@@ -1,0 +1,31 @@
+"""swarmdb_tpu — a TPU-native multi-agent messaging + LLM serving framework.
+
+Capability parity with The-Swarm-Corporation/SwarmDB (messaging core, wire
+API) plus a first-class JAX/XLA serving layer (continuous-batched generation,
+paged KV cache, DP/TP/EP over a `jax.sharding.Mesh`). See SURVEY.md.
+"""
+
+from .core.messages import (
+    BackendSpec,
+    BrokerConfig,
+    KafkaConfig,
+    Message,
+    MessagePriority,
+    MessageStatus,
+    MessageType,
+)
+from .core.runtime import SwarmDB, SwarmsDB
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BackendSpec",
+    "BrokerConfig",
+    "KafkaConfig",
+    "Message",
+    "MessagePriority",
+    "MessageStatus",
+    "MessageType",
+    "SwarmDB",
+    "SwarmsDB",
+]

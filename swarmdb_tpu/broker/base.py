@@ -1,0 +1,329 @@
+"""Broker transport interface (the L1 layer).
+
+This is the contract the reference consumes from confluent_kafka/librdkafka
+(produce/poll/flush at ` main.py:476-484,1386`; subscribe/poll/close at
+`:344,557,367`; list_topics/create_topics/create_partitions at
+`:241,277,1349`) re-expressed as an in-tree interface with two
+implementations:
+
+- ``broker.local.LocalBroker`` — pure-Python, thread-safe, in-memory with
+  optional JSON durability; used for tests and single-process serving.
+- ``broker.native.NativeBroker`` — C++ engine (mmap append-only segment log,
+  per-partition rings) loaded via ctypes; the production path.
+
+Key semantic choices (deliberate departures from the reference, per SURVEY):
+
+- Partition affinity is REAL: consumers subscribe to specific partitions and
+  unicast messages are produced to the receiver's partition, so receive is
+  O(own messages). The reference's consumers re-read the whole topic and
+  filter client-side (defect D8, ` main.py:334-345,579-585`).
+- Broadcast is a fan-out WRITE (one record per partition) instead of a
+  fan-out READ, preserving single-partition consumption.
+- The partitioner is stable FNV-1a (fixes defect D6).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Record:
+    """One entry in a partition log (librdkafka ``Message`` equivalent)."""
+
+    topic: str
+    partition: int
+    offset: int
+    key: Optional[bytes]
+    value: bytes
+    timestamp: float
+
+
+@dataclass
+class TopicMeta:
+    name: str
+    num_partitions: int
+    retention_ms: int
+
+
+DeliveryCallback = Callable[[Optional[str], Record], None]
+# signature mirrors rdkafka's (err, msg) delivery report (` main.py:374-391`):
+# err is None on success, else a human-readable error string.
+
+
+class BrokerError(Exception):
+    pass
+
+
+class UnknownTopicError(BrokerError):
+    pass
+
+
+class Broker(abc.ABC):
+    """Storage + admin plane. One per process (or one native engine)."""
+
+    # -- admin (AdminClient equivalent: ` main.py:241,277,1349`) -------------
+
+    @abc.abstractmethod
+    def create_topic(
+        self, name: str, num_partitions: int, retention_ms: int = 7 * 24 * 3600 * 1000
+    ) -> bool:
+        """Create a topic; returns False if it already existed."""
+
+    @abc.abstractmethod
+    def list_topics(self) -> Dict[str, TopicMeta]: ...
+
+    @abc.abstractmethod
+    def create_partitions(self, name: str, new_total: int) -> None:
+        """Grow (never shrink) a topic's partition count
+        (reference `auto_scale_partitions`, ` main.py:1327-1365`)."""
+
+    # -- data plane ----------------------------------------------------------
+
+    @abc.abstractmethod
+    def append(
+        self,
+        topic: str,
+        partition: int,
+        value: bytes,
+        key: Optional[bytes] = None,
+        timestamp: Optional[float] = None,
+    ) -> int:
+        """Append one record; returns its offset."""
+
+    @abc.abstractmethod
+    def fetch(
+        self, topic: str, partition: int, offset: int, max_records: int = 256
+    ) -> List[Record]:
+        """Read records at >= offset. Non-blocking; empty list if none."""
+
+    @abc.abstractmethod
+    def end_offset(self, topic: str, partition: int) -> int:
+        """Offset one past the last record (== next offset to be assigned)."""
+
+    @abc.abstractmethod
+    def begin_offset(self, topic: str, partition: int) -> int:
+        """Earliest retained offset (>0 after retention trims)."""
+
+    @abc.abstractmethod
+    def wait_for_data(
+        self, topic: str, partition: int, offset: int, timeout_s: float
+    ) -> bool:
+        """Block until a record at >= offset exists or timeout. True if data."""
+
+    # -- consumer-group offsets ---------------------------------------------
+
+    @abc.abstractmethod
+    def commit_offset(self, group: str, topic: str, partition: int, offset: int) -> None: ...
+
+    @abc.abstractmethod
+    def committed_offset(self, group: str, topic: str, partition: int) -> Optional[int]: ...
+
+    # -- retention / durability ---------------------------------------------
+
+    @abc.abstractmethod
+    def trim_older_than(self, topic: str, cutoff_ts: float) -> int:
+        """Drop records older than cutoff; returns number dropped."""
+
+    def flush(self) -> None:
+        """Force durability (fsync segment logs). No-op for in-memory."""
+
+    def close(self) -> None:
+        pass
+
+    # -- health --------------------------------------------------------------
+
+    def healthy(self) -> bool:
+        """Liveness probe used by GET /health (reference `api.py:794-800`)."""
+        try:
+            self.list_topics()
+            return True
+        except Exception:
+            return False
+
+
+class Producer:
+    """Client-side producer with delivery reports.
+
+    Mirrors the confluent Producer surface the reference uses
+    (` main.py:476-484`): ``produce(topic, value, key, partition,
+    on_delivery)`` + ``poll`` + ``flush``. Callbacks are queued at produce
+    time and fired from ``poll``/``flush``, matching rdkafka's
+    callback-on-poll contract.
+    """
+
+    def __init__(self, broker: Broker) -> None:
+        self._broker = broker
+        self._pending: List[Tuple[DeliveryCallback, Optional[str], Record]] = []
+        self._pending_lock = threading.Lock()
+
+    def produce(
+        self,
+        topic: str,
+        value: bytes,
+        key: Optional[bytes] = None,
+        partition: Optional[int] = None,
+        on_delivery: Optional[DeliveryCallback] = None,
+    ) -> Record:
+        if partition is None:
+            from ..utils.hashing import stable_partition
+
+            meta = self._broker.list_topics().get(topic)
+            if meta is None:
+                raise UnknownTopicError(topic)
+            partition = stable_partition(
+                (key or value).decode("utf-8", "replace"), meta.num_partitions
+            )
+        # Local errors raise synchronously (rdkafka contract); the delivery
+        # callback reports the committed (topic, partition, offset).
+        ts = time.time()
+        offset = self._broker.append(topic, partition, value, key=key, timestamp=ts)
+        record = Record(topic, partition, offset, key, value, ts)
+        if on_delivery is not None:
+            with self._pending_lock:
+                self._pending.append((on_delivery, None, record))
+        return record
+
+    def poll(self, timeout: float = 0.0) -> int:
+        """Fire queued delivery callbacks; returns how many fired."""
+        with self._pending_lock:
+            batch, self._pending = self._pending, []
+        for cb, err, rec in batch:
+            cb(err, rec)
+        return len(batch)
+
+    def flush(self, timeout: float = -1.0) -> int:
+        self.poll(0)
+        self._broker.flush()
+        return 0
+
+
+@dataclass
+class _PartitionCursor:
+    topic: str
+    partition: int
+    next_offset: int
+
+
+class Consumer:
+    """Partition-affine consumer with committed offsets.
+
+    Unlike the reference's consumers (whole-topic subscribe + client-side
+    filter, defect D8), a Consumer subscribes to explicit ``(topic,
+    partition)`` pairs — normally exactly the one partition its agent hashes
+    to — and round-robins across them.
+    """
+
+    def __init__(
+        self,
+        broker: Broker,
+        group_id: str,
+        auto_offset_reset: str = "earliest",
+        auto_commit: bool = True,
+    ) -> None:
+        self._broker = broker
+        self.group_id = group_id
+        self._auto_offset_reset = auto_offset_reset
+        self._auto_commit = auto_commit
+        self._cursors: List[_PartitionCursor] = []
+        self._rr = 0  # round-robin index
+        self._closed = False
+
+    def assign(self, assignments: Sequence[Tuple[str, int]]) -> None:
+        """Subscribe to explicit (topic, partition) pairs."""
+        self._cursors = []
+        for topic, part in assignments:
+            committed = self._broker.committed_offset(self.group_id, topic, part)
+            if committed is not None:
+                start = committed
+            elif self._auto_offset_reset == "latest":
+                start = self._broker.end_offset(topic, part)
+            else:  # earliest
+                start = self._broker.begin_offset(topic, part)
+            self._cursors.append(_PartitionCursor(topic, part, start))
+
+    def add_assignment(
+        self, topic: str, partition: int, start_offset: Optional[int] = None
+    ) -> bool:
+        """Incrementally add one partition, KEEPING existing assignments.
+
+        Used on partition-count growth (`SwarmDB.auto_scale_partitions`): the
+        old partition stays assigned so its undelivered backlog drains, and
+        the newly-mapped partition starts at committed-offset-if-any, else
+        ``start_offset`` (the caller's pre-growth end snapshot), else its
+        CURRENT END — never earliest — so historical records there (e.g.
+        broadcast fan-out copies this group already consumed via its old
+        partition) are not replayed. Returns False if already assigned.
+        """
+        for cur in self._cursors:
+            if (cur.topic, cur.partition) == (topic, partition):
+                return False
+        committed = self._broker.committed_offset(self.group_id, topic, partition)
+        if committed is not None:
+            start = committed
+        elif start_offset is not None:
+            start = start_offset
+        else:
+            start = self._broker.end_offset(topic, partition)
+        self._cursors.append(_PartitionCursor(topic, partition, start))
+        return True
+
+    def subscribe_topic(self, topic: str) -> None:
+        """Whole-topic subscription (all partitions) — reference-compatible
+        mode used by admin/replay tooling, not the per-agent hot path."""
+        meta = self._broker.list_topics().get(topic)
+        if meta is None:
+            raise UnknownTopicError(topic)
+        self.assign([(topic, p) for p in range(meta.num_partitions)])
+
+    def poll(self, timeout: float = 0.0) -> Optional[Record]:
+        """Next record from any assigned partition, or None on timeout."""
+        if self._closed or not self._cursors:
+            return None
+        deadline = time.time() + max(0.0, timeout)
+        while True:
+            for _ in range(len(self._cursors)):
+                cur = self._cursors[self._rr % len(self._cursors)]
+                self._rr += 1
+                # Retention may have trimmed past our cursor — skip forward.
+                begin = self._broker.begin_offset(cur.topic, cur.partition)
+                if cur.next_offset < begin:
+                    cur.next_offset = begin
+                recs = self._broker.fetch(cur.topic, cur.partition, cur.next_offset, 1)
+                if recs:
+                    rec = recs[0]
+                    cur.next_offset = rec.offset + 1
+                    if self._auto_commit:
+                        self._broker.commit_offset(
+                            self.group_id, cur.topic, cur.partition, cur.next_offset
+                        )
+                    return rec
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return None
+            # Block on the first cursor's partition for the remainder; any
+            # new data there wakes us, otherwise we re-scan on timeout.
+            cur = self._cursors[self._rr % len(self._cursors)]
+            self._broker.wait_for_data(
+                cur.topic, cur.partition, cur.next_offset, min(remaining, 0.05)
+            )
+
+    def commit(self) -> None:
+        for cur in self._cursors:
+            self._broker.commit_offset(
+                self.group_id, cur.topic, cur.partition, cur.next_offset
+            )
+
+    def close(self) -> None:
+        if not self._closed:
+            if self._auto_commit:
+                self.commit()
+            self._closed = True
+
+    @property
+    def assignments(self) -> List[Tuple[str, int]]:
+        return [(c.topic, c.partition) for c in self._cursors]

@@ -1,0 +1,886 @@
+"""SwarmDB — the core multi-agent messaging runtime.
+
+Capability parity with the reference's ``SwarmsDB`` class
+(`swarmdb/ main.py:130-1394`): agent lifecycle, unicast/broadcast/group
+send, polled receive, query/search/conversation, status management,
+JSON/YAML persistence + archive GC, stats/load introspection, LLM-backend
+assignment, partition autoscaling, and context-manager shutdown.
+
+Architectural differences (all deliberate, per SURVEY.md):
+
+- Transport is the in-tree broker (``broker/``), not an external Kafka
+  cluster; the L1 interface is the same shape (produce/poll/flush,
+  subscribe/poll/close, create_topics/create_partitions).
+- Partition routing uses stable FNV-1a (fixes defect D6) and consumers have
+  REAL partition affinity: unicast is produced to the receiver's partition,
+  broadcast is a fan-out write to every partition, and each agent's consumer
+  reads only its own partition (fixes defect D8 — receive is O(own
+  messages), not O(all messages)).
+- All shared state is guarded by one RLock; the reference shares unlocked
+  dicts across 4 gunicorn threads (SURVEY §5.2).
+- ``resend_failed_messages`` marks the failed original with
+  ``metadata.resent_to`` and skips already-resent messages, so repeated
+  calls don't duplicate (fixes defect D10).
+- Stats counters are maintained incrementally (O(1) ``get_stats``) instead
+  of full scans (` main.py:973-1024`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+from ..broker.base import Broker, Consumer, Producer, Record
+from ..utils.hashing import stable_partition
+from ..utils.metrics import MetricsRegistry
+from .messages import (
+    BrokerConfig,
+    Message,
+    MessageContent,
+    MessagePriority,
+    MessageStatus,
+    MessageType,
+)
+
+logger = logging.getLogger("swarmdb_tpu")
+
+
+def _default_broker(config: BrokerConfig) -> Broker:
+    """Pick the broker implementation: native C++ engine when built and
+    requested, else the pure-Python LocalBroker."""
+    impl = config.implementation
+    if impl in ("auto", "native"):
+        try:
+            from ..broker.native import NativeBroker, native_available
+
+            if native_available():
+                return NativeBroker(log_dir=config.log_dir)
+            if impl == "native":
+                raise RuntimeError("native broker requested but library not built")
+        except ImportError:
+            if impl == "native":
+                raise
+    from ..broker.local import LocalBroker
+
+    return LocalBroker()
+
+
+class SwarmDB:
+    """TPU-native re-implementation of the reference's ``SwarmsDB``
+    (` main.py:130-1394`)."""
+
+    def __init__(
+        self,
+        config: Optional[BrokerConfig] = None,
+        topic_name: str = "swarm_messages",
+        save_dir: str = "message_history",
+        autosave_interval: float = 300.0,
+        max_messages_per_file: int = 10_000,
+        token_counter: Optional[Callable[[str], int]] = None,
+        broker: Optional[Broker] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        # Reference `__init__` ` main.py:156-237`.
+        self.config = config or BrokerConfig()
+        self.topic_name = topic_name
+        self.error_topic = f"{topic_name}_errors"
+        self.save_dir = save_dir
+        self.autosave_interval = autosave_interval
+        self.max_messages_per_file = max_messages_per_file
+        self.token_counter = token_counter
+        self.metrics = metrics or MetricsRegistry()
+
+        self.broker: Broker = broker if broker is not None else _default_broker(self.config)
+        self.producer = Producer(self.broker)
+        self._ensure_topics_exist()
+
+        self._lock = threading.RLock()
+        self.registered_agents: Set[str] = set()
+        self.consumers: Dict[str, Consumer] = {}
+        self.messages: Dict[str, Message] = {}
+        self.agent_inbox: Dict[str, List[Message]] = {}
+        self.agent_metadata: Dict[str, Dict[str, Any]] = {}
+        self.metadata: Dict[str, Any] = {
+            "agent_groups": {},  # reference stores groups here (` main.py:1208-1227`)
+            "llm_backends": {},  # agent_id -> backend_id (` main.py:1293-1325`)
+        }
+        self.llm_load_balancing_enabled = False
+        self.message_count = 0
+        self._last_save_time = time.time()
+        self._sends_since_save = 0
+        self._prescale_ends: Dict[int, int] = {}
+        self._closed = False
+
+        # incremental stats (replaces full scans at ` main.py:973-1024`);
+        # per-agent receive rate lives in self.metrics.rates (self-evicting
+        # trailing window — bounded, unlike a raw timestamp list).
+        self._stats_by_type: Dict[str, int] = {}
+        self._stats_by_status: Dict[str, int] = {}
+        self._stats_by_agent: Dict[str, Dict[str, int]] = {}
+
+        os.makedirs(self.save_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------ setup
+
+    def _ensure_topics_exist(self) -> None:
+        """Create base + error topics (reference ` main.py:239-293`:
+        base topic with N partitions & 7-day retention, `{base}_errors` with
+        1 partition & 2x retention)."""
+        self.broker.create_topic(
+            self.topic_name, self.config.num_partitions, self.config.retention_ms
+        )
+        self.broker.create_topic(self.error_topic, 1, self.config.retention_ms * 2)
+
+    def _count_tokens(self, content: MessageContent) -> Optional[int]:
+        """Pluggable token counting (reference ` main.py:295-307`):
+        structured content is JSON-serialized first."""
+        if self.token_counter is None:
+            return None
+        text = content if isinstance(content, str) else json.dumps(content)
+        try:
+            return int(self.token_counter(text))
+        except Exception as exc:
+            logger.warning("token counter failed: %s", exc)
+            return None
+
+    def _get_partition(self, agent_id: str) -> int:
+        """Stable agent → partition mapping (fixes defect D6;
+        reference ` main.py:309-312`)."""
+        num = self.broker.list_topics()[self.topic_name].num_partitions
+        return stable_partition(agent_id, num)
+
+    # --------------------------------------------------------------- registry
+
+    def register_agent(self, agent_id: str, metadata: Optional[Dict[str, Any]] = None) -> bool:
+        """Register an agent and attach a partition-affine consumer
+        (reference ` main.py:314-349` — but assigned to the agent's own
+        partition instead of the whole topic, fixing D8)."""
+        with self._lock:
+            if agent_id in self.registered_agents:
+                if metadata:
+                    self.agent_metadata.setdefault(agent_id, {}).update(metadata)
+                return False
+            self.registered_agents.add(agent_id)
+            self.agent_inbox.setdefault(agent_id, [])
+            if metadata:
+                self.agent_metadata[agent_id] = dict(metadata)
+            # Fresh per-agent consumers start at the partition END (not
+            # `auto_offset_reset`): send_message registers the receiver
+            # BEFORE producing, so no record addressed to this agent can
+            # predate this consumer — replaying history would only churn
+            # through other agents' records client-side (the O(all) receive
+            # cost of reference defect D8). Committed offsets still resume.
+            consumer = Consumer(
+                self.broker,
+                group_id=f"{self.config.group_id}_{agent_id}",
+                auto_offset_reset="latest",
+            )
+            consumer.assign([(self.topic_name, self._get_partition(agent_id))])
+            self.consumers[agent_id] = consumer
+            self.metrics.counters["agents_registered"].inc()
+            logger.info("registered agent %s", agent_id)
+            return True
+
+    def deregister_agent(self, agent_id: str) -> bool:
+        """Remove an agent and close its consumer (reference ` main.py:351-372`)."""
+        with self._lock:
+            if agent_id not in self.registered_agents:
+                return False
+            self.registered_agents.discard(agent_id)
+            consumer = self.consumers.pop(agent_id, None)
+            if consumer is not None:
+                consumer.close()
+            self.agent_metadata.pop(agent_id, None)
+            # inbox retained, as in the reference (messages remain queryable)
+            logger.info("deregistered agent %s", agent_id)
+            return True
+
+    def _reassign_consumers(self) -> None:
+        """After partition growth, ADD each agent's newly-mapped partition to
+        its consumer while keeping the old one, so the old partition's
+        undelivered backlog still drains and the new partition starts at its
+        current end (no broadcast replay). No reference counterpart — the
+        reference's whole-topic subscribe makes this moot at the cost of
+        O(all) receives (defect D8)."""
+        with self._lock:
+            for agent_id, consumer in self.consumers.items():
+                part = self._get_partition(agent_id)
+                consumer.add_assignment(
+                    self.topic_name, part, start_offset=self._prescale_ends.get(part)
+                )
+
+    # ------------------------------------------------------------------- send
+
+    def _delivery_callback(self, err: Optional[str], record: Record) -> None:
+        """Broker delivery report → message status (reference ` main.py:374-391`)."""
+        msg_id = record.key.decode() if record.key else None
+        with self._lock:
+            msg = self.messages.get(msg_id) if msg_id else None
+            if msg is None:
+                return
+            if err is None:
+                self._set_status(msg, MessageStatus.DELIVERED)
+                # first report wins: on broadcast fan-out the (partition,
+                # offset) of copy #1 is as good an anchor as any
+                msg.metadata.setdefault("partition", record.partition)
+                msg.metadata.setdefault("offset", record.offset)
+            else:
+                self._set_status(msg, MessageStatus.FAILED)
+                msg.metadata["error"] = err
+
+    def send_message(
+        self,
+        sender_id: str,
+        receiver_id: Optional[str],
+        content: MessageContent,
+        message_type: MessageType = MessageType.CHAT,
+        priority: MessagePriority = MessagePriority.NORMAL,
+        metadata: Optional[Dict[str, Any]] = None,
+        visible_to: Optional[List[str]] = None,
+    ) -> str:
+        """Send one message; returns its id (reference ` main.py:374-519`).
+
+        Broadcast (``receiver_id=None``) fills ``visible_to`` with every
+        registered agent except the sender and is produced to EVERY
+        partition (fan-out write) so partition-affine consumers still see it.
+        """
+        message_type = MessageType(message_type)
+        priority = MessagePriority(priority)
+        # auto-register both ends (reference :419-427)
+        self.register_agent(sender_id)
+        if receiver_id is not None:
+            self.register_agent(receiver_id)
+
+        msg = Message(
+            sender_id=sender_id,
+            receiver_id=receiver_id,
+            content=content,
+            type=message_type,
+            priority=priority,
+            metadata=dict(metadata or {}),
+            token_count=self._count_tokens(content),
+        )
+        if receiver_id is None:
+            with self._lock:
+                everyone = self.registered_agents - {sender_id}
+            # None = everyone; an explicit list (even empty) is honored —
+            # excluding all agents must NOT fall back to "all" (empty-list
+            # vs None ambiguity).
+            if visible_to is None:
+                msg.visible_to = sorted(everyone)
+            else:
+                msg.visible_to = sorted(set(visible_to) & everyone)
+        elif visible_to:
+            msg.visible_to = list(visible_to)
+        msg.stage_stamp("enqueued")
+
+        with self._lock:
+            self.messages[msg.id] = msg
+            self._stats_record_new(msg)
+            if receiver_id is not None:
+                self.agent_inbox.setdefault(receiver_id, []).append(msg)
+            else:
+                for agent in msg.visible_to:
+                    self.agent_inbox.setdefault(agent, []).append(msg)
+            self.message_count += 1
+            self._sends_since_save += 1
+
+        if receiver_id is None and not msg.visible_to:
+            # Broadcast with no eligible recipients: nothing to put on the
+            # wire (an empty visible_to on the wire would mean "all" to
+            # reference-compatible consumers). Trivially delivered.
+            with self._lock:
+                self._set_status(msg, MessageStatus.DELIVERED)
+            self.metrics.counters["messages_sent"].inc()
+            return msg.id
+
+        payload = json.dumps(msg.to_dict()).encode("utf-8")
+        key = msg.id.encode("utf-8")
+        try:
+            if receiver_id is not None:
+                self.producer.produce(
+                    self.topic_name,
+                    payload,
+                    key=key,
+                    partition=self._get_partition(receiver_id),
+                    on_delivery=self._delivery_callback,
+                )
+            else:
+                num = self.broker.list_topics()[self.topic_name].num_partitions
+                for p in range(num):
+                    self.producer.produce(
+                        self.topic_name, payload, key=key, partition=p,
+                        on_delivery=self._delivery_callback,
+                    )
+            self.producer.poll(0)
+        except Exception as exc:
+            # failure path (reference :507-517): FAILED + copy to error topic
+            with self._lock:
+                self._set_status(msg, MessageStatus.FAILED)
+                msg.metadata["error"] = str(exc)
+            try:
+                self.producer.produce(self.error_topic, payload, key=key, partition=0)
+            except Exception:
+                logger.exception("error-topic produce failed for %s", msg.id)
+            raise
+
+        self.metrics.counters["messages_sent"].inc()
+        self.metrics.rates["messages_sent"].mark()
+        self._maybe_autosave()
+        return msg.id
+
+    def broadcast_message(
+        self,
+        sender_id: str,
+        content: MessageContent,
+        message_type: MessageType = MessageType.CHAT,
+        priority: MessagePriority = MessagePriority.NORMAL,
+        metadata: Optional[Dict[str, Any]] = None,
+        exclude_agents: Optional[Sequence[str]] = None,
+    ) -> str:
+        """Broadcast to all registered agents minus sender minus exclusions
+        (reference ` main.py:810-850`)."""
+        with self._lock:
+            visible = sorted(
+                self.registered_agents - {sender_id} - set(exclude_agents or ())
+            )
+        return self.send_message(
+            sender_id,
+            None,
+            content,
+            message_type=message_type,
+            priority=priority,
+            metadata=metadata,
+            visible_to=visible,
+        )
+
+    # ---------------------------------------------------------------- receive
+
+    def receive_messages(
+        self,
+        agent_id: str,
+        max_messages: int = 10,
+        timeout: float = 5.0,
+    ) -> List[Message]:
+        """Poll the agent's partition for its messages
+        (reference ` main.py:521-601`). Bounded by ``max_messages`` and
+        wall-clock ``timeout``; marks received messages READ."""
+        self.register_agent(agent_id)
+        consumer = self.consumers[agent_id]
+        out: List[Message] = []
+        deadline = time.time() + timeout
+        while len(out) < max_messages:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            rec = consumer.poll(min(remaining, self.config.consumer_timeout_ms / 1000.0))
+            if rec is None:
+                break  # no data within poll window (reference breaks on EOF :566-568)
+            try:
+                msg = Message.from_dict(json.loads(rec.value.decode("utf-8")))
+            except Exception as exc:
+                logger.warning("undecodable record at %s[%d]@%d: %s",
+                               rec.topic, rec.partition, rec.offset, exc)
+                continue
+            # visibility filter (reference :579-585)
+            if msg.receiver_id not in (agent_id, None):
+                continue
+            if msg.receiver_id is None:
+                if msg.sender_id == agent_id:
+                    continue
+                if msg.visible_to and agent_id not in msg.visible_to:
+                    continue
+            with self._lock:
+                stored = self.messages.get(msg.id)
+                target = stored if stored is not None else msg
+                if msg.receiver_id is None:
+                    # Broadcast fan-out writes one copy per partition; a
+                    # consumer holding several partitions (post-scale) sees
+                    # several copies — dedup per agent via read_by.
+                    read_by = target.metadata.setdefault("read_by", [])
+                    if agent_id in read_by:
+                        continue
+                    read_by.append(agent_id)
+                self._set_status(target, MessageStatus.READ)
+                if stored is None:
+                    # record arrived from another process/worker — adopt it
+                    self.messages[msg.id] = msg
+                    self.agent_inbox.setdefault(agent_id, []).append(msg)
+                    self._stats_record_new(msg)
+            out.append(target)
+            self.metrics.counters["messages_received"].inc()
+            self.metrics.rates[f"agent_recv:{agent_id}"].mark()
+        return out
+
+    # ------------------------------------------------------------ read/query
+
+    def get_message(self, message_id: str) -> Optional[Message]:
+        """Reference ` main.py:603-612`."""
+        with self._lock:
+            return self.messages.get(message_id)
+
+    def get_agent_messages(
+        self,
+        agent_id: str,
+        status: Optional[MessageStatus] = None,
+        limit: int = 100,
+        skip: int = 0,
+    ) -> List[Message]:
+        """Inbox pagination, newest-first (reference ` main.py:614-652`)."""
+        with self._lock:
+            inbox = list(reversed(self.agent_inbox.get(agent_id, [])))
+        if status is not None:
+            status = MessageStatus(status)
+            inbox = [m for m in inbox if m.status == status]
+        return inbox[skip : skip + limit]
+
+    def query_messages(
+        self,
+        sender_id: Optional[str] = None,
+        receiver_id: Optional[str] = None,
+        message_type: Optional[MessageType] = None,
+        status: Optional[MessageStatus] = None,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+        limit: int = 100,
+    ) -> List[Message]:
+        """Multi-filter scan, newest-first (reference ` main.py:671-726`)."""
+        message_type = MessageType(message_type) if message_type is not None else None
+        status = MessageStatus(status) if status is not None else None
+        if limit <= 0:
+            return []
+        with self._lock:
+            msgs = list(self.messages.values())
+        out = []
+        for m in sorted(msgs, key=lambda m: m.timestamp, reverse=True):
+            if sender_id is not None and m.sender_id != sender_id:
+                continue
+            if receiver_id is not None and m.receiver_id != receiver_id:
+                continue
+            if message_type is not None and m.type != message_type:
+                continue
+            if status is not None and m.status != status:
+                continue
+            if start_time is not None and m.timestamp < start_time:
+                continue
+            if end_time is not None and m.timestamp > end_time:
+                continue
+            out.append(m)
+            if len(out) >= limit:
+                break
+        return out
+
+    def search_messages(
+        self, keyword: str, case_sensitive: bool = False, limit: int = 100
+    ) -> List[Message]:
+        """Keyword search over content (structured content JSON-serialized
+        first), reference ` main.py:728-768`."""
+        if limit <= 0:
+            return []
+        needle = keyword if case_sensitive else keyword.lower()
+        with self._lock:
+            msgs = list(self.messages.values())
+        out = []
+        for m in sorted(msgs, key=lambda m: m.timestamp, reverse=True):
+            hay = m.content if isinstance(m.content, str) else json.dumps(m.content)
+            if not case_sensitive:
+                hay = hay.lower()
+            if needle in hay:
+                out.append(m)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def get_conversation(
+        self, agent_a: str, agent_b: str, limit: int = 100
+    ) -> List[Message]:
+        """Two-way conversation, chronological, up to ``limit`` newest
+        messages (reference ` main.py:770-808` queries limit/2 per direction,
+        which starves one side and returns nothing for limit=1; we query
+        ``limit`` per direction and trim the merge)."""
+        if limit <= 0:
+            return []
+        a_to_b = self.query_messages(sender_id=agent_a, receiver_id=agent_b, limit=limit)
+        b_to_a = self.query_messages(sender_id=agent_b, receiver_id=agent_a, limit=limit)
+        merged = sorted(a_to_b + b_to_a, key=lambda m: m.timestamp)
+        return merged[-limit:]
+
+    # ------------------------------------------------------------- status mgmt
+
+    def _set_status(self, msg: Message, status: MessageStatus) -> None:
+        """Single choke-point for status transitions; keeps incremental
+        by-status counters consistent."""
+        old = msg.status
+        if old == status:
+            return
+        msg.status = status
+        self._stats_by_status[old.value] = max(0, self._stats_by_status.get(old.value, 0) - 1)
+        self._stats_by_status[status.value] = self._stats_by_status.get(status.value, 0) + 1
+
+    def update_message_status(self, message_id: str, status: MessageStatus) -> bool:
+        """Direct status transition (API PUT /messages/{id}/status path,
+        reference `api.py:691-733`)."""
+        status = MessageStatus(status)
+        with self._lock:
+            msg = self.messages.get(message_id)
+            if msg is None:
+                return False
+            self._set_status(msg, status)
+            return True
+
+    def mark_message_as_processed(self, message_id: str) -> bool:
+        """Reference ` main.py:654-669`."""
+        return self.update_message_status(message_id, MessageStatus.PROCESSED)
+
+    def resend_failed_messages(self) -> List[str]:
+        """Re-emit every FAILED message as a new message with
+        ``metadata.resent_from`` lineage (reference ` main.py:1096-1130`).
+        Fixes defect D10: the failed original is stamped with ``resent_to``
+        and skipped on subsequent calls, so repeat invocations are idempotent.
+        """
+        with self._lock:
+            failed = [
+                m for m in self.messages.values()
+                if m.status == MessageStatus.FAILED and "resent_to" not in m.metadata
+            ]
+        new_ids: List[str] = []
+        for m in failed:
+            new_id = self.send_message(
+                m.sender_id,
+                m.receiver_id,
+                m.content,
+                message_type=m.type,
+                priority=m.priority,
+                metadata={**m.metadata, "resent_from": m.id},
+            )
+            with self._lock:
+                m.metadata["resent_to"] = new_id
+            new_ids.append(new_id)
+        if new_ids:
+            logger.info("resent %d failed messages", len(new_ids))
+        return new_ids
+
+    # ----------------------------------------------------------------- groups
+
+    def add_agent_group(self, group_name: str, agent_ids: Sequence[str]) -> bool:
+        """Create/replace a named group (reference ` main.py:1208-1227`)."""
+        for a in agent_ids:
+            self.register_agent(a)
+        with self._lock:
+            self.metadata["agent_groups"][group_name] = list(agent_ids)
+        logger.info("group %s = %s", group_name, list(agent_ids))
+        return True
+
+    def get_agent_group(self, group_name: str) -> Optional[List[str]]:
+        with self._lock:
+            members = self.metadata["agent_groups"].get(group_name)
+            return list(members) if members is not None else None
+
+    def send_to_group(
+        self,
+        sender_id: str,
+        group_name: str,
+        content: MessageContent,
+        message_type: MessageType = MessageType.CHAT,
+        priority: MessagePriority = MessagePriority.NORMAL,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> List[str]:
+        """Group fan-out: one unicast per member, skipping the sender, each
+        stamped with ``metadata.group`` (reference ` main.py:1229-1279`).
+
+        The sends target distinct partitions, so downstream the TPU backend
+        services the fan-out as one data-parallel batch over the mesh
+        (SURVEY §3.4).
+        """
+        members = self.get_agent_group(group_name)
+        if members is None:
+            raise KeyError(f"unknown group: {group_name}")
+        ids = []
+        for member in members:
+            if member == sender_id:
+                continue
+            ids.append(
+                self.send_message(
+                    sender_id,
+                    member,
+                    content,
+                    message_type=message_type,
+                    priority=priority,
+                    metadata={**(metadata or {}), "group": group_name},
+                )
+            )
+        return ids
+
+    # ------------------------------------------------------------ persistence
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """Snapshot schema identical to the reference (` main.py:878-884`):
+        {messages, agent_inbox, registered_agents, timestamp, message_count}."""
+        with self._lock:
+            return {
+                "messages": {mid: m.to_dict() for mid, m in self.messages.items()},
+                "agent_inbox": {
+                    a: [m.id for m in inbox] for a, inbox in self.agent_inbox.items()
+                },
+                "registered_agents": sorted(self.registered_agents),
+                "timestamp": time.time(),
+                "message_count": self.message_count,
+            }
+
+    def save_message_history(self, filepath: Optional[str] = None) -> str:
+        """JSON snapshot to a timestamped file (reference ` main.py:852-892`)."""
+        if filepath is None:
+            filepath = os.path.join(
+                self.save_dir, f"message_history_{int(time.time())}.json"
+            )
+        state = self._snapshot_state()
+        os.makedirs(os.path.dirname(filepath) or ".", exist_ok=True)
+        tmp = filepath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=2)
+        os.replace(tmp, filepath)
+        with self._lock:
+            self._last_save_time = time.time()
+            self._sends_since_save = 0
+        logger.info("saved message history to %s", filepath)
+        return filepath
+
+    def load_message_history(self, filepath: str) -> int:
+        """Restore a snapshot: messages, inboxes, re-registered agents
+        (reference ` main.py:894-934`). Returns number of messages loaded."""
+        with open(filepath) as f:
+            state = json.load(f)
+        msgs = {mid: Message.from_dict(d) for mid, d in state["messages"].items()}
+        with self._lock:
+            self.messages.update(msgs)
+            self._rebuild_stats()
+            for agent, ids in state.get("agent_inbox", {}).items():
+                inbox = self.agent_inbox.setdefault(agent, [])
+                known = {m.id for m in inbox}
+                for mid in ids:
+                    if mid in msgs and mid not in known:
+                        inbox.append(msgs[mid])
+            self.message_count = state.get("message_count", len(self.messages))
+        for agent in state.get("registered_agents", []):
+            self.register_agent(agent)
+        logger.info("loaded %d messages from %s", len(msgs), filepath)
+        return len(msgs)
+
+    def export_as_yaml(self, filepath: Optional[str] = None) -> str:
+        """YAML export of the same snapshot shape (reference ` main.py:936-971`)."""
+        import yaml
+
+        if filepath is None:
+            filepath = os.path.join(
+                self.save_dir, f"message_history_{int(time.time())}.yaml"
+            )
+        with open(filepath, "w") as f:
+            yaml.safe_dump(self._snapshot_state(), f, sort_keys=False)
+        return filepath
+
+    def _maybe_autosave(self) -> None:
+        """Autosave on interval or message-count threshold
+        (reference ` main.py:492-497`: 300 s / 10 k sends)."""
+        with self._lock:
+            due = (
+                time.time() - self._last_save_time >= self.autosave_interval
+                or self._sends_since_save >= self.max_messages_per_file
+            )
+        if due:
+            try:
+                self.save_message_history()
+            except Exception:
+                logger.exception("autosave failed")
+
+    # --------------------------------------------------------------------- GC
+
+    def delete_message(self, message_id: str) -> bool:
+        """Remove from the store and every inbox (reference ` main.py:1132-1157`)."""
+        with self._lock:
+            msg = self.messages.pop(message_id, None)
+            if msg is None:
+                return False
+            self._stats_record_removed(msg)
+            for inbox in self.agent_inbox.values():
+                inbox[:] = [m for m in inbox if m.id != message_id]
+            return True
+
+    def flush_old_messages(self, max_age_seconds: float = 7 * 24 * 3600) -> int:
+        """Archive-then-delete messages older than the cutoff
+        (reference ` main.py:1159-1206`): archive JSON lands under
+        ``save_dir/archives/``; broker log is trimmed to match."""
+        cutoff = time.time() - max_age_seconds
+        with self._lock:
+            old = [m for m in self.messages.values() if m.timestamp < cutoff]
+        if not old:
+            return 0
+        archive_dir = os.path.join(self.save_dir, "archives")
+        os.makedirs(archive_dir, exist_ok=True)
+        path = os.path.join(archive_dir, f"archive_{int(time.time())}.json")
+        with open(path, "w") as f:
+            json.dump({"messages": [m.to_dict() for m in old],
+                       "archived_at": time.time()}, f, indent=2)
+        for m in old:
+            self.delete_message(m.id)
+        self.broker.trim_older_than(self.topic_name, cutoff)
+        logger.info("archived %d messages to %s", len(old), path)
+        return len(old)
+
+    # ------------------------------------------------------------------ stats
+
+    def _stats_record_new(self, msg: Message) -> None:
+        self._stats_by_type[msg.type.value] = self._stats_by_type.get(msg.type.value, 0) + 1
+        self._stats_by_status[msg.status.value] = (
+            self._stats_by_status.get(msg.status.value, 0) + 1
+        )
+        sender = self._stats_by_agent.setdefault(msg.sender_id, {"sent": 0, "received": 0})
+        sender["sent"] += 1
+        if msg.receiver_id is not None:
+            recv = self._stats_by_agent.setdefault(
+                msg.receiver_id, {"sent": 0, "received": 0}
+            )
+            recv["received"] += 1
+
+    def _stats_record_removed(self, msg: Message) -> None:
+        self._stats_by_type[msg.type.value] = max(
+            0, self._stats_by_type.get(msg.type.value, 0) - 1
+        )
+        self._stats_by_status[msg.status.value] = max(
+            0, self._stats_by_status.get(msg.status.value, 0) - 1
+        )
+        sender = self._stats_by_agent.get(msg.sender_id)
+        if sender is not None:
+            sender["sent"] = max(0, sender["sent"] - 1)
+        if msg.receiver_id is not None:
+            recv = self._stats_by_agent.get(msg.receiver_id)
+            if recv is not None:
+                recv["received"] = max(0, recv["received"] - 1)
+
+    def _rebuild_stats(self) -> None:
+        self._stats_by_type = {}
+        self._stats_by_status = {}
+        self._stats_by_agent = {}
+        for m in self.messages.values():
+            self._stats_record_new(m)
+
+    def get_stats(self) -> Dict[str, Any]:
+        """Totals by type/status/agent (reference ` main.py:973-1024`) — O(1)
+        from incrementally maintained counters."""
+        with self._lock:
+            return {
+                "total_messages": len(self.messages),
+                "message_count": self.message_count,
+                "registered_agents": len(self.registered_agents),
+                "messages_by_type": dict(self._stats_by_type),
+                "messages_by_status": dict(self._stats_by_status),
+                "messages_by_agent": {a: dict(c) for a, c in self._stats_by_agent.items()},
+                "metrics": self.metrics.snapshot(),
+            }
+
+    def get_unread_message_count(self, agent_id: str) -> int:
+        """Unread = DELIVERED-status inbox entries (reference ` main.py:1026-1047`)."""
+        with self._lock:
+            return sum(
+                1
+                for m in self.agent_inbox.get(agent_id, [])
+                if m.status == MessageStatus.DELIVERED
+            )
+
+    def get_agent_load(self, agent_id: str) -> Dict[str, Any]:
+        """Inbox size, unread count, msgs/sec over trailing 60 s
+        (reference ` main.py:1049-1094`)."""
+        with self._lock:
+            return {
+                "agent_id": agent_id,
+                "inbox_size": len(self.agent_inbox.get(agent_id, [])),
+                "unread_count": self.get_unread_message_count(agent_id),
+                "messages_per_second": self.metrics.rates[f"agent_recv:{agent_id}"].rate(),
+            }
+
+    # ------------------------------------------------------- LLM load balancer
+
+    def set_llm_load_balancing(self, enabled: bool) -> None:
+        """Toggle (reference ` main.py:1281-1291`)."""
+        with self._lock:
+            self.llm_load_balancing_enabled = bool(enabled)
+
+    def assign_llm_backend(self, agent_id: str, backend_id: str) -> None:
+        """Agent → backend assignment (reference ` main.py:1293-1311`).
+        In the TPU build this is the routing table the ``TPUBackend``
+        consumers act on (the reference only stores it)."""
+        with self._lock:
+            self.metadata["llm_backends"][agent_id] = backend_id
+
+    def get_llm_backend(self, agent_id: str) -> Optional[str]:
+        """Reference ` main.py:1313-1325`."""
+        with self._lock:
+            return self.metadata["llm_backends"].get(agent_id)
+
+    def agents_for_backend(self, backend_id: str) -> List[str]:
+        """Inverse lookup used by TPUBackend consumers (no ref counterpart)."""
+        with self._lock:
+            return [
+                a for a, b in self.metadata["llm_backends"].items() if b == backend_id
+            ]
+
+    # -------------------------------------------------------------- autoscale
+
+    def auto_scale_partitions(self) -> int:
+        """Grow partitions to ``max(3, ceil(agents/10)*3)`` — never shrink
+        (reference ` main.py:1327-1365`). Returns the (possibly new) count.
+
+        In the TPU build, partition count is the data-parallel width, so
+        growth here is also a signal to widen the serving mesh's data axis.
+        """
+        import math
+
+        with self._lock:
+            n_agents = len(self.registered_agents)
+        recommended = max(3, math.ceil(n_agents / 10) * 3)
+        current = self.broker.list_topics()[self.topic_name].num_partitions
+        if recommended > current:
+            # Snapshot pre-growth end offsets BEFORE widening: a send racing
+            # between create_partitions and consumer re-pinning must not be
+            # skipped, and pre-growth history must not be replayed.
+            self._prescale_ends = {
+                p: self.broker.end_offset(self.topic_name, p) for p in range(current)
+            }
+            self._prescale_ends.update({p: 0 for p in range(current, recommended)})
+            self.broker.create_partitions(self.topic_name, recommended)
+            self._reassign_consumers()
+            logger.info("scaled partitions %d -> %d", current, recommended)
+            return recommended
+        return current
+
+    # --------------------------------------------------------------- shutdown
+
+    def close(self) -> None:
+        """Autosave, close consumers, flush producer (reference ` main.py:1367-1394`)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.save_message_history()
+        except Exception:
+            logger.exception("final autosave failed")
+        with self._lock:
+            consumers = list(self.consumers.values())
+        for c in consumers:
+            c.close()
+        self.producer.flush()
+        self.broker.close()
+
+    def __enter__(self) -> "SwarmDB":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+# Reference-compatible alias (` main.py:130`): existing SwarmDB users import
+# `SwarmsDB`.
+SwarmsDB = SwarmDB

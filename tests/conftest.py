@@ -1,0 +1,26 @@
+"""Test harness config.
+
+Forces JAX onto 8 virtual CPU devices (standard trick, SURVEY §4) so
+Mesh/pjit/shard_map tests exercise real multi-device semantics with no TPU.
+Must run before any test module imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_swarm(tmp_path):
+    """A SwarmDB over a fresh LocalBroker with save_dir in tmp."""
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.core.runtime import SwarmDB
+
+    db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "history"))
+    yield db
+    db.close()

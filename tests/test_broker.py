@@ -1,0 +1,199 @@
+"""Broker transport tests — run against every available implementation
+(LocalBroker always; NativeBroker when the C++ library is built)."""
+
+import threading
+import time
+
+import pytest
+
+from swarmdb_tpu.broker.base import Consumer, Producer, UnknownTopicError
+from swarmdb_tpu.broker.local import LocalBroker
+from swarmdb_tpu.utils.hashing import fnv1a64, stable_partition
+
+
+def _impls():
+    impls = [("local", lambda tmp: LocalBroker())]
+    try:
+        from swarmdb_tpu.broker.native import NativeBroker, native_available
+
+        if native_available():
+            impls.append(("native", lambda tmp: NativeBroker(log_dir=str(tmp))))
+    except ImportError:
+        pass
+    return impls
+
+
+@pytest.fixture(params=[name for name, _ in _impls()])
+def broker(request, tmp_path):
+    factory = dict(_impls())[request.param]
+    b = factory(tmp_path)
+    yield b
+    b.close()
+
+
+def test_create_and_list_topics(broker):
+    assert broker.create_topic("t", 3)
+    assert not broker.create_topic("t", 3)  # already exists
+    meta = broker.list_topics()["t"]
+    assert meta.num_partitions == 3
+
+
+def test_append_fetch_offsets(broker):
+    broker.create_topic("t", 2)
+    o0 = broker.append("t", 0, b"a")
+    o1 = broker.append("t", 0, b"b")
+    assert (o0, o1) == (0, 1)
+    recs = broker.fetch("t", 0, 0, 10)
+    assert [r.value for r in recs] == [b"a", b"b"]
+    assert broker.end_offset("t", 0) == 2
+    assert broker.end_offset("t", 1) == 0
+    assert broker.fetch("t", 0, 2) == []
+
+
+def test_unknown_topic(broker):
+    with pytest.raises(UnknownTopicError):
+        broker.append("nope", 0, b"x")
+
+
+def test_partition_growth(broker):
+    broker.create_topic("t", 2)
+    broker.create_partitions("t", 5)
+    assert broker.list_topics()["t"].num_partitions == 5
+    broker.create_partitions("t", 3)  # shrink is a no-op
+    assert broker.list_topics()["t"].num_partitions == 5
+    broker.append("t", 4, b"x")
+    assert broker.end_offset("t", 4) == 1
+
+
+def test_committed_offsets(broker):
+    broker.create_topic("t", 1)
+    assert broker.committed_offset("g", "t", 0) is None
+    broker.commit_offset("g", "t", 0, 7)
+    assert broker.committed_offset("g", "t", 0) == 7
+
+
+def test_retention_trim(broker):
+    broker.create_topic("t", 1)
+    now = time.time()
+    broker.append("t", 0, b"old", timestamp=now - 100)
+    broker.append("t", 0, b"new", timestamp=now)
+    dropped = broker.trim_older_than("t", now - 50)
+    assert dropped == 1
+    assert broker.begin_offset("t", 0) == 1
+    recs = broker.fetch("t", 0, 0)
+    assert [r.value for r in recs] == [b"new"]
+    assert recs[0].offset == 1  # offsets are stable across trims
+
+
+def test_producer_delivery_callback(broker):
+    broker.create_topic("t", 1)
+    p = Producer(broker)
+    reports = []
+    p.produce("t", b"v", key=b"k", partition=0,
+              on_delivery=lambda err, rec: reports.append((err, rec.offset)))
+    assert reports == []  # callbacks fire on poll, like rdkafka
+    assert p.poll(0) == 1
+    assert reports == [(None, 0)]
+
+
+def test_producer_failure_raises_synchronously(broker):
+    # Local errors raise (rdkafka contract); no callback fires.
+    p = Producer(broker)
+    reports = []
+    with pytest.raises(Exception):
+        p.produce("missing_topic", b"v", partition=0,
+                  on_delivery=lambda err, rec: reports.append(err))
+    assert p.poll(0) == 0 and reports == []
+
+
+def test_consumer_assign_poll(broker):
+    broker.create_topic("t", 2)
+    broker.append("t", 0, b"p0-a")
+    broker.append("t", 1, b"p1-a")
+    c = Consumer(broker, group_id="g")
+    c.assign([("t", 0)])
+    rec = c.poll(0.1)
+    assert rec.value == b"p0-a"
+    assert c.poll(0.05) is None  # partition-affine: never sees p1
+    c.close()
+
+
+def test_consumer_resumes_from_committed(broker):
+    broker.create_topic("t", 1)
+    for i in range(3):
+        broker.append("t", 0, f"m{i}".encode())
+    c1 = Consumer(broker, group_id="g")
+    c1.assign([("t", 0)])
+    assert c1.poll(0.1).value == b"m0"
+    c1.close()
+    c2 = Consumer(broker, group_id="g")
+    c2.assign([("t", 0)])
+    assert c2.poll(0.1).value == b"m1"  # resumed at committed offset
+    c2.close()
+
+
+def test_consumer_latest_reset(broker):
+    broker.create_topic("t", 1)
+    broker.append("t", 0, b"before")
+    c = Consumer(broker, group_id="g2", auto_offset_reset="latest")
+    c.assign([("t", 0)])
+    assert c.poll(0.05) is None
+    broker.append("t", 0, b"after")
+    assert c.poll(0.1).value == b"after"
+    c.close()
+
+
+def test_blocking_poll_wakes_on_append(broker):
+    broker.create_topic("t", 1)
+    c = Consumer(broker, group_id="g")
+    c.assign([("t", 0)])
+    got = []
+
+    def consume():
+        got.append(c.poll(2.0))
+
+    th = threading.Thread(target=consume)
+    th.start()
+    time.sleep(0.05)
+    broker.append("t", 0, b"wake")
+    th.join(timeout=3)
+    assert not th.is_alive()
+    assert got and got[0].value == b"wake"
+    c.close()
+
+
+def test_stable_hash_deterministic():
+    # defect D6 fix: must be stable across processes — pin exact values.
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert stable_partition("agent-1", 3) == fnv1a64(b"agent-1") % 3
+    assert stable_partition("agent-1", 3) == stable_partition("agent-1", 3)
+    with pytest.raises(ValueError):
+        stable_partition("x", 0)
+
+
+def test_local_snapshot_restore(tmp_path):
+    path = str(tmp_path / "snap.json")
+    b = LocalBroker(snapshot_path=path)
+    b.create_topic("t", 2)
+    b.append("t", 1, b"hello", key=b"k")
+    b.commit_offset("g", "t", 1, 1)
+    b.flush()
+    b2 = LocalBroker(snapshot_path=path)
+    recs = b2.fetch("t", 1, 0)
+    assert [r.value for r in recs] == [b"hello"]
+    assert recs[0].key == b"k"
+    assert b2.committed_offset("g", "t", 1) == 1
+
+
+def test_snapshot_binary_safe(tmp_path):
+    # Review finding: binary keys/values must survive snapshot round-trip.
+    path = str(tmp_path / "snap.json")
+    b = LocalBroker(snapshot_path=path)
+    b.create_topic("t", 1)
+    blob = bytes(range(256))
+    b.append("t", 0, blob, key=b"\xff\xfe\x00key")
+    b.flush()
+    b2 = LocalBroker(snapshot_path=path)
+    rec = b2.fetch("t", 0, 0)[0]
+    assert rec.value == blob
+    assert rec.key == b"\xff\xfe\x00key"

@@ -1,0 +1,78 @@
+"""Data-model tests (reference surface ` main.py:23-127`)."""
+
+import json
+import time
+
+from swarmdb_tpu import (
+    BrokerConfig,
+    KafkaConfig,
+    Message,
+    MessagePriority,
+    MessageStatus,
+    MessageType,
+)
+
+
+def test_message_defaults():
+    m = Message(sender_id="a", receiver_id="b", content="hi")
+    assert m.type == MessageType.CHAT
+    assert m.priority == MessagePriority.NORMAL
+    assert m.status == MessageStatus.PENDING
+    assert m.receiver_id == "b"
+    assert isinstance(m.timestamp, float)
+    assert m.id  # uuid4 assigned
+    assert m.visible_to == []
+    assert m.token_count is None
+
+
+def test_to_dict_roundtrip_json_safe():
+    # Reference defect D2: to_dict crashed; ours must be json.dumps-able.
+    m = Message(
+        sender_id="a",
+        receiver_id=None,
+        content={"nested": [1, 2, {"x": "y"}]},
+        type=MessageType.FUNCTION_CALL,
+        priority=MessagePriority.CRITICAL,
+        metadata={"k": "v"},
+        visible_to=["b", "c"],
+    )
+    d = m.to_dict()
+    payload = json.dumps(d)  # must not raise
+    back = Message.from_dict(json.loads(payload))
+    assert back == m
+
+
+def test_timestamp_coercion():
+    m = Message(sender_id="a", content="x", timestamp="123.5")
+    assert m.timestamp == 123.5
+    m2 = Message(sender_id="a", content="x", timestamp=7)
+    assert m2.timestamp == 7.0
+
+
+def test_enum_values_match_reference():
+    assert {t.value for t in MessageType} == {
+        "chat", "command", "function_call", "function_result",
+        "system", "error", "status",
+    }
+    assert [p.value for p in MessagePriority] == [0, 1, 2, 3]
+    assert {s.value for s in MessageStatus} == {
+        "pending", "delivered", "read", "processed", "failed",
+    }
+
+
+def test_broker_config_defaults_match_reference():
+    # ` main.py:114-127`
+    c = BrokerConfig()
+    assert c.num_partitions == 3
+    assert c.retention_ms == 7 * 24 * 60 * 60 * 1000
+    assert c.auto_offset_reset == "earliest"
+    assert c.consumer_timeout_ms == 1000
+    assert KafkaConfig is BrokerConfig
+
+
+def test_stage_stamp():
+    m = Message(sender_id="a", content="x")
+    m.stage_stamp("enqueued")
+    m.stage_stamp("first_token")
+    stages = m.metadata["stages"]
+    assert stages["first_token"] >= stages["enqueued"] <= time.time()

@@ -1,0 +1,388 @@
+"""Core runtime tests — the SwarmsDB capability surface (SURVEY §2.1)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from swarmdb_tpu import Message, MessagePriority, MessageStatus, MessageType
+from swarmdb_tpu.broker.local import LocalBroker
+from swarmdb_tpu.core.runtime import SwarmDB, SwarmsDB
+
+
+def test_alias():
+    assert SwarmsDB is SwarmDB
+
+
+def test_register_deregister(tmp_swarm):
+    db = tmp_swarm
+    assert db.register_agent("a", metadata={"role": "tester"})
+    assert not db.register_agent("a")  # idempotent
+    assert "a" in db.registered_agents
+    assert db.agent_metadata["a"]["role"] == "tester"
+    assert db.deregister_agent("a")
+    assert not db.deregister_agent("a")
+    assert "a" not in db.registered_agents
+
+
+def test_send_receive_unicast(tmp_swarm):
+    db = tmp_swarm
+    mid = db.send_message("alice", "bob", "hello bob")
+    msg = db.get_message(mid)
+    assert msg is not None
+    assert msg.status == MessageStatus.DELIVERED  # delivery callback fired
+    assert "partition" in msg.metadata
+
+    received = db.receive_messages("bob", max_messages=5, timeout=1.0)
+    assert [m.id for m in received] == [mid]
+    assert received[0].status == MessageStatus.READ
+    assert received[0].content == "hello bob"
+
+
+def test_receive_does_not_leak_other_agents_messages(tmp_swarm):
+    db = tmp_swarm
+    # two agents that may share a partition; each must only see its own
+    db.send_message("s", "r1", "for r1")
+    db.send_message("s", "r2", "for r2")
+    got1 = db.receive_messages("r1", timeout=0.5)
+    got2 = db.receive_messages("r2", timeout=0.5)
+    assert all(m.receiver_id == "r1" for m in got1) and len(got1) == 1
+    assert all(m.receiver_id == "r2" for m in got2) and len(got2) == 1
+
+
+def test_broadcast_visibility_and_exclusion(tmp_swarm):
+    db = tmp_swarm
+    for a in ("a", "b", "c", "d"):
+        db.register_agent(a)
+    mid = db.broadcast_message("a", "all hands", exclude_agents=["d"])
+    msg = db.get_message(mid)
+    assert msg.receiver_id is None
+    assert set(msg.visible_to) == {"b", "c"}
+    assert [m.id for m in db.receive_messages("b", timeout=0.5)] == [mid]
+    assert [m.id for m in db.receive_messages("c", timeout=0.5)] == [mid]
+    assert db.receive_messages("d", timeout=0.2) == []  # excluded
+    assert db.receive_messages("a", timeout=0.2) == []  # sender never gets own broadcast
+
+
+def test_send_auto_registers(tmp_swarm):
+    db = tmp_swarm
+    db.send_message("newbie", "other", "hi")
+    assert {"newbie", "other"} <= db.registered_agents
+
+
+def test_token_counting(tmp_path):
+    db = SwarmDB(
+        broker=LocalBroker(),
+        save_dir=str(tmp_path),
+        token_counter=lambda text: len(text.split()),
+    )
+    mid = db.send_message("a", "b", "one two three")
+    assert db.get_message(mid).token_count == 3
+    # structured content is JSON-serialized first (` main.py:295-307`)
+    mid2 = db.send_message("a", "b", {"k": "v"})
+    assert db.get_message(mid2).token_count == len(json.dumps({"k": "v"}).split())
+    db.close()
+
+
+def test_get_agent_messages_pagination(tmp_swarm):
+    db = tmp_swarm
+    ids = [db.send_message("s", "r", f"m{i}") for i in range(10)]
+    # newest-first
+    page = db.get_agent_messages("r", limit=3)
+    assert [m.id for m in page] == ids[-1:-4:-1]
+    page2 = db.get_agent_messages("r", limit=3, skip=3)
+    assert [m.id for m in page2] == ids[-4:-7:-1]
+    # status filter
+    db.mark_message_as_processed(ids[0])
+    done = db.get_agent_messages("r", status=MessageStatus.PROCESSED)
+    assert [m.id for m in done] == [ids[0]]
+
+
+def test_query_messages_filters(tmp_swarm):
+    db = tmp_swarm
+    t0 = time.time()
+    m1 = db.send_message("a", "b", "x", message_type=MessageType.CHAT)
+    m2 = db.send_message("b", "a", "y", message_type=MessageType.COMMAND)
+    m3 = db.send_message("a", "c", "z", message_type=MessageType.CHAT,
+                         priority=MessagePriority.HIGH)
+    assert {m.id for m in db.query_messages(sender_id="a")} == {m1, m3}
+    assert [m.id for m in db.query_messages(message_type=MessageType.COMMAND)] == [m2]
+    assert {m.id for m in db.query_messages(start_time=t0)} == {m1, m2, m3}
+    assert db.query_messages(end_time=t0 - 1) == []
+    assert len(db.query_messages(limit=2)) == 2
+
+
+def test_search_messages(tmp_swarm):
+    db = tmp_swarm
+    m1 = db.send_message("a", "b", "The Quick brown fox")
+    db.send_message("a", "b", "nothing here")
+    m3 = db.send_message("a", "b", {"tool": "quicksort"})
+    assert {m.id for m in db.search_messages("quick")} == {m1, m3}
+    assert [m.id for m in db.search_messages("Quick", case_sensitive=True)] == [m1]
+
+
+def test_conversation(tmp_swarm):
+    db = tmp_swarm
+    m1 = db.send_message("a", "b", "1")
+    m2 = db.send_message("b", "a", "2")
+    m3 = db.send_message("a", "b", "3")
+    db.send_message("a", "c", "unrelated")
+    convo = db.get_conversation("a", "b", limit=10)
+    assert [m.id for m in convo] == [m1, m2, m3]
+    assert convo == sorted(convo, key=lambda m: m.timestamp)
+
+
+def test_status_management_and_resend(tmp_swarm):
+    db = tmp_swarm
+    mid = db.send_message("a", "b", "x")
+    assert db.mark_message_as_processed(mid)
+    assert db.get_message(mid).status == MessageStatus.PROCESSED
+    assert not db.update_message_status("nope", MessageStatus.READ)
+
+    # simulate a failure then resend
+    db.update_message_status(mid, MessageStatus.FAILED)
+    new_ids = db.resend_failed_messages()
+    assert len(new_ids) == 1
+    resent = db.get_message(new_ids[0])
+    assert resent.metadata["resent_from"] == mid
+    assert db.get_message(mid).metadata["resent_to"] == new_ids[0]
+    # D10 fix: idempotent on repeat
+    assert db.resend_failed_messages() == []
+
+
+def test_groups(tmp_swarm):
+    db = tmp_swarm
+    db.add_agent_group("team", ["a", "b", "c"])
+    assert db.get_agent_group("team") == ["a", "b", "c"]
+    ids = db.send_to_group("a", "team", "standup")
+    assert len(ids) == 2  # sender skipped
+    receivers = {db.get_message(i).receiver_id for i in ids}
+    assert receivers == {"b", "c"}
+    assert all(db.get_message(i).metadata["group"] == "team" for i in ids)
+    with pytest.raises(KeyError):
+        db.send_to_group("a", "ghost", "x")
+
+
+def test_persistence_roundtrip(tmp_path):
+    b = LocalBroker()
+    db = SwarmDB(broker=b, save_dir=str(tmp_path / "h1"))
+    db.register_agent("a")
+    mid = db.send_message("a", "b", "persist me", metadata={"k": 1})
+    path = db.save_message_history()
+    assert os.path.exists(path)
+    db.close()
+
+    db2 = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "h2"))
+    n = db2.load_message_history(path)
+    assert n >= 1
+    msg = db2.get_message(mid)
+    assert msg.content == "persist me"
+    assert {"a", "b"} <= db2.registered_agents
+    assert mid in [m.id for m in db2.get_agent_messages("b")]
+    db2.close()
+
+
+def test_yaml_export(tmp_swarm):
+    db = tmp_swarm
+    db.send_message("a", "b", "to yaml")
+    path = db.export_as_yaml()
+    import yaml
+
+    with open(path) as f:
+        state = yaml.safe_load(f)
+    assert state["message_count"] == 1
+    assert len(state["messages"]) == 1
+
+
+def test_delete_and_flush_old(tmp_swarm):
+    db = tmp_swarm
+    mid = db.send_message("a", "b", "temp")
+    assert db.delete_message(mid)
+    assert not db.delete_message(mid)
+    assert db.get_agent_messages("b") == []
+
+    mid2 = db.send_message("a", "b", "old one")
+    db.get_message(mid2).timestamp = time.time() - 10 * 24 * 3600
+    flushed = db.flush_old_messages(max_age_seconds=7 * 24 * 3600)
+    assert flushed == 1
+    assert db.get_message(mid2) is None
+    archives = os.listdir(os.path.join(db.save_dir, "archives"))
+    assert len(archives) == 1
+
+
+def test_stats_and_load(tmp_swarm):
+    db = tmp_swarm
+    db.send_message("a", "b", "1")
+    db.send_message("a", "b", "2", message_type=MessageType.COMMAND)
+    db.send_message("b", "a", "3")
+    stats = db.get_stats()
+    assert stats["total_messages"] == 3
+    assert stats["messages_by_type"]["chat"] == 2
+    assert stats["messages_by_type"]["command"] == 1
+    assert stats["messages_by_agent"]["a"] == {"sent": 2, "received": 1}
+    assert stats["messages_by_status"]["delivered"] == 3
+
+    assert db.get_unread_message_count("b") == 2
+    db.receive_messages("b", timeout=0.5)
+    assert db.get_unread_message_count("b") == 0
+    load = db.get_agent_load("b")
+    assert load["inbox_size"] == 2
+    assert load["messages_per_second"] > 0
+
+
+def test_llm_backend_assignment(tmp_swarm):
+    db = tmp_swarm
+    db.set_llm_load_balancing(True)
+    assert db.llm_load_balancing_enabled
+    db.assign_llm_backend("agent1", "tpu-0")
+    db.assign_llm_backend("agent2", "tpu-0")
+    db.assign_llm_backend("agent3", "tpu-1")
+    assert db.get_llm_backend("agent1") == "tpu-0"
+    assert db.get_llm_backend("ghost") is None
+    assert sorted(db.agents_for_backend("tpu-0")) == ["agent1", "agent2"]
+
+
+def test_auto_scale_partitions(tmp_swarm):
+    db = tmp_swarm
+    assert db.auto_scale_partitions() == 3  # few agents → floor of 3
+    for i in range(35):
+        db.register_agent(f"agent{i}")
+    n = db.auto_scale_partitions()
+    assert n == 12  # ceil(35/10)*3
+    assert db.broker.list_topics()[db.topic_name].num_partitions == 12
+    # consumers re-pinned: routing still works after growth
+    mid = db.send_message("agent0", "agent1", "post-scale")
+    got = db.receive_messages("agent1", timeout=1.0)
+    assert mid in [m.id for m in got]
+
+
+def test_context_manager_and_final_save(tmp_path):
+    with SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path)) as db:
+        db.send_message("a", "b", "bye")
+    saves = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert saves  # close() autosaved
+
+
+def test_error_topic_receives_failed_sends(tmp_path):
+    class FlakyBroker(LocalBroker):
+        def __init__(self):
+            super().__init__()
+            self.fail_next = False
+
+        def append(self, topic, partition, value, key=None, timestamp=None):
+            if self.fail_next and topic != "swarm_messages_errors":
+                raise RuntimeError("injected broker failure")
+            return super().append(topic, partition, value, key, timestamp)
+
+    b = FlakyBroker()
+    db = SwarmDB(broker=b, save_dir=str(tmp_path))
+    db.register_agent("a")
+    db.register_agent("b")
+    b.fail_next = True
+    with pytest.raises(RuntimeError):
+        db.send_message("a", "b", "doomed")
+    b.fail_next = False
+    # message marked FAILED and a copy landed on the error topic
+    failed = db.query_messages(status=MessageStatus.FAILED)
+    assert len(failed) == 1
+    assert "error" in failed[0].metadata
+    assert b.end_offset("swarm_messages_errors", 0) == 1
+    db.close()
+
+
+def test_broadcast_exclude_all_delivers_to_nobody(tmp_swarm):
+    # Review finding: empty effective visible_to must not fall back to "all".
+    db = tmp_swarm
+    for a in ("a", "b", "c"):
+        db.register_agent(a)
+    mid = db.broadcast_message("a", "secret", exclude_agents=["b", "c"])
+    assert db.get_message(mid).visible_to == []
+    assert db.receive_messages("b", timeout=0.3) == []
+    assert db.receive_messages("c", timeout=0.3) == []
+    assert db.get_message(mid).status == MessageStatus.DELIVERED
+
+
+def test_scale_preserves_undelivered_and_no_broadcast_replay(tmp_swarm):
+    # Review finding: re-pinning on growth must drain old-partition backlog
+    # and must not replay already-consumed broadcast copies.
+    db = tmp_swarm
+    for i in range(5):
+        db.register_agent(f"agent{i}")
+    bid = db.broadcast_message("agent0", "pre-scale broadcast")
+    got_before = db.receive_messages("agent1", timeout=0.5)
+    assert bid in [m.id for m in got_before]
+    # undelivered unicast sitting in agent2's pre-scale partition
+    pending = db.send_message("agent0", "agent2", "pending across scale")
+    for i in range(5, 35):
+        db.register_agent(f"agent{i}")
+    db.auto_scale_partitions()
+    got2 = db.receive_messages("agent2", max_messages=50, timeout=1.0)
+    ids2 = [m.id for m in got2]
+    assert pending in ids2  # backlog drained from old partition
+    # agent1 must NOT see the pre-scale broadcast again
+    got1 = db.receive_messages("agent1", max_messages=50, timeout=0.5)
+    assert bid not in [m.id for m in got1]
+
+
+def test_stats_decrement_on_delete(tmp_swarm):
+    db = tmp_swarm
+    ids = [db.send_message("a", "b", f"m{i}") for i in range(3)]
+    for i in ids:
+        db.delete_message(i)
+    s = db.get_stats()
+    assert s["messages_by_agent"]["a"]["sent"] == 0
+    assert s["messages_by_agent"]["b"]["received"] == 0
+    assert s["messages_by_type"].get("chat", 0) == 0
+
+
+def test_snapshot_with_separator_chars_in_ids(tmp_path):
+    # Review finding: '|' in agent/group ids must survive snapshot round-trip.
+    path = str(tmp_path / "snap.json")
+    b = LocalBroker(snapshot_path=path)
+    db = SwarmDB(broker=b, save_dir=str(tmp_path / "h"))
+    mid = db.send_message("team|alpha", "user|beta", "pipes everywhere")
+    db.receive_messages("user|beta", timeout=0.5)
+    b.flush()
+    b2 = LocalBroker(snapshot_path=path)  # must not crash on restore
+    assert b2.committed_offset(
+        f"{db.config.group_id}_user|beta", db.topic_name,
+        db._get_partition("user|beta")) is not None
+    db.close()
+
+
+def test_broadcast_no_duplicate_after_scale(tmp_swarm):
+    # Review finding: multi-partition consumers must dedup broadcast copies.
+    db = tmp_swarm
+    for i in range(35):
+        db.register_agent(f"agent{i}")
+    db.auto_scale_partitions()  # consumers now hold old+new partitions
+    bid = db.broadcast_message("agent0", "once please")
+    got = db.receive_messages("agent1", max_messages=50, timeout=1.0)
+    assert [m.id for m in got].count(bid) == 1
+
+
+def test_conversation_limit_one(tmp_swarm):
+    db = tmp_swarm
+    db.send_message("a", "b", "first")
+    m2 = db.send_message("b", "a", "second")
+    convo = db.get_conversation("a", "b", limit=1)
+    assert [m.id for m in convo] == [m2]  # newest, not empty
+    assert db.get_conversation("a", "b", limit=0) == []
+
+
+def test_late_registration_does_not_scan_history(tmp_path):
+    # Fresh consumers start at partition end: a new agent's first receive
+    # must not churn through other agents' backlog.
+    b = LocalBroker()
+    db = SwarmDB(broker=b, save_dir=str(tmp_path))
+    for i in range(50):
+        db.send_message("s", "r", f"backlog {i}")
+    t0 = time.time()
+    got = db.receive_messages("newcomer", max_messages=10, timeout=5.0)
+    assert got == []
+    # messages TO the newcomer still arrive (registered before produce)
+    mid = db.send_message("s", "newcomer", "fresh")
+    got = db.receive_messages("newcomer", timeout=1.0)
+    assert [m.id for m in got] == [mid]
+    db.close()
