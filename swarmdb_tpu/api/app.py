@@ -1,0 +1,593 @@
+"""HTTP API server — the reference's wire surface on aiohttp.
+
+Route-for-route parity with the reference FastAPI app (`api.py:365-935`,
+table in SURVEY §2.5): same paths, methods, payload schemas, auth rules
+(JWT HS256, ``sub`` = agent id, username ``admin`` = superuser), per-IP
+sliding-window rate limiting, CORS, and env-var config names. FastAPI is
+not in this image, so the server is aiohttp; schemas stay pydantic so the
+wire contract is identical.
+
+Fixed reference defects: D3 (response models match actual payloads), D4
+(no ``status`` name shadowing — we return explicit HTTP codes).
+
+TPU extension (north star): ``POST /messages`` and ``POST /groups/message``
+accept ``stream: true`` and reply with SSE. With a serving engine attached
+(``create_app(serving=...)``) the events are LLM decode tokens streamed off
+the TPU; without one, the message lifecycle events stream instead.
+
+Blocking SwarmDB calls run in the default executor so consumer polls never
+stall the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from ..core.messages import MessageStatus
+from ..core.runtime import SwarmDB
+from ..utils import jwt as jwt_util
+from . import schemas
+
+logger = logging.getLogger("swarmdb_tpu.api")
+
+ADMIN_USERNAME = "admin"  # reference: "admin" is the authorization superuser
+
+DB_KEY: web.AppKey = web.AppKey("db", object)
+CONFIG_KEY: web.AppKey = web.AppKey("config", object)
+SERVING_KEY: web.AppKey = web.AppKey("serving", object)
+
+
+@dataclass
+class ApiConfig:
+    """Env-var backed config; names match the reference catalog
+    (`README.md:78-100`, `api.py:38-74`)."""
+
+    jwt_secret_key: str = "change-me-in-production"
+    token_expire_minutes: float = 30.0
+    rate_limit_per_minute: int = 300
+    cors_origins: str = "*"
+    host: str = "0.0.0.0"
+    port: int = 8000
+    # If set, the "admin" username requires this password. The reference
+    # accepts ANY non-empty credentials (`api.py:373-374`) which makes every
+    # authorization check moot; unset keeps that demo parity but logs loudly.
+    admin_password: Optional[str] = None
+
+    @classmethod
+    def from_env(cls) -> "ApiConfig":
+        import os
+
+        return cls(
+            jwt_secret_key=os.environ.get("JWT_SECRET_KEY", "change-me-in-production"),
+            token_expire_minutes=float(os.environ.get("TOKEN_EXPIRE_MINUTES", "30")),
+            rate_limit_per_minute=int(os.environ.get("RATE_LIMIT_PER_MINUTE", "300")),
+            cors_origins=os.environ.get("CORS_ORIGINS", "*"),
+            host=os.environ.get("API_HOST", "0.0.0.0"),
+            port=int(os.environ.get("API_PORT", "8000")),
+            admin_password=os.environ.get("ADMIN_PASSWORD") or None,
+        )
+
+    def allowed_origin(self, request_origin: Optional[str]) -> str:
+        """Resolve the Access-Control-Allow-Origin value for one request.
+        CORS_ORIGINS may be '*' or a comma-separated allowlist; a list must
+        be echoed back one-origin-at-a-time, never as the raw joined string
+        (browsers reject a comma-joined header)."""
+        if self.cors_origins.strip() == "*":
+            return "*"
+        allowed = {o.strip() for o in self.cors_origins.split(",") if o.strip()}
+        if request_origin and request_origin in allowed:
+            return request_origin
+        return next(iter(sorted(allowed)), "*")
+
+
+def _error(status_code: int, detail: str) -> web.HTTPException:
+    exc_cls = {
+        400: web.HTTPBadRequest,
+        401: web.HTTPUnauthorized,
+        403: web.HTTPForbidden,
+        404: web.HTTPNotFound,
+        422: web.HTTPUnprocessableEntity,
+        429: web.HTTPTooManyRequests,
+        503: web.HTTPServiceUnavailable,
+    }.get(status_code, web.HTTPInternalServerError)
+    return exc_cls(
+        text=json.dumps({"detail": detail}), content_type="application/json"
+    )
+
+
+async def _run_sync(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+    loop = asyncio.get_running_loop()
+    if kwargs:
+        import functools
+
+        fn = functools.partial(fn, **kwargs)
+    return await loop.run_in_executor(None, fn, *args)
+
+
+async def _parse(request: web.Request, model: type) -> Any:
+    try:
+        body = await request.json()
+    except Exception:
+        raise _error(400, "invalid JSON body")
+    try:
+        return model.model_validate(body)
+    except ValidationError as exc:
+        raise _error(422, exc.json())
+
+
+def _json(model_or_dict: Any, status_code: int = 200) -> web.Response:
+    if hasattr(model_or_dict, "model_dump"):
+        body = model_or_dict.model_dump(mode="json")
+    else:
+        body = model_or_dict
+    return web.json_response(body, status=status_code)
+
+
+class RateLimiter:
+    """Per-IP sliding 60 s window (reference `RateLimiter`, `api.py:266-314`).
+    asyncio-single-threaded, so no lock needed."""
+
+    def __init__(self, limit_per_minute: int) -> None:
+        self.limit = limit_per_minute
+        self._windows: Dict[str, deque] = {}
+        self._ops = 0
+
+    def check(self, ip: str) -> bool:
+        now = time.time()
+        self._ops += 1
+        if self._ops % 4096 == 0:
+            # bound memory across IP churn: drop windows that fell idle
+            self._windows = {
+                k: w for k, w in self._windows.items() if w and w[-1] >= now - 60.0
+            }
+        win = self._windows.setdefault(ip, deque())
+        while win and win[0] < now - 60.0:
+            win.popleft()
+        if len(win) >= self.limit:
+            return False
+        win.append(now)
+        return True
+
+
+def create_app(
+    db: SwarmDB,
+    config: Optional[ApiConfig] = None,
+    serving: Optional[Any] = None,
+) -> web.Application:
+    """Build the application. ``serving`` is an optional
+    :class:`~swarmdb_tpu.backend.service.ServingService` that turns
+    LLM-addressed messages into streamed replies."""
+    cfg = config or ApiConfig()
+    limiter = RateLimiter(cfg.rate_limit_per_minute)
+    if cfg.admin_password is None:
+        logger.warning(
+            "ADMIN_PASSWORD not set: any client can obtain an admin token "
+            "(reference demo parity, api.py:373-374). Set it in production."
+        )
+
+    # ---------------------------------------------------------------- auth
+
+    def current_agent(request: web.Request) -> str:
+        """Bearer-token dependency (reference `get_current_agent`,
+        `api.py:337-361`)."""
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            raise _error(401, "missing bearer token")
+        try:
+            claims = jwt_util.decode(auth[len("Bearer "):], cfg.jwt_secret_key)
+        except jwt_util.ExpiredTokenError:
+            raise _error(401, "token expired")
+        except jwt_util.JWTError as exc:
+            raise _error(401, f"invalid token: {exc}")
+        sub = claims.get("sub")
+        if not sub:
+            raise _error(401, "token missing subject")
+        return sub
+
+    def require_admin(agent: str) -> None:
+        if agent != ADMIN_USERNAME:
+            raise _error(403, "admin privileges required")
+
+    # ---------------------------------------------------------- middlewares
+
+    @web.middleware
+    async def middleware(request: web.Request, handler: Any) -> web.StreamResponse:
+        # CORS preflight
+        if request.method == "OPTIONS":
+            resp: web.StreamResponse = web.Response(status=204)
+        else:
+            # rate limit everything except health (reference exempts nothing,
+            # but probing liveness through a 429 defeats the healthcheck)
+            if request.path != "/health":
+                ip = request.remote or "unknown"
+                if not limiter.check(ip):
+                    resp = web.json_response(
+                        {"detail": "rate limit exceeded"}, status=429
+                    )
+                    _add_cors(resp, request.headers.get("Origin"))
+                    return resp
+            try:
+                resp = await handler(request)
+            except web.HTTPException as exc:
+                # convert to a plain response (returning the exception object
+                # is deprecated in aiohttp)
+                resp = web.Response(
+                    status=exc.status, text=exc.text,
+                    content_type=exc.content_type or "application/json",
+                )
+        _add_cors(resp, request.headers.get("Origin"))
+        return resp
+
+    def _add_cors(resp: web.StreamResponse, origin: Optional[str] = None) -> None:
+        resp.headers["Access-Control-Allow-Origin"] = cfg.allowed_origin(origin)
+        resp.headers["Access-Control-Allow-Methods"] = "GET, POST, PUT, DELETE, OPTIONS"
+        resp.headers["Access-Control-Allow-Headers"] = "Authorization, Content-Type"
+
+    # -------------------------------------------------------------- handlers
+
+    async def auth_token(request: web.Request) -> web.Response:
+        """POST /auth/token (reference `api.py:365-388`): demo-grade — any
+        non-empty username/password is accepted; sub = username."""
+        creds = await _parse(request, schemas.UserCredentials)
+        if not creds.username or not creds.password:
+            raise _error(401, "empty credentials")
+        if (
+            creds.username == ADMIN_USERNAME
+            and cfg.admin_password is not None
+            and creds.password != cfg.admin_password
+        ):
+            raise _error(401, "invalid admin credentials")
+        token = jwt_util.create_access_token(
+            creds.username, cfg.jwt_secret_key, cfg.token_expire_minutes
+        )
+        return _json(schemas.Token(access_token=token))
+
+    async def register_agent(request: web.Request) -> web.Response:
+        """POST /agents/register (reference `api.py:391-437`): self or admin."""
+        agent = current_agent(request)
+        req = await _parse(request, schemas.AgentRegistrationRequest)
+        if agent != ADMIN_USERNAME and agent != req.agent_id:
+            raise _error(403, "can only register yourself (or be admin)")
+        meta = {
+            "description": req.description,
+            "capabilities": req.capabilities,
+            **req.metadata,
+        }
+        created = await _run_sync(db.register_agent, req.agent_id, meta)
+        return _json(
+            {"status": "registered" if created else "already_registered",
+             "agent_id": req.agent_id}
+        )
+
+    async def deregister_agent(request: web.Request) -> web.Response:
+        """DELETE /agents/{agent_id} (reference `api.py:440-469`)."""
+        agent = current_agent(request)
+        target = request.match_info["agent_id"]
+        if agent != ADMIN_USERNAME and agent != target:
+            raise _error(403, "can only deregister yourself (or be admin)")
+        removed = await _run_sync(db.deregister_agent, target)
+        if not removed:
+            raise _error(404, f"agent {target} not registered")
+        return _json({"status": "deregistered", "agent_id": target})
+
+    async def send_message(request: web.Request) -> web.StreamResponse:
+        """POST /messages (reference `api.py:472-504`): sender is the token
+        subject. With ``stream: true`` replies over SSE (TPU extension)."""
+        agent = current_agent(request)
+        req = await _parse(request, schemas.MessageRequest)
+        msg_id = await _run_sync(
+            db.send_message,
+            agent,
+            req.receiver_id,
+            req.content,
+            message_type=req.message_type,
+            priority=req.priority,
+            metadata=req.metadata,
+        )
+        if req.stream:
+            return await _stream_reply(request, msg_id)
+        msg = await _run_sync(db.get_message, msg_id)
+        if msg is None:
+            raise _error(404, "message vanished after send")
+        return _json(schemas.MessageResponse.from_message(msg))
+
+    async def broadcast(request: web.Request) -> web.Response:
+        """POST /messages/broadcast (reference `api.py:507-536`; returns the
+        dict the reference actually produced — defect D3 fixed by declaring
+        it)."""
+        agent = current_agent(request)
+        req = await _parse(request, schemas.BroadcastRequest)
+        msg_id = await _run_sync(
+            db.broadcast_message,
+            agent,
+            req.content,
+            message_type=req.message_type,
+            priority=req.priority,
+            metadata=req.metadata,
+            exclude_agents=req.exclude_agents,
+        )
+        return _json(schemas.BroadcastResponse(status="broadcast", message_id=msg_id))
+
+    async def get_message(request: web.Request) -> web.Response:
+        """GET /messages/{message_id} (reference `api.py:539-568`):
+        admin/sender/receiver/visible_to only."""
+        agent = current_agent(request)
+        msg = await _run_sync(db.get_message, request.match_info["message_id"])
+        if msg is None:
+            raise _error(404, "message not found")
+        allowed = (
+            agent == ADMIN_USERNAME
+            or agent == msg.sender_id
+            or agent == msg.receiver_id
+            or agent in msg.visible_to
+        )
+        if not allowed:
+            raise _error(403, "not authorized to view this message")
+        return _json(schemas.MessageResponse.from_message(msg))
+
+    async def query_messages(request: web.Request) -> web.Response:
+        """GET /messages (reference `api.py:571-621`): non-admin restricted
+        to own traffic. (Reference defect D4 — `status` shadowing — does not
+        arise: codes are explicit.)"""
+        agent = current_agent(request)
+        q = request.query
+        sender = q.get("sender_id")
+        receiver = q.get("receiver_id")
+        if agent != ADMIN_USERNAME:
+            # restrict to own traffic: force one side to be the caller
+            if sender is None and receiver is None:
+                sender, receiver = None, None  # filtered below
+            elif agent not in (sender, receiver):
+                raise _error(403, "non-admin may only query own messages")
+        try:
+            msgs = await _run_sync(
+                db.query_messages,
+                sender_id=sender,
+                receiver_id=receiver,
+                message_type=q.get("message_type"),
+                status=q.get("status"),
+                start_time=float(q["start_time"]) if "start_time" in q else None,
+                end_time=float(q["end_time"]) if "end_time" in q else None,
+                limit=int(q.get("limit", "100")),
+            )
+        except ValueError as exc:
+            raise _error(422, str(exc))
+        if agent != ADMIN_USERNAME and sender is None and receiver is None:
+            msgs = [
+                m for m in msgs
+                if agent in (m.sender_id, m.receiver_id) or agent in m.visible_to
+            ]
+        return _json([schemas.MessageResponse.from_message(m).model_dump(mode="json")
+                      for m in msgs])
+
+    async def agent_messages(request: web.Request) -> web.Response:
+        """GET /agents/{agent_id}/messages (reference `api.py:624-664`)."""
+        agent = current_agent(request)
+        target = request.match_info["agent_id"]
+        if agent != ADMIN_USERNAME and agent != target:
+            raise _error(403, "can only read your own inbox (or be admin)")
+        q = request.query
+        try:
+            msgs = await _run_sync(
+                db.get_agent_messages,
+                target,
+                status=q.get("status"),
+                limit=int(q.get("limit", "100")),
+                skip=int(q.get("skip", "0")),
+            )
+        except ValueError as exc:
+            raise _error(422, str(exc))
+        return _json([schemas.MessageResponse.from_message(m).model_dump(mode="json")
+                      for m in msgs])
+
+    async def receive(request: web.Request) -> web.Response:
+        """POST /agents/receive (reference `api.py:667-688`): broker poll for
+        the calling agent."""
+        agent = current_agent(request)
+        req = await _parse(request, schemas.ReceiveRequest)
+        msgs = await _run_sync(
+            db.receive_messages, agent,
+            max_messages=req.max_messages, timeout=req.timeout,
+        )
+        return _json([schemas.MessageResponse.from_message(m).model_dump(mode="json")
+                      for m in msgs])
+
+    async def update_status(request: web.Request) -> web.Response:
+        """PUT /messages/{message_id}/status (reference `api.py:691-733`):
+        admin or receiver; PROCESSED goes through the dedicated method."""
+        agent = current_agent(request)
+        msg = await _run_sync(db.get_message, request.match_info["message_id"])
+        if msg is None:
+            raise _error(404, "message not found")
+        if agent != ADMIN_USERNAME and agent != msg.receiver_id:
+            raise _error(403, "only the receiver (or admin) may update status")
+        req = await _parse(request, schemas.StatusUpdateRequest)
+        if req.status == MessageStatus.PROCESSED:
+            ok = await _run_sync(db.mark_message_as_processed, msg.id)
+        else:
+            ok = await _run_sync(db.update_message_status, msg.id, req.status)
+        if not ok:
+            raise _error(404, "message vanished during update")
+        return _json({"status": "updated", "message_id": msg.id,
+                      "new_status": req.status.value})
+
+    async def create_group(request: web.Request) -> web.Response:
+        """POST /groups (reference `api.py:736-757`)."""
+        current_agent(request)
+        req = await _parse(request, schemas.AgentGroupRequest)
+        if not req.agent_ids:
+            raise _error(422, "agent_ids must be non-empty")
+        await _run_sync(db.add_agent_group, req.group_name, req.agent_ids)
+        return _json({"status": "created", "group_name": req.group_name,
+                      "agent_ids": req.agent_ids})
+
+    async def group_message(request: web.Request) -> web.StreamResponse:
+        """POST /groups/message (reference `api.py:760-787`; D3 fixed).
+        With ``stream: true``, SSE-streams the fan-out replies."""
+        agent = current_agent(request)
+        req = await _parse(request, schemas.GroupMessageRequest)
+        try:
+            ids = await _run_sync(
+                db.send_to_group, agent, req.group_name, req.content,
+                message_type=req.message_type, priority=req.priority,
+                metadata=req.metadata,
+            )
+        except KeyError:
+            raise _error(404, f"group {req.group_name} not found")
+        if req.stream:
+            return await _stream_group(request, ids)
+        return _json(schemas.GroupMessageResponse(
+            status="sent", group_name=req.group_name, message_ids=ids))
+
+    async def health(request: web.Request) -> web.Response:
+        """GET /health (reference `api.py:790-815`): live broker probe."""
+        ok = await _run_sync(db.broker.healthy)
+        tpu_state = None
+        if serving is not None and hasattr(serving, "health"):
+            try:
+                tpu_state = await _run_sync(serving.health)
+            except Exception as exc:
+                tpu_state = {"status": "error", "error": str(exc)}
+        resp = schemas.HealthResponse(
+            status="healthy" if ok else "degraded",
+            broker_connected=ok,
+            tpu=tpu_state,
+        )
+        return _json(resp, 200 if ok else 503)
+
+    async def stats(request: web.Request) -> web.Response:
+        """GET /stats (reference `api.py:818-838`): admin only."""
+        agent = current_agent(request)
+        require_admin(agent)
+        return _json(schemas.SystemStats(**await _run_sync(db.get_stats)))
+
+    async def admin_save(request: web.Request) -> web.Response:
+        """POST /admin/save (reference `api.py:841-861`)."""
+        require_admin(current_agent(request))
+        path = await _run_sync(db.save_message_history)
+        return _json({"status": "saved", "filepath": path})
+
+    async def admin_flush(request: web.Request) -> web.Response:
+        """POST /admin/flush (reference `api.py:864-885`)."""
+        require_admin(current_agent(request))
+        q = request.query
+        try:
+            max_age = float(q.get("max_age_seconds", str(7 * 24 * 3600)))
+        except ValueError as exc:
+            raise _error(422, f"bad max_age_seconds: {exc}")
+        n = await _run_sync(db.flush_old_messages, max_age)
+        return _json({"status": "flushed", "archived_count": n})
+
+    async def admin_resend(request: web.Request) -> web.Response:
+        """POST /admin/resend_failed (reference `api.py:888-912`)."""
+        require_admin(current_agent(request))
+        ids = await _run_sync(db.resend_failed_messages)
+        return _json({"status": "resent", "message_ids": ids})
+
+    async def admin_scale(request: web.Request) -> web.Response:
+        """POST /admin/scale_partitions (reference `api.py:915-935`)."""
+        require_admin(current_agent(request))
+        n = await _run_sync(db.auto_scale_partitions)
+        return _json({"status": "scaled", "num_partitions": n})
+
+    # ----------------------------------------------------------- SSE helpers
+
+    async def _sse_response(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        _add_cors(resp, request.headers.get("Origin"))
+        await resp.prepare(request)
+        return resp
+
+    async def _sse_event(resp: web.StreamResponse, event: str, data: Any) -> None:
+        payload = json.dumps(data) if not isinstance(data, str) else data
+        await resp.write(f"event: {event}\ndata: {payload}\n\n".encode())
+
+    async def _stream_reply(request: web.Request, msg_id: str) -> web.StreamResponse:
+        """SSE stream for one message: LLM decode tokens when a serving
+        engine is attached (north star), else the message lifecycle."""
+        resp = await _sse_response(request)
+        msg = await _run_sync(db.get_message, msg_id)
+        await _sse_event(resp, "message",
+                         schemas.MessageResponse.from_message(msg).model_dump(mode="json"))
+        if serving is not None:
+            try:
+                async for tok in serving.stream_reply(msg):
+                    await _sse_event(resp, "token", tok)
+                reply_id = msg.metadata.get("reply_id")
+                reply = await _run_sync(db.get_message, reply_id) if reply_id else None
+                if reply is not None:
+                    await _sse_event(
+                        resp, "reply",
+                        schemas.MessageResponse.from_message(reply).model_dump(mode="json"))
+            except Exception as exc:
+                await _sse_event(resp, "error", {"detail": str(exc)})
+        await _sse_event(resp, "done", {"message_id": msg_id})
+        await resp.write_eof()
+        return resp
+
+    async def _stream_group(request: web.Request, ids: list) -> web.StreamResponse:
+        resp = await _sse_response(request)
+        for mid in ids:
+            m = await _run_sync(db.get_message, mid)
+            await _sse_event(resp, "message",
+                             schemas.MessageResponse.from_message(m).model_dump(mode="json"))
+        if serving is not None:
+            try:
+                group_msgs = [await _run_sync(db.get_message, i) for i in ids]
+                async for item in serving.stream_group(group_msgs):
+                    await _sse_event(resp, item.get("event", "token"), item)
+            except Exception as exc:
+                await _sse_event(resp, "error", {"detail": str(exc)})
+        await _sse_event(resp, "done", {"message_ids": ids})
+        await resp.write_eof()
+        return resp
+
+    # ---------------------------------------------------------------- wiring
+
+    app = web.Application(middlewares=[middleware])
+    app[DB_KEY] = db
+    app[CONFIG_KEY] = cfg
+    app[SERVING_KEY] = serving
+    app.add_routes([
+        web.post("/auth/token", auth_token),
+        web.post("/agents/register", register_agent),
+        web.delete("/agents/{agent_id}", deregister_agent),
+        web.post("/messages", send_message),
+        web.post("/messages/broadcast", broadcast),
+        web.get("/messages/{message_id}", get_message),
+        web.get("/messages", query_messages),
+        web.get("/agents/{agent_id}/messages", agent_messages),
+        web.post("/agents/receive", receive),
+        web.put("/messages/{message_id}/status", update_status),
+        web.post("/groups", create_group),
+        web.post("/groups/message", group_message),
+        web.get("/health", health),
+        web.get("/stats", stats),
+        web.post("/admin/save", admin_save),
+        web.post("/admin/flush", admin_flush),
+        web.post("/admin/resend_failed", admin_resend),
+        web.post("/admin/scale_partitions", admin_scale),
+    ])
+
+    async def on_shutdown(app: web.Application) -> None:
+        # reference `shutdown_event` (`api.py:939-945`)
+        await _run_sync(db.close)
+
+    app.on_shutdown.append(on_shutdown)
+    return app

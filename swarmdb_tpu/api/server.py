@@ -1,0 +1,66 @@
+"""Server entry point: ``python -m swarmdb_tpu.api.server``.
+
+Builds SwarmDB + the aiohttp app from environment variables using the
+reference's env-var catalog (`README.md:78-100`, `api.py:38-74`,
+`gunicorn_config.py`): KAFKA_BOOTSTRAP_SERVERS, KAFKA_GROUP_ID,
+KAFKA_NUM_PARTITIONS, KAFKA_TOPIC, SAVE_DIR, AUTOSAVE_INTERVAL,
+JWT_SECRET_KEY, TOKEN_EXPIRE_MINUTES, RATE_LIMIT_PER_MINUTE, CORS_ORIGINS,
+API_HOST, API_PORT. Unlike the reference (one SwarmsDB per gunicorn worker,
+defect D7), this runs ONE process owning the broker; scale-out is via the
+serving mesh, not API-process replication.
+
+Optional TPU serving: set SERVE_MODEL (e.g. ``llama3-8b``, ``tiny-debug``)
+to attach a generation backend; agent->backend routing then drives real
+decode on device.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from aiohttp import web
+
+from ..core.messages import BrokerConfig
+from ..core.runtime import SwarmDB
+from .app import ApiConfig, create_app
+
+
+def build_db() -> SwarmDB:
+    cfg = BrokerConfig(
+        bootstrap_servers=os.environ.get("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
+        group_id=os.environ.get("KAFKA_GROUP_ID", "swarm_agents"),
+        num_partitions=int(os.environ.get("KAFKA_NUM_PARTITIONS", "3")),
+        log_dir=os.environ.get("BROKER_LOG_DIR") or None,
+        implementation=os.environ.get("BROKER_IMPL", "auto"),
+    )
+    return SwarmDB(
+        config=cfg,
+        topic_name=os.environ.get("KAFKA_TOPIC", "swarm_messages"),
+        save_dir=os.environ.get("SAVE_DIR", "message_history"),
+        autosave_interval=float(os.environ.get("AUTOSAVE_INTERVAL", "300")),
+    )
+
+
+def build_serving(db: SwarmDB):
+    model_name = os.environ.get("SERVE_MODEL")
+    if not model_name:
+        return None
+    from ..backend.service import ServingService
+
+    return ServingService.from_model_name(db, model_name)
+
+
+def main() -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    db = build_db()
+    serving = build_serving(db)
+    cfg = ApiConfig.from_env()
+    app = create_app(db, cfg, serving=serving)
+    if serving is not None:
+        serving.start()
+    web.run_app(app, host=cfg.host, port=cfg.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,408 @@
+"""Wire-API tests: the 18-route surface of SURVEY §2.5 over the aiohttp app.
+
+No pytest-asyncio in the image, so each test runs its coroutine via
+``asyncio.run`` through the ``api_drive`` helper.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from swarmdb_tpu.api.app import ApiConfig, create_app
+from swarmdb_tpu.broker.local import LocalBroker
+from swarmdb_tpu.core.runtime import SwarmDB
+
+CFG = ApiConfig(jwt_secret_key="test-secret", rate_limit_per_minute=10_000)
+
+
+def api_drive(coro_fn, tmp_path, config=CFG, serving=None):
+    """Spin up app+client, run coro_fn(client, db), tear down."""
+
+    async def runner():
+        db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "hist"))
+        app = create_app(db, config, serving=serving)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client, db)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+async def get_token(client, username="tester", password="pw"):
+    r = await client.post("/auth/token", json={"username": username, "password": password})
+    assert r.status == 200, await r.text()
+    data = await r.json()
+    assert data["token_type"] == "bearer"
+    return {"Authorization": f"Bearer {data['access_token']}"}
+
+
+def test_auth_token_and_rejections(tmp_path):
+    async def drive(client, db):
+        await get_token(client)
+        # empty credentials rejected
+        r = await client.post("/auth/token", json={"username": "", "password": "x"})
+        assert r.status == 401
+        # missing token
+        r = await client.post("/messages", json={"receiver_id": "b", "content": "x"})
+        assert r.status == 401
+        # garbage token
+        r = await client.post("/messages", json={"receiver_id": "b", "content": "x"},
+                              headers={"Authorization": "Bearer garbage"})
+        assert r.status == 401
+        # token signed with wrong secret
+        from swarmdb_tpu.utils import jwt as jwt_util
+        bad = jwt_util.create_access_token("x", "wrong-secret")
+        r = await client.get("/messages", headers={"Authorization": f"Bearer {bad}"})
+        assert r.status == 401
+
+    api_drive(drive, tmp_path)
+
+
+def test_register_and_deregister(tmp_path):
+    async def drive(client, db):
+        hdrs = await get_token(client, "agent1")
+        r = await client.post("/agents/register", json={
+            "agent_id": "agent1", "description": "test agent",
+            "capabilities": ["chat"]}, headers=hdrs)
+        assert r.status == 200
+        assert (await r.json())["status"] == "registered"
+        assert "agent1" in db.registered_agents
+        assert db.agent_metadata["agent1"]["description"] == "test agent"
+
+        # cannot register someone else
+        r = await client.post("/agents/register", json={"agent_id": "other"},
+                              headers=hdrs)
+        assert r.status == 403
+        # admin can
+        admin = await get_token(client, "admin")
+        r = await client.post("/agents/register", json={"agent_id": "other"},
+                              headers=admin)
+        assert r.status == 200
+
+        # deregister: self ok, other forbidden, missing 404
+        r = await client.delete("/agents/other", headers=hdrs)
+        assert r.status == 403
+        r = await client.delete("/agents/agent1", headers=hdrs)
+        assert r.status == 200
+        r = await client.delete("/agents/ghost", headers=admin)
+        assert r.status == 404
+
+    api_drive(drive, tmp_path)
+
+
+def test_send_and_get_message(tmp_path):
+    async def drive(client, db):
+        alice = await get_token(client, "alice")
+        r = await client.post("/messages", json={
+            "receiver_id": "bob", "content": "hi bob",
+            "message_type": "chat", "priority": 2,
+            "metadata": {"k": "v"}}, headers=alice)
+        assert r.status == 200
+        body = await r.json()
+        assert body["sender_id"] == "alice"  # sender forced to token subject
+        assert body["status"] == "delivered"
+        assert body["priority"] == 2
+        mid = body["id"]
+
+        # sender can fetch
+        r = await client.get(f"/messages/{mid}", headers=alice)
+        assert r.status == 200
+        # receiver can fetch
+        bob = await get_token(client, "bob")
+        r = await client.get(f"/messages/{mid}", headers=bob)
+        assert r.status == 200
+        # stranger cannot
+        eve = await get_token(client, "eve")
+        r = await client.get(f"/messages/{mid}", headers=eve)
+        assert r.status == 403
+        # admin can
+        admin = await get_token(client, "admin")
+        r = await client.get(f"/messages/{mid}", headers=admin)
+        assert r.status == 200
+        # missing
+        r = await client.get("/messages/doesnotexist", headers=admin)
+        assert r.status == 404
+
+    api_drive(drive, tmp_path)
+
+
+def test_broadcast_and_group_flow(tmp_path):
+    async def drive(client, db):
+        admin = await get_token(client, "admin")
+        for a in ("a", "b", "c"):
+            await client.post("/agents/register", json={"agent_id": a}, headers=admin)
+
+        a_hdrs = await get_token(client, "a")
+        r = await client.post("/messages/broadcast", json={
+            "content": "hello all", "exclude_agents": ["c"]}, headers=a_hdrs)
+        assert r.status == 200
+        body = await r.json()
+        assert body["status"] == "broadcast" and body["message_id"]
+
+        # group create + send
+        r = await client.post("/groups", json={
+            "group_name": "team", "agent_ids": ["a", "b", "c"]}, headers=a_hdrs)
+        assert r.status == 200
+        r = await client.post("/groups/message", json={
+            "group_name": "team", "content": "standup"}, headers=a_hdrs)
+        assert r.status == 200
+        body = await r.json()
+        assert body["status"] == "sent" and len(body["message_ids"]) == 2
+        # unknown group
+        r = await client.post("/groups/message", json={
+            "group_name": "ghost", "content": "x"}, headers=a_hdrs)
+        assert r.status == 404
+        # empty group
+        r = await client.post("/groups", json={"group_name": "e", "agent_ids": []},
+                              headers=a_hdrs)
+        assert r.status == 422
+
+    api_drive(drive, tmp_path)
+
+
+def test_receive_and_inbox_and_status(tmp_path):
+    async def drive(client, db):
+        alice = await get_token(client, "alice")
+        bob = await get_token(client, "bob")
+        # register bob FIRST so his consumer exists before the send
+        await client.post("/agents/register", json={"agent_id": "bob"}, headers=bob)
+        r = await client.post("/messages", json={
+            "receiver_id": "bob", "content": "poll me"}, headers=alice)
+        mid = (await r.json())["id"]
+
+        r = await client.post("/agents/receive", json={"max_messages": 5, "timeout": 1.0},
+                              headers=bob)
+        assert r.status == 200
+        msgs = await r.json()
+        assert [m["id"] for m in msgs] == [mid]
+        assert msgs[0]["status"] == "read"
+
+        # inbox pagination
+        r = await client.get("/agents/bob/messages?limit=10", headers=bob)
+        assert r.status == 200
+        assert len(await r.json()) == 1
+        r = await client.get("/agents/bob/messages", headers=alice)
+        assert r.status == 403
+
+        # status update: stranger forbidden, receiver ok, processed via method
+        eve = await get_token(client, "eve")
+        r = await client.put(f"/messages/{mid}/status", json={"status": "processed"},
+                             headers=eve)
+        assert r.status == 403
+        r = await client.put(f"/messages/{mid}/status", json={"status": "processed"},
+                             headers=bob)
+        assert r.status == 200
+        assert db.get_message(mid).status.value == "processed"
+        # bad status value
+        r = await client.put(f"/messages/{mid}/status", json={"status": "bogus"},
+                             headers=bob)
+        assert r.status == 422
+
+    api_drive(drive, tmp_path)
+
+
+def test_query_scoping(tmp_path):
+    async def drive(client, db):
+        db.send_message("a", "b", "ab")
+        db.send_message("b", "a", "ba")
+        db.send_message("c", "d", "cd")
+
+        a = await get_token(client, "a")
+        r = await client.get("/messages", headers=a)
+        assert r.status == 200
+        msgs = await r.json()
+        # non-admin sees only own traffic
+        assert {m["content"] for m in msgs} == {"ab", "ba"}
+        # explicit foreign sender filter forbidden
+        r = await client.get("/messages?sender_id=c", headers=a)
+        assert r.status == 403
+        # own filter fine
+        r = await client.get("/messages?sender_id=a", headers=a)
+        assert r.status == 200
+        # admin sees all
+        admin = await get_token(client, "admin")
+        r = await client.get("/messages", headers=admin)
+        assert len(await r.json()) == 3
+        # filters validated
+        r = await client.get("/messages?message_type=bogus", headers=admin)
+        assert r.status == 422
+
+    api_drive(drive, tmp_path)
+
+
+def test_health_open_and_stats_admin(tmp_path):
+    async def drive(client, db):
+        r = await client.get("/health")  # no auth required
+        assert r.status == 200
+        body = await r.json()
+        assert body["status"] == "healthy" and body["broker_connected"]
+
+        tester = await get_token(client, "tester")
+        r = await client.get("/stats", headers=tester)
+        assert r.status == 403
+        admin = await get_token(client, "admin")
+        db.send_message("x", "y", "1")
+        r = await client.get("/stats", headers=admin)
+        assert r.status == 200
+        stats = await r.json()
+        assert stats["total_messages"] == 1
+        assert stats["messages_by_type"]["chat"] == 1
+
+    api_drive(drive, tmp_path)
+
+
+def test_admin_routes(tmp_path):
+    async def drive(client, db):
+        tester = await get_token(client, "tester")
+        admin = await get_token(client, "admin")
+        for route in ("/admin/save", "/admin/flush", "/admin/resend_failed",
+                      "/admin/scale_partitions"):
+            r = await client.post(route, headers=tester)
+            assert r.status == 403, route
+
+        db.send_message("a", "b", "save me")
+        r = await client.post("/admin/save", headers=admin)
+        assert r.status == 200
+        assert (await r.json())["filepath"]
+
+        r = await client.post("/admin/flush?max_age_seconds=0.0", headers=admin)
+        assert r.status == 200
+        assert (await r.json())["archived_count"] == 1
+
+        r = await client.post("/admin/resend_failed", headers=admin)
+        assert (await r.json())["message_ids"] == []
+
+        for i in range(35):
+            db.register_agent(f"agent{i}")
+        r = await client.post("/admin/scale_partitions", headers=admin)
+        assert (await r.json())["num_partitions"] == 12
+
+    api_drive(drive, tmp_path)
+
+
+def test_rate_limit(tmp_path):
+    cfg = ApiConfig(jwt_secret_key="test-secret", rate_limit_per_minute=5)
+
+    async def drive(client, db):
+        statuses = []
+        for _ in range(8):
+            r = await client.get("/health")  # exempt — never limited
+            statuses.append(r.status)
+        assert all(s == 200 for s in statuses)
+        hdrs = await get_token(client, "x")  # consumes 1
+        statuses = []
+        for _ in range(8):
+            r = await client.get("/messages", headers=hdrs)
+            statuses.append(r.status)
+        assert 429 in statuses
+        assert statuses[:4] == [200, 200, 200, 200]
+
+    api_drive(drive, tmp_path, config=cfg)
+
+
+def test_sse_stream_without_backend(tmp_path):
+    """stream:true with no serving engine streams lifecycle events."""
+
+    async def drive(client, db):
+        alice = await get_token(client, "alice")
+        r = await client.post("/messages", json={
+            "receiver_id": "bob", "content": "stream me", "stream": True},
+            headers=alice)
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = (await r.read()).decode()
+        events = [line.split(": ", 1)[1] for line in raw.splitlines()
+                  if line.startswith("event: ")]
+        assert events[0] == "message" and events[-1] == "done"
+        data_lines = [line[6:] for line in raw.splitlines() if line.startswith("data: ")]
+        first = json.loads(data_lines[0])
+        assert first["content"] == "stream me"
+
+    api_drive(drive, tmp_path)
+
+
+def test_cors_headers_and_preflight(tmp_path):
+    async def drive(client, db):
+        r = await client.get("/health")
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        r = await client.options("/messages")
+        assert r.status == 204
+        assert "POST" in r.headers["Access-Control-Allow-Methods"]
+
+    api_drive(drive, tmp_path)
+
+
+def test_malformed_bodies(tmp_path):
+    async def drive(client, db):
+        hdrs = await get_token(client, "x")
+        r = await client.post("/messages", data=b"not json",
+                              headers={**hdrs, "Content-Type": "application/json"})
+        assert r.status == 400
+        r = await client.post("/messages", json={"receiver_id": "b"}, headers=hdrs)
+        assert r.status == 422  # content missing
+
+    api_drive(drive, tmp_path)
+
+
+def test_admin_password_enforced(tmp_path):
+    cfg = ApiConfig(jwt_secret_key="s", admin_password="hunter2",
+                    rate_limit_per_minute=10_000)
+
+    async def drive(client, db):
+        r = await client.post("/auth/token",
+                              json={"username": "admin", "password": "wrong"})
+        assert r.status == 401
+        r = await client.post("/auth/token",
+                              json={"username": "admin", "password": "hunter2"})
+        assert r.status == 200
+        # non-admin users unaffected
+        r = await client.post("/auth/token",
+                              json={"username": "joe", "password": "anything"})
+        assert r.status == 200
+
+    api_drive(drive, tmp_path, config=cfg)
+
+
+def test_crafted_tokens_give_401_not_500(tmp_path):
+    async def drive(client, db):
+        for bad in ("é.a.b", "a.b", "a.b.c.d", "!!!.###.$$$", "..", "a.é.c"):
+            r = await client.get("/messages",
+                                 headers={"Authorization": f"Bearer {bad}"})
+            assert r.status == 401, (bad, r.status)
+        # token with non-numeric exp
+        import base64, json as j
+        def seg(d): return base64.urlsafe_b64encode(j.dumps(d).encode()).rstrip(b"=").decode()
+        forged = f'{seg({"alg":"HS256"})}.{seg({"sub":"x","exp":"soon"})}.AAAA'
+        r = await client.get("/messages", headers={"Authorization": f"Bearer {forged}"})
+        assert r.status == 401
+
+    api_drive(drive, tmp_path)
+
+
+def test_cors_allowlist_echoes_single_origin(tmp_path):
+    cfg = ApiConfig(jwt_secret_key="s", rate_limit_per_minute=10_000,
+                    cors_origins="https://a.com, https://b.com")
+
+    async def drive(client, db):
+        r = await client.get("/health", headers={"Origin": "https://b.com"})
+        assert r.headers["Access-Control-Allow-Origin"] == "https://b.com"
+        r = await client.get("/health", headers={"Origin": "https://evil.com"})
+        assert r.headers["Access-Control-Allow-Origin"] == "https://a.com"  # never echoes evil
+        r = await client.get("/health")
+        assert "," not in r.headers["Access-Control-Allow-Origin"]
+
+    api_drive(drive, tmp_path, config=cfg)
+
+
+def test_admin_flush_bad_param_422(tmp_path):
+    async def drive(client, db):
+        admin = await get_token(client, "admin")
+        r = await client.post("/admin/flush?max_age_seconds=abc", headers=admin)
+        assert r.status == 422
+
+    api_drive(drive, tmp_path)
