@@ -86,10 +86,11 @@ class ApiConfig:
         allowed = {o.strip() for o in self.cors_origins.split(",") if o.strip()}
         if request_origin and request_origin in allowed:
             return request_origin
-        return next(iter(sorted(allowed)), "*")
+        # no match (or empty allowlist): "null" denies — never widen to "*"
+        return next(iter(sorted(allowed)), "null")
 
 
-def _error(status_code: int, detail: str) -> web.HTTPException:
+def _error(status_code: int, detail: Any) -> web.HTTPException:
     exc_cls = {
         400: web.HTTPBadRequest,
         401: web.HTTPUnauthorized,
@@ -121,7 +122,9 @@ async def _parse(request: web.Request, model: type) -> Any:
     try:
         return model.model_validate(body)
     except ValidationError as exc:
-        raise _error(422, exc.json())
+        # detail is the parsed error list (FastAPI wire shape), not a
+        # double-encoded JSON string
+        raise _error(422, json.loads(exc.json()))
 
 
 def _json(model_or_dict: Any, status_code: int = 200) -> web.Response:
@@ -224,6 +227,12 @@ def create_app(
                     status=exc.status, text=exc.text,
                     content_type=exc.content_type or "application/json",
                 )
+            except Exception:
+                # unexpected failure: still a JSON body WITH CORS headers,
+                # or browser clients see an opaque CORS error instead of 500
+                logger.exception("unhandled error on %s %s",
+                                 request.method, request.path)
+                resp = web.json_response({"detail": "internal error"}, status=500)
         _add_cors(resp, request.headers.get("Origin"))
         return resp
 
@@ -342,10 +351,13 @@ def create_app(
         q = request.query
         sender = q.get("sender_id")
         receiver = q.get("receiver_id")
+        involving = None
         if agent != ADMIN_USERNAME:
-            # restrict to own traffic: force one side to be the caller
+            # restrict to own traffic; the `involving` filter runs DB-side
+            # BEFORE the limit, so the caller's messages can't be crowded
+            # out of the page by other agents' newer traffic
             if sender is None and receiver is None:
-                sender, receiver = None, None  # filtered below
+                involving = agent
             elif agent not in (sender, receiver):
                 raise _error(403, "non-admin may only query own messages")
         try:
@@ -358,14 +370,10 @@ def create_app(
                 start_time=float(q["start_time"]) if "start_time" in q else None,
                 end_time=float(q["end_time"]) if "end_time" in q else None,
                 limit=int(q.get("limit", "100")),
+                involving=involving,
             )
         except ValueError as exc:
             raise _error(422, str(exc))
-        if agent != ADMIN_USERNAME and sender is None and receiver is None:
-            msgs = [
-                m for m in msgs
-                if agent in (m.sender_id, m.receiver_id) or agent in m.visible_to
-            ]
         return _json([schemas.MessageResponse.from_message(m).model_dump(mode="json")
                       for m in msgs])
 
@@ -543,14 +551,15 @@ def create_app(
 
     async def _stream_group(request: web.Request, ids: list) -> web.StreamResponse:
         resp = await _sse_response(request)
+        group_msgs = []
         for mid in ids:
             m = await _run_sync(db.get_message, mid)
+            group_msgs.append(m)
             await _sse_event(resp, "message",
                              schemas.MessageResponse.from_message(m).model_dump(mode="json"))
         if serving is not None:
             try:
-                group_msgs = [await _run_sync(db.get_message, i) for i in ids]
-                async for item in serving.stream_group(group_msgs):
+                async for item in serving.stream_group([m for m in group_msgs if m]):
                     await _sse_event(resp, item.get("event", "token"), item)
             except Exception as exc:
                 await _sse_event(resp, "error", {"detail": str(exc)})

@@ -46,8 +46,13 @@ def build_serving(db: SwarmDB):
     model_name = os.environ.get("SERVE_MODEL")
     if not model_name:
         return None
-    from ..backend.service import ServingService
-
+    try:
+        from ..backend.service import ServingService
+    except ImportError as exc:
+        raise SystemExit(
+            f"SERVE_MODEL={model_name!r} requires the serving backend "
+            f"(swarmdb_tpu.backend.service): {exc}"
+        )
     return ServingService.from_model_name(db, model_name)
 
 

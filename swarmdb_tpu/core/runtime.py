@@ -447,8 +447,14 @@ class SwarmDB:
         start_time: Optional[float] = None,
         end_time: Optional[float] = None,
         limit: int = 100,
+        involving: Optional[str] = None,
     ) -> List[Message]:
-        """Multi-filter scan, newest-first (reference ` main.py:671-726`)."""
+        """Multi-filter scan, newest-first (reference ` main.py:671-726`).
+
+        ``involving`` (TPU-build addition) keeps only messages the named
+        agent participates in (sender, receiver, or in ``visible_to``) —
+        applied BEFORE the limit so non-admin API queries can't have their
+        own traffic crowded out by others' newer messages."""
         message_type = MessageType(message_type) if message_type is not None else None
         status = MessageStatus(status) if status is not None else None
         if limit <= 0:
@@ -468,6 +474,10 @@ class SwarmDB:
             if start_time is not None and m.timestamp < start_time:
                 continue
             if end_time is not None and m.timestamp > end_time:
+                continue
+            if involving is not None and involving not in (
+                m.sender_id, m.receiver_id
+            ) and involving not in m.visible_to:
                 continue
             out.append(m)
             if len(out) >= limit:

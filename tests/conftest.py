@@ -2,15 +2,25 @@
 
 Forces JAX onto 8 virtual CPU devices (standard trick, SURVEY §4) so
 Mesh/pjit/shard_map tests exercise real multi-device semantics with no TPU.
-Must run before any test module imports jax.
+
+Environment subtlety: this image's sitecustomize registers the remote-TPU
+("axon") PJRT plugin and imports jax at interpreter startup, so the
+JAX_PLATFORMS env var is latched to "axon" before conftest runs. Setting
+os.environ here is too late — the supported override is
+``jax.config.update('jax_platforms', 'cpu')``, which must happen before any
+backend client is created. XLA_FLAGS, however, is read at backend-init
+time, so setting it here (before the first jax op) still works.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -406,3 +406,53 @@ def test_admin_flush_bad_param_422(tmp_path):
         assert r.status == 422
 
     api_drive(drive, tmp_path)
+
+
+def test_query_own_messages_not_crowded_out(tmp_path):
+    # Review finding: ownership filter must run before the limit.
+    async def drive(client, db):
+        db.send_message("a", "b", "mine-1")
+        for i in range(150):
+            db.send_message("x", "y", f"noise-{i}")
+        a = await get_token(client, "a")
+        r = await client.get("/messages?limit=100", headers=a)
+        msgs = await r.json()
+        assert [m["content"] for m in msgs] == ["mine-1"]
+
+    api_drive(drive, tmp_path)
+
+
+def test_422_detail_is_structured(tmp_path):
+    async def drive(client, db):
+        hdrs = await get_token(client, "x")
+        r = await client.post("/messages", json={"receiver_id": "b"}, headers=hdrs)
+        assert r.status == 422
+        detail = (await r.json())["detail"]
+        assert isinstance(detail, list) and "loc" in detail[0]
+
+    api_drive(drive, tmp_path)
+
+
+def test_unexpected_error_returns_cors_500(tmp_path):
+    async def drive(client, db):
+        def boom(*a, **k):
+            raise RuntimeError("injected")
+        db.get_stats = boom
+        admin = await get_token(client, "admin")
+        r = await client.get("/stats", headers=admin)
+        assert r.status == 500
+        assert "Access-Control-Allow-Origin" in r.headers
+        assert (await r.json())["detail"] == "internal error"
+
+    api_drive(drive, tmp_path)
+
+
+def test_cors_empty_allowlist_denies(tmp_path):
+    cfg = ApiConfig(jwt_secret_key="s", rate_limit_per_minute=10_000,
+                    cors_origins=",")
+
+    async def drive(client, db):
+        r = await client.get("/health", headers={"Origin": "https://evil.com"})
+        assert r.headers["Access-Control-Allow-Origin"] == "null"
+
+    api_drive(drive, tmp_path, config=cfg)
