@@ -76,18 +76,19 @@ class ApiConfig:
             admin_password=os.environ.get("ADMIN_PASSWORD") or None,
         )
 
-    def allowed_origin(self, request_origin: Optional[str]) -> str:
-        """Resolve the Access-Control-Allow-Origin value for one request.
-        CORS_ORIGINS may be '*' or a comma-separated allowlist; a list must
-        be echoed back one-origin-at-a-time, never as the raw joined string
-        (browsers reject a comma-joined header)."""
+    def allowed_origin(self, request_origin: Optional[str]) -> Optional[str]:
+        """Resolve the Access-Control-Allow-Origin value for one request, or
+        None to OMIT the header (deny). CORS_ORIGINS may be '*' or a
+        comma-separated allowlist; a listed origin is echoed back verbatim.
+        Never emit "null" (sandboxed iframes send Origin: null and browsers
+        treat an echoed "null" as a match — OWASP anti-pattern) and never
+        widen a miss to "*"."""
         if self.cors_origins.strip() == "*":
             return "*"
         allowed = {o.strip() for o in self.cors_origins.split(",") if o.strip()}
         if request_origin and request_origin in allowed:
             return request_origin
-        # no match (or empty allowlist): "null" denies — never widen to "*"
-        return next(iter(sorted(allowed)), "null")
+        return None
 
 
 def _error(status_code: int, detail: Any) -> web.HTTPException:
@@ -229,7 +230,10 @@ def create_app(
                 )
             except Exception:
                 # unexpected failure: still a JSON body WITH CORS headers,
-                # or browser clients see an opaque CORS error instead of 500
+                # or browser clients see an opaque CORS error instead of 500.
+                # (SSE handlers contain their own errors post-prepare — see
+                # _stream_reply/_stream_group — so no second response can be
+                # written over an already-streaming connection.)
                 logger.exception("unhandled error on %s %s",
                                  request.method, request.path)
                 resp = web.json_response({"detail": "internal error"}, status=500)
@@ -237,7 +241,9 @@ def create_app(
         return resp
 
     def _add_cors(resp: web.StreamResponse, origin: Optional[str] = None) -> None:
-        resp.headers["Access-Control-Allow-Origin"] = cfg.allowed_origin(origin)
+        acao = cfg.allowed_origin(origin)
+        if acao is not None:
+            resp.headers["Access-Control-Allow-Origin"] = acao
         resp.headers["Access-Control-Allow-Methods"] = "GET, POST, PUT, DELETE, OPTIONS"
         resp.headers["Access-Control-Allow-Headers"] = "Authorization, Content-Type"
 
@@ -528,43 +534,65 @@ def create_app(
 
     async def _stream_reply(request: web.Request, msg_id: str) -> web.StreamResponse:
         """SSE stream for one message: LLM decode tokens when a serving
-        engine is attached (north star), else the message lifecycle."""
+        engine is attached (north star), else the message lifecycle.
+
+        Once the stream response is prepared, NO exception may escape —
+        aiohttp would try to write a second (500) response over a connection
+        that already sent text/event-stream headers. Errors are reported as
+        SSE "error" events when the transport still works, else swallowed
+        (client went away)."""
         resp = await _sse_response(request)
-        msg = await _run_sync(db.get_message, msg_id)
-        await _sse_event(resp, "message",
-                         schemas.MessageResponse.from_message(msg).model_dump(mode="json"))
-        if serving is not None:
-            try:
-                async for tok in serving.stream_reply(msg):
-                    await _sse_event(resp, "token", tok)
-                reply_id = msg.metadata.get("reply_id")
-                reply = await _run_sync(db.get_message, reply_id) if reply_id else None
-                if reply is not None:
-                    await _sse_event(
-                        resp, "reply",
-                        schemas.MessageResponse.from_message(reply).model_dump(mode="json"))
-            except Exception as exc:
-                await _sse_event(resp, "error", {"detail": str(exc)})
-        await _sse_event(resp, "done", {"message_id": msg_id})
-        await resp.write_eof()
+        try:
+            msg = await _run_sync(db.get_message, msg_id)
+            if msg is not None:
+                await _sse_event(
+                    resp, "message",
+                    schemas.MessageResponse.from_message(msg).model_dump(mode="json"))
+            if serving is not None and msg is not None:
+                try:
+                    async for tok in serving.stream_reply(msg):
+                        await _sse_event(resp, "token", tok)
+                    reply_id = msg.metadata.get("reply_id")
+                    reply = await _run_sync(db.get_message, reply_id) if reply_id else None
+                    if reply is not None:
+                        await _sse_event(
+                            resp, "reply",
+                            schemas.MessageResponse.from_message(reply).model_dump(mode="json"))
+                except Exception as exc:
+                    await _sse_event(resp, "error", {"detail": str(exc)})
+            await _sse_event(resp, "done", {"message_id": msg_id})
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            logger.debug("SSE client disconnected during /messages stream")
+        except Exception:
+            logger.exception("error inside prepared SSE stream")
         return resp
 
     async def _stream_group(request: web.Request, ids: list) -> web.StreamResponse:
+        """Same post-prepare exception containment as _stream_reply."""
         resp = await _sse_response(request)
-        group_msgs = []
-        for mid in ids:
-            m = await _run_sync(db.get_message, mid)
-            group_msgs.append(m)
-            await _sse_event(resp, "message",
-                             schemas.MessageResponse.from_message(m).model_dump(mode="json"))
-        if serving is not None:
-            try:
-                async for item in serving.stream_group([m for m in group_msgs if m]):
-                    await _sse_event(resp, item.get("event", "token"), item)
-            except Exception as exc:
-                await _sse_event(resp, "error", {"detail": str(exc)})
-        await _sse_event(resp, "done", {"message_ids": ids})
-        await resp.write_eof()
+        try:
+            group_msgs = []
+            for mid in ids:
+                m = await _run_sync(db.get_message, mid)
+                if m is None:
+                    continue  # flushed/deleted between send and stream
+                group_msgs.append(m)
+                await _sse_event(
+                    resp, "message",
+                    schemas.MessageResponse.from_message(m).model_dump(mode="json"))
+            if serving is not None:
+                try:
+                    async for item in serving.stream_group(group_msgs):
+                        await _sse_event(resp, item.get("event", "token"), item)
+                except Exception as exc:
+                    await _sse_event(resp, "error", {"detail": str(exc)})
+            await _sse_event(resp, "done", {"message_ids": ids})
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            logger.debug("SSE client disconnected during group stream")
+        except Exception:
+            logger.exception("error inside prepared SSE stream")
         return resp
 
     # ---------------------------------------------------------------- wiring

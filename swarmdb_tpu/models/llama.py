@@ -1,0 +1,168 @@
+"""Llama-3 model family — functional JAX implementation.
+
+Design (idiomatic TPU, not a torch port):
+- Parameters are a plain pytree dict; per-layer weights are STACKED along a
+  leading [L, ...] axis and the forward pass is one `lax.scan` over layers —
+  one compiled layer body regardless of depth (fast compiles, natural hook
+  for pipeline parallelism later).
+- Same forward for prefill ([B, T] tokens) and decode ([B, 1]): each batch
+  row carries its own absolute positions, and K/V are scattered into a
+  fixed-shape slot cache — the continuous-batching engine admits/retires
+  sequences by rewriting slot state, never by changing shapes.
+- Tensor parallelism is expressed as PartitionSpecs over a 'model' mesh axis
+  (`param_specs`): attention/MLP column-sharded in, row-sharded out, GSPMD
+  inserts the all-reduces (SURVEY §2.4 TP row).
+
+The reference has no model layer (SURVEY §2.4); this is the north-star
+serving backend for Llama-3-8B/70B (BASELINE.json configs 2, 3, 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.layers import apply_rope, gqa_attention, rms_norm, swiglu, write_kv_cache
+from .configs import ModelConfig
+
+Params = Dict[str, Any]
+KVCache = Tuple[jnp.ndarray, jnp.ndarray]  # each [L, B, S, Hkv, D]
+
+
+# ---------------------------------------------------------------------- init
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random init (serving weights normally come from a checkpoint; random
+    params exercise identical shapes/compute for tests and benches)."""
+    if cfg.is_moe:
+        raise ValueError(
+            f"{cfg.name!r} is a MoE config (n_experts={cfg.n_experts}); "
+            "use swarmdb_tpu.models.mixtral, not the dense Llama stack"
+        )
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    Hq, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": dense(k_embed, (cfg.vocab_size, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype),
+            "wq": dense(ks[0], (L, D, Hq * hd), D),
+            "wk": dense(ks[1], (L, D, Hkv * hd), D),
+            "wv": dense(ks[2], (L, D, Hkv * hd), D),
+            "wo": dense(ks[3], (L, Hq * hd, D), Hq * hd),
+            "mlp_norm": jnp.ones((L, D), dtype),
+            "w_gate": dense(ks[4], (L, D, F), D),
+            "w_up": dense(ks[5], (L, D, F), D),
+            "w_down": dense(ks[6], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k_head, (D, cfg.vocab_size), D)
+    return params
+
+
+def param_specs(cfg: ModelConfig, model_axis: str = "model") -> Params:
+    """PartitionSpecs for tensor parallelism over ``model_axis``.
+
+    Megatron-style: QKV/gate/up column-parallel (shard output features),
+    O/down row-parallel (shard input features) — one all-reduce per block,
+    emitted by GSPMD. Embedding/head shard the vocab dimension.
+    """
+    m = model_axis
+    specs: Params = {
+        "embed": P(m, None),        # vocab-sharded
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, m),
+            "wk": P(None, None, m),
+            "wv": P(None, None, m),
+            "wo": P(None, m, None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, m),
+            "w_up": P(None, None, m),
+            "w_down": P(None, m, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, m)
+    return specs
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def cache_specs(model_axis: str = "model") -> Tuple[P, P]:
+    """KV cache shards its head dim over the model axis, batch over data."""
+    spec = P(None, "data", None, model_axis, None)
+    return spec, spec
+
+
+# ------------------------------------------------------------------- forward
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, T] int32
+    positions: jnp.ndarray,    # [B, T] int32 absolute positions per row
+    cache: KVCache,            # ([L, B, S, Hkv, hd], ...)
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One forward pass; returns fp32 logits [B, T, V] and updated cache.
+
+    Works for mixed prefill/decode batches: each row's ``positions`` are its
+    own absolute offsets, and attention masks by position (ops/layers.py).
+    """
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral.forward")
+    x = params["embed"][tokens]  # [B, T, D]; compute dtype = param dtype
+    cache_k, cache_v = cache
+
+    layer_params = params["layers"]
+
+    def layer_step(x, scanned):
+        lp, ck, cv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
+            B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        ck, cv = write_kv_cache(ck, cv, k, v, positions)
+        attn = gqa_attention(q, ck, cv, positions)
+        attn_out = jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        x = x + attn_out
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (layer_params, cache_k, cache_v))
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:  # tied embeddings
+        head = params["embed"].T
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return logits, (new_k, new_v)
+

@@ -1,0 +1,120 @@
+"""Shared transformer building blocks (JAX, TPU-first).
+
+Functional ops used by the Llama and Mixtral families: RMSNorm, rotary
+embeddings, grouped-query attention over a slot-based KV cache, SwiGLU.
+No reference counterpart (the reference has no model code, SURVEY §2.4/§5.7).
+
+TPU notes:
+- matmuls/einsums stay bf16 (MXU native); normalization statistics and
+  softmax run in fp32 for stability, logits are returned fp32.
+- all shapes are static under jit; the KV cache is a fixed [B, S, ...] slot
+  buffer and validity is expressed by masking, never by dynamic shapes.
+- attention is plain einsum + masked softmax: XLA fuses this well on TPU;
+  the Pallas ragged/paged kernel in ``ops/pallas_attention.py`` replaces it
+  on the serving hot path when enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm with fp32 statistics, output in x.dtype."""
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(x.dtype) * weight
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies [head_dim/2], fp32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float
+) -> jnp.ndarray:
+    """Rotary position embedding.
+
+    x: [B, T, H, D], positions: [B, T] (absolute token positions).
+    Pairs (x[..., :D/2], x[..., D/2:]) are rotated — the "split-half"
+    convention used by HF Llama, so checkpoints interoperate.
+    """
+    d = x.shape[-1]
+    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
+           w_down: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU MLP: silu(x @ gate) * (x @ up) @ down."""
+    g = jax.nn.silu(jnp.einsum("btd,df->btf", x, w_gate))
+    u = jnp.einsum("btd,df->btf", x, w_up)
+    return jnp.einsum("btf,fd->btd", g * u, w_down)
+
+
+def write_kv_cache(
+    cache_k: jnp.ndarray,  # [B, S, Hkv, D]
+    cache_v: jnp.ndarray,
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, T]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V into per-slot cache rows at absolute positions.
+
+    Positions may differ per batch row (continuous batching: each slot is at
+    its own decode offset). Compiles to a scatter; shapes stay static.
+    """
+    b_idx = jnp.arange(cache_k.shape[0])[:, None]  # [B, 1]
+    cache_k = cache_k.at[b_idx, positions].set(k.astype(cache_k.dtype))
+    cache_v = cache_v.at[b_idx, positions].set(v.astype(cache_v.dtype))
+    return cache_k, cache_v
+
+
+def gqa_attention(
+    q: jnp.ndarray,          # [B, T, Hq, D]
+    cache_k: jnp.ndarray,    # [B, S, Hkv, D]
+    cache_v: jnp.ndarray,    # [B, S, Hkv, D]
+    q_positions: jnp.ndarray,  # [B, T] absolute position of each query
+    *,
+    window: Optional[int] = None,  # sliding-window size (None = full causal)
+) -> jnp.ndarray:
+    """Grouped-query attention against the full cache buffer with causal
+    masking by absolute position.
+
+    Validity invariant: a cache slot is filled monotonically from position 0,
+    so every cache entry at position s <= q_position is live for that row.
+    Returns [B, T, Hq, D] in q.dtype; softmax in fp32.
+    """
+    B, S = cache_k.shape[0], cache_k.shape[1]
+    Hq, Hkv = q.shape[2], cache_k.shape[2]
+    group = Hq // Hkv
+
+    qf = q.astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    vf = cache_v.astype(jnp.float32)
+
+    # [B, T, Hkv, group, D] x [B, S, Hkv, D] -> [B, Hkv, group, T, S]
+    qg = qf.reshape(B, q.shape[1], Hkv, group, -1)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, kf)
+    scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
+
+    kv_pos = jnp.arange(S)[None, None, :]                # [1, 1, S]
+    causal = kv_pos <= q_positions[:, :, None]           # [B, T, S]
+    if window is not None:
+        causal &= kv_pos > (q_positions[:, :, None] - window)
+    mask = causal[:, None, None, :, :]                   # [B, 1, 1, T, S]
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, vf)
+    return out.reshape(q.shape).astype(q.dtype)

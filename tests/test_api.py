@@ -391,10 +391,12 @@ def test_cors_allowlist_echoes_single_origin(tmp_path):
     async def drive(client, db):
         r = await client.get("/health", headers={"Origin": "https://b.com"})
         assert r.headers["Access-Control-Allow-Origin"] == "https://b.com"
+        # non-matching origin: header omitted entirely (deny) — never echoes
+        # the attacker origin, never "null", never the joined list
         r = await client.get("/health", headers={"Origin": "https://evil.com"})
-        assert r.headers["Access-Control-Allow-Origin"] == "https://a.com"  # never echoes evil
+        assert "Access-Control-Allow-Origin" not in r.headers
         r = await client.get("/health")
-        assert "," not in r.headers["Access-Control-Allow-Origin"]
+        assert "Access-Control-Allow-Origin" not in r.headers
 
     api_drive(drive, tmp_path, config=cfg)
 
@@ -452,7 +454,10 @@ def test_cors_empty_allowlist_denies(tmp_path):
                     cors_origins=",")
 
     async def drive(client, db):
+        # deny = omit the header ("null" would match sandboxed iframes)
         r = await client.get("/health", headers={"Origin": "https://evil.com"})
-        assert r.headers["Access-Control-Allow-Origin"] == "null"
+        assert "Access-Control-Allow-Origin" not in r.headers
+        r = await client.get("/health", headers={"Origin": "null"})
+        assert "Access-Control-Allow-Origin" not in r.headers
 
     api_drive(drive, tmp_path, config=cfg)
