@@ -1,0 +1,411 @@
+"""Continuous-batching generation engine.
+
+The TPU serving core the north star demands (SURVEY §7 step 4): a
+fixed-shape decode loop under ``jax.jit`` with slot management —
+
+- ``max_batch`` slots; each slot holds one in-flight sequence with its own
+  absolute position, sampling params, and PRNG stream.
+- ONE compiled decode step serves every population of slots: inactive slots
+  run masked garbage that is ignored host-side (shapes never change, so XLA
+  never recompiles).
+- Prefill runs per-sequence at bucketed lengths (powers of two) to bound
+  the number of compiled variants, then the prefix cache is inserted into
+  the slot's rows of the batch KV cache.
+- Admission is priority-ordered (MessagePriority: CRITICAL first — the
+  reference stores priorities but never uses them, SURVEY §2.2).
+- Tokens stream to per-request callbacks as they are sampled; the HTTP
+  layer bridges these to SSE (asyncio) queues.
+
+The engine is model-agnostic: it takes a ``forward(params, tokens,
+positions, cache)`` callable (Llama or Mixtral) plus cache constructors.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.metrics import MetricsRegistry
+from .sampling import SamplingParams, make_slot_keys, sample_tokens
+
+logger = logging.getLogger("swarmdb_tpu.engine")
+
+
+@dataclass
+class GenRequest:
+    prompt: List[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    priority: int = 1
+    request_id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    # on_token(request_id, token_id) fires per sampled token (engine thread!)
+    on_token: Optional[Callable[[str, int], None]] = None
+    # on_done(request_id, token_ids, finish_reason)
+    on_done: Optional[Callable[[str, List[int], str], None]] = None
+    submitted_at: float = field(default_factory=time.time)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class _Slot:
+    active: bool = False
+    request: Optional[GenRequest] = None
+    position: int = 0           # next absolute position to write
+    generated: List[int] = field(default_factory=list)
+    last_token: int = 0
+    first_token_at: Optional[float] = None
+
+
+class Engine:
+    """Slot-based continuous batching over a jitted decode step."""
+
+    def __init__(
+        self,
+        forward_fn: Callable,            # forward(params, tokens, positions, cache)
+        init_cache_fn: Callable,         # (batch, max_seq) -> cache pytree
+        params: Any,
+        *,
+        max_batch: int = 8,
+        max_seq: int = 1024,
+        eos_id: int = 2,
+        pad_id: int = 0,
+        seed: int = 0,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        donate_cache: bool = True,
+    ) -> None:
+        self.forward_fn = forward_fn
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.metrics = metrics or MetricsRegistry()
+
+        self.cache = init_cache_fn(max_batch, max_seq)
+        self._prefill_cache_fn = init_cache_fn
+        self.base_keys = make_slot_keys(seed, max_batch)
+        self.slots = [_Slot() for _ in range(max_batch)]
+
+        if prefill_buckets is None:
+            prefill_buckets = [
+                b for b in (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+                if b <= max_seq
+            ]
+        prefill_buckets = sorted(prefill_buckets)
+        # the largest bucket must hold the longest admissible prompt
+        # (max_seq - 1), or an oversized prompt would crash prefill and
+        # collateral-fail every in-flight request
+        if not prefill_buckets or prefill_buckets[-1] < max_seq - 1:
+            prefill_buckets.append(max_seq - 1)
+        self.prefill_buckets = prefill_buckets
+
+        # host-side mirrors of per-slot sampling params (device arrays built
+        # on change, not per step)
+        self._temp = np.zeros(max_batch, np.float32)
+        self._topk = np.zeros(max_batch, np.int32)
+        self._topp = np.ones(max_batch, np.float32)
+        self._params_dirty = True
+        self._temp_dev = None
+        self._topk_dev = None
+        self._topp_dev = None
+
+        self._queue: List[Tuple[int, float, int, GenRequest]] = []  # heap
+        self._tiebreak = itertools.count()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+        donate = (3,) if donate_cache else ()
+
+        # ---- compiled step: decode all slots by one token -----------------
+        def _decode(params, tokens, positions, cache, base_keys, temp, topk, topp):
+            # tokens [B,1], positions [B,1]
+            logits, cache = self.forward_fn(params, tokens, positions, cache)
+            next_tok = sample_tokens(
+                logits[:, -1], base_keys, positions[:, 0], temp, topk, topp
+            )
+            return next_tok, cache
+
+        self._decode = jax.jit(_decode, donate_argnums=donate)
+
+        # ---- compiled prefill (B=1), one variant per bucket ---------------
+        def _prefill(params, tokens, length, cache1, base_key, temp, topk, topp):
+            # tokens [1, T] padded; length scalar = true length
+            T = tokens.shape[1]
+            positions = jnp.arange(T, dtype=jnp.int32)[None]
+            logits, cache1 = self.forward_fn(params, tokens, positions, cache1)
+            last = logits[jnp.arange(1), (length - 1)[None]]  # [1, V]
+            next_tok = sample_tokens(
+                last, base_key[None], (length - 1)[None],
+                temp[None], topk[None], topp[None],
+            )
+            return next_tok[0], cache1
+
+        self._prefill = jax.jit(_prefill)
+
+        self.total_generated = 0
+        self.total_requests = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="swarmdb-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # ------------------------------------------------------------ submission
+
+    def submit(self, request: GenRequest) -> str:
+        """Thread-safe enqueue; returns the request id."""
+        if len(request.prompt) >= self.max_seq:
+            raise ValueError(
+                f"prompt length {len(request.prompt)} >= max_seq {self.max_seq}"
+            )
+        with self._cv:
+            heapq.heappush(
+                self._queue,
+                (-request.priority, request.submitted_at,
+                 next(self._tiebreak), request),
+            )
+            self.metrics.counters["engine_requests"].inc()
+            self._cv.notify_all()
+        return request.request_id
+
+    def generate_sync(self, prompt: List[int], sampling: SamplingParams,
+                      timeout: float = 120.0) -> Tuple[List[int], str]:
+        """Blocking convenience API (tests, benches)."""
+        done = threading.Event()
+        result: Dict[str, Any] = {}
+
+        def on_done(rid, toks, reason):
+            result["tokens"] = toks
+            result["reason"] = reason
+            done.set()
+
+        self.submit(GenRequest(prompt=prompt, sampling=sampling, on_done=on_done))
+        if not done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        return result["tokens"], result["reason"]
+
+    # ------------------------------------------------------------- the loop
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._queue and not self._any_active():
+                    self._cv.wait(timeout=0.5)
+                if self._stop:
+                    break
+            try:
+                self._admit()
+                if self._any_active():
+                    self._step_decode()
+            except Exception:
+                logger.exception("engine step failed; failing active requests")
+                self._fail_all("engine_error")
+                # the decode step donates the cache buffer: if it raised
+                # mid-step, self.cache may reference a deleted buffer —
+                # rebuild it so the engine survives the error
+                try:
+                    self.cache = self._prefill_cache_fn(self.max_batch, self.max_seq)
+                except Exception:
+                    logger.exception("cache re-init failed; stopping engine")
+                    with self._cv:
+                        self._stop = True
+
+    def _any_active(self) -> bool:
+        return any(s.active for s in self.slots)
+
+    def _free_slot_ids(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if not s.active]
+
+    # ------------------------------------------------------------- admission
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots (highest priority first) and
+        run their prefill."""
+        while True:
+            with self._cv:
+                free = self._free_slot_ids()
+                if not free or not self._queue:
+                    return
+                _, _, _, req = heapq.heappop(self._queue)
+            self._prefill_into_slot(free[0], req)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _prefill_into_slot(self, slot_id: int, req: GenRequest) -> None:
+        t0 = time.time()
+        slot = self.slots[slot_id]
+        prompt = req.prompt[: self.max_seq - 1]
+        bucket = self._bucket_for(len(prompt))
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, : len(prompt)] = prompt
+
+        # slot sampling params must be set BEFORE prefill samples its first
+        # token, or the new request inherits the previous occupant's knobs
+        s = req.sampling
+        self._temp[slot_id] = s.temperature
+        self._topk[slot_id] = s.top_k
+        self._topp[slot_id] = s.top_p
+        self._params_dirty = True
+        self._refresh_sampling_arrays()
+
+        cache1 = self._prefill_cache_fn(1, self.max_seq)
+        next_tok, cache1 = self._prefill(
+            self.params,
+            jnp.asarray(padded),
+            jnp.int32(len(prompt)),
+            cache1,
+            self.base_keys[slot_id],
+            self._temp_dev[slot_id],
+            self._topk_dev[slot_id],
+            self._topp_dev[slot_id],
+        )
+        # insert the prefix cache into this slot's rows: cache leaves are
+        # [L, B, S, ...]; prefill produced [L, 1, S, ...]
+        self.cache = jax.tree.map(
+            lambda full, one: full.at[:, slot_id].set(one[:, 0]), self.cache, cache1
+        )
+
+        tok = int(next_tok)
+        slot.active = True
+        slot.request = req
+        slot.position = len(prompt)   # next write position = prompt length
+        slot.generated = []
+        slot.first_token_at = None
+        self.total_requests += 1
+
+        self.metrics.latencies["prefill_s"].observe(time.time() - t0)
+        self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
+        self._emit_token(slot_id, tok)
+
+    # --------------------------------------------------------------- decode
+
+    def _refresh_sampling_arrays(self) -> None:
+        if self._params_dirty or self._temp_dev is None:
+            self._temp_dev = jnp.asarray(self._temp)
+            self._topk_dev = jnp.asarray(self._topk)
+            self._topp_dev = jnp.asarray(self._topp)
+            self._params_dirty = False
+
+    def _step_decode(self) -> None:
+        tokens = np.zeros((self.max_batch, 1), np.int32)
+        positions = np.zeros((self.max_batch, 1), np.int32)
+        for i, s in enumerate(self.slots):
+            if s.active:
+                tokens[i, 0] = s.last_token
+                positions[i, 0] = s.position
+        self._refresh_sampling_arrays()
+        next_tok, self.cache = self._decode(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            self.cache, self.base_keys,
+            self._temp_dev, self._topk_dev, self._topp_dev,
+        )
+        next_host = np.asarray(jax.device_get(next_tok))
+        now = time.time()
+        for i, s in enumerate(self.slots):
+            if not s.active:
+                continue
+            s.position += 1
+            self._emit_token(i, int(next_host[i]), now)
+
+    def _emit_token(self, slot_id: int, token: int,
+                    now: Optional[float] = None) -> None:
+        """Record a sampled token for a slot, stream it, retire if finished."""
+        slot = self.slots[slot_id]
+        req = slot.request
+        now = now or time.time()
+        if slot.first_token_at is None:
+            slot.first_token_at = now
+            self.metrics.latencies["first_token_s"].observe(now - req.submitted_at)
+
+        finished_reason = None
+        if token == self.eos_id:
+            finished_reason = "eos"
+        else:
+            slot.generated.append(token)
+            slot.last_token = token
+            self.total_generated += 1
+            self.metrics.rates["tokens_generated"].mark(now)
+            if req.on_token is not None:
+                try:
+                    req.on_token(req.request_id, token)
+                except Exception:
+                    logger.exception("on_token callback failed")
+            if len(slot.generated) >= req.sampling.max_new_tokens:
+                finished_reason = "length"
+            elif slot.position >= self.max_seq:
+                # position is the NEXT write index; at max_seq the cache is full
+                finished_reason = "max_seq"
+
+        if finished_reason is not None:
+            self._retire(slot_id, finished_reason)
+
+    def _retire(self, slot_id: int, reason: str) -> None:
+        slot = self.slots[slot_id]
+        req = slot.request
+        slot.active = False
+        slot.request = None
+        self.metrics.counters["engine_completed"].inc()
+        self.metrics.rates["requests_completed"].mark()
+        if req and req.on_done is not None:
+            try:
+                req.on_done(req.request_id, list(slot.generated), reason)
+            except Exception:
+                logger.exception("on_done callback failed")
+
+    def _fail_all(self, reason: str) -> None:
+        for i, s in enumerate(self.slots):
+            if s.active:
+                self._retire(i, reason)
+        with self._cv:
+            pending = [item[3] for item in self._queue]
+            self._queue.clear()
+        for req in pending:
+            if req.on_done is not None:
+                try:
+                    req.on_done(req.request_id, [], reason)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------ info
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active_slots": sum(1 for s in self.slots if s.active),
+            "max_batch": self.max_batch,
+            "queued": len(self._queue),
+            "total_requests": self.total_requests,
+            "total_generated": self.total_generated,
+            "tokens_per_sec_60s": self.metrics.rates["tokens_generated"].rate(),
+            "latencies": {
+                k: self.metrics.latencies[k].summary()
+                for k in ("queue_wait_s", "prefill_s", "first_token_s")
+                if k in self.metrics.latencies
+            },
+        }

@@ -1,0 +1,75 @@
+"""Token sampling — fixed-shape, jit-compatible, per-slot parameters.
+
+Continuous batching means every decode step samples for B slots at once,
+each slot with its OWN temperature/top-k/top-p and its own PRNG stream. All
+branching is arithmetic (no Python control flow), so one compiled sampler
+serves every parameter combination (SURVEY §7 "masked sampling").
+
+Randomness: each slot has a base key; the key for a given step is
+``fold_in(base_key, position)`` — deterministic per (slot seed, position),
+so replays reproduce and no key state needs threading through the step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request knobs. temperature=0 means greedy (argmax)."""
+
+    temperature: float = 0.0
+    top_k: int = 0        # 0 = disabled
+    top_p: float = 1.0    # 1.0 = disabled
+    max_new_tokens: int = 128
+
+
+def make_slot_keys(seed: int, batch: int) -> jnp.ndarray:
+    """[B, 2] uint32 base keys, one per slot."""
+    base = jax.random.PRNGKey(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(batch))
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] fp32
+    base_keys: jnp.ndarray,     # [B, 2] uint32 per-slot base keys
+    positions: jnp.ndarray,     # [B] int32 current position (PRNG fold value)
+    temperature: jnp.ndarray,   # [B] fp32; 0 => greedy
+    top_k: jnp.ndarray,         # [B] int32; 0 => off
+    top_p: jnp.ndarray,         # [B] fp32; 1.0 => off
+) -> jnp.ndarray:
+    """Sample one token per row; greedy rows (temperature==0) take argmax.
+
+    Filtering: temperature-scale -> top-k mask -> top-p (nucleus) mask ->
+    categorical, all with static shapes.
+    """
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    # top-k: keep entries >= k-th largest (k<=0 disables)
+    k_eff = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    keep_k = scaled >= kth
+
+    # top-p: smallest prefix of the sorted distribution with mass >= top_p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    in_nucleus = (cum - probs_sorted) < top_p[:, None]   # always keeps argmax
+    cutoff = jnp.min(
+        jnp.where(in_nucleus, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    keep_p = scaled >= cutoff
+
+    filtered = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    step_keys = jax.vmap(jax.random.fold_in)(base_keys, positions)
+    sampled = jax.vmap(jax.random.categorical)(step_keys, filtered)
+
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,357 @@
+"""ServingService + TPUBackend consumer — the north-star graft point.
+
+The reference's LLM load balancer stops at a metadata map (agent →
+backend-id, ` main.py:1281-1325`); nothing ever dispatches. Here the map
+drives real serving (SURVEY §3.2 "graft point"):
+
+- A ``TPUBackendConsumer`` drains the broker partitions for THIS backend
+  (partition-affine, like any agent consumer) and turns chat /
+  function_call messages addressed to LLM-backed agents into engine
+  requests.
+- Replies are emitted back through ``SwarmDB.send_message`` as first-class
+  messages (type ``chat`` or ``function_result``), so lineage, stats,
+  persistence, and the wire API all see them.
+- ``stream_reply`` bridges the engine's per-token callbacks (engine
+  thread) to an ``asyncio`` queue for SSE streaming
+  (api/app.py ``_stream_reply``).
+- Per-stage timestamps land in ``Message.metadata["stages"]`` — the
+  tracing hook of SURVEY §5.1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.messages import Message, MessagePriority, MessageType
+from ..core.runtime import SwarmDB
+from .engine import Engine, GenRequest
+from .sampling import SamplingParams
+from .tokenizer import Tokenizer, default_tokenizer
+
+logger = logging.getLogger("swarmdb_tpu.serving")
+
+# module-level so repeated health() calls hit the jit cache instead of
+# recompiling (and leaking cache entries) per probe
+_HEALTH_PROBE = jax.jit(lambda x: (x * 2).sum())
+
+
+def build_prompt(db: SwarmDB, msg: Message, tokenizer: Tokenizer,
+                 history_limit: int = 8) -> List[int]:
+    """Chat-style prompt from the two-way conversation plus the new message.
+
+    For ``function_call`` messages the structured content (tool name/args)
+    is embedded as JSON — the Mixtral tool-use path (BASELINE config 4).
+    """
+    lines: List[str] = []
+    if msg.receiver_id:
+        convo = db.get_conversation(msg.sender_id, msg.receiver_id,
+                                    limit=history_limit)
+        for m in convo:
+            if m.id == msg.id:
+                continue
+            body = m.content if isinstance(m.content, str) else json.dumps(m.content)
+            lines.append(f"{m.sender_id}: {body}")
+    body = msg.content if isinstance(msg.content, str) else json.dumps(msg.content)
+    if msg.type == MessageType.FUNCTION_CALL:
+        lines.append(f"{msg.sender_id} [tool-call]: {body}")
+        lines.append(f"{msg.receiver_id} [tool-result]:")
+    else:
+        lines.append(f"{msg.sender_id}: {body}")
+        lines.append(f"{msg.receiver_id}:")
+    return tokenizer.encode("\n".join(lines))
+
+
+def sampling_from_message(msg: Message) -> SamplingParams:
+    """Sampling knobs ride in Message.metadata (free-form dict the reference
+    already reserves for annotations, ` main.py:80`)."""
+    g = msg.metadata.get("generation", {}) if isinstance(msg.metadata, dict) else {}
+    return SamplingParams(
+        temperature=float(g.get("temperature", 0.0)),
+        top_k=int(g.get("top_k", 0)),
+        top_p=float(g.get("top_p", 1.0)),
+        max_new_tokens=int(g.get("max_new_tokens", 64)),
+    )
+
+
+class ServingService:
+    """Owns one Engine + its broker consumer; routes messages → generation."""
+
+    def __init__(
+        self,
+        db: SwarmDB,
+        engine: Engine,
+        tokenizer: Tokenizer,
+        backend_id: str = "tpu-0",
+        poll_interval: float = 0.05,
+    ) -> None:
+        self.db = db
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.backend_id = backend_id
+        self.poll_interval = poll_interval
+        self._consumer_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    @classmethod
+    def from_model_name(
+        cls,
+        db: SwarmDB,
+        model_name: str,
+        backend_id: str = "tpu-0",
+        max_batch: int = 8,
+        max_seq: Optional[int] = None,
+        seed: int = 0,
+        tokenizer_path: Optional[str] = None,
+    ) -> "ServingService":
+        """Build model + engine for a registry config. Weights are randomly
+        initialized unless a checkpoint is loaded afterwards
+        (``utils/checkpoint.py``) — shapes/compute are identical either way.
+        """
+        from ..models import llama, mixtral
+        from ..models.configs import get_config
+
+        cfg = get_config(model_name)
+        seq = max_seq or min(cfg.max_seq_len, 1024)
+        key = jax.random.PRNGKey(seed)
+        if cfg.is_moe:
+            params = mixtral.init_params(cfg, key)
+            fwd = lambda p, t, pos, c: mixtral.forward(p, cfg, t, pos, c)
+            init_cache = lambda b, s: mixtral.init_kv_cache(cfg, b, s)
+        else:
+            params = llama.init_params(cfg, key)
+            fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+            init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+        tokenizer = default_tokenizer(cfg.vocab_size, tokenizer_path)
+        engine = Engine(
+            fwd, init_cache, params,
+            max_batch=max_batch, max_seq=seq,
+            eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
+            metrics=db.metrics,
+        )
+        return cls(db, engine, tokenizer, backend_id=backend_id)
+
+    def start(self) -> None:
+        self.engine.start()
+        if self._consumer_thread is None:
+            self._consumer_thread = threading.Thread(
+                target=self._consume_loop, daemon=True,
+                name=f"tpu-backend-{self.backend_id}",
+            )
+            self._consumer_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._consumer_thread is not None:
+            self._consumer_thread.join(timeout=10)
+            self._consumer_thread = None
+        self.engine.stop()
+
+    # --------------------------------------------------- broker consumption
+
+    def _consume_loop(self) -> None:
+        """Poll the inboxes of LLM-backed agents and serve new requests.
+
+        Uses the same partition-affine receive path as any agent
+        (SwarmDB.receive_messages), so backend serving respects broker
+        ordering, offsets, and visibility; one consumer per backend drains
+        all of its assigned agents.
+        """
+        while not self._stop.is_set():
+            agents = self.db.agents_for_backend(self.backend_id)
+            served = 0
+            for agent in agents:
+                if self._stop.is_set():
+                    break
+                try:
+                    msgs = self.db.receive_messages(agent, max_messages=8,
+                                                    timeout=0.0)
+                except Exception:
+                    logger.exception("backend receive failed for %s", agent)
+                    continue
+                for msg in msgs:
+                    if msg.type in (MessageType.CHAT, MessageType.FUNCTION_CALL):
+                        # one bad message must not kill the consumer thread
+                        try:
+                            self.serve_message(msg)
+                        except Exception:
+                            logger.exception("serve_message failed for %s", msg.id)
+                            self.db.update_message_status(msg.id, "failed")
+                            self.db.metrics.counters["backend_serve_errors"].inc()
+                        served += 1
+                    else:
+                        # non-servable types stay available via the inbox /
+                        # query APIs (a backend-owned agent's broker stream
+                        # belongs to the backend); count them for visibility
+                        logger.debug("backend skipping %s message %s for %s",
+                                     msg.type.value, msg.id, agent)
+                        self.db.metrics.counters["backend_skipped_messages"].inc()
+            if served == 0:
+                self._stop.wait(self.poll_interval)
+
+    # ------------------------------------------------------------- serving
+
+    def serve_message(
+        self,
+        msg: Message,
+        on_token=None,
+        on_done=None,
+    ) -> str:
+        """Submit one message for generation; reply is emitted on completion.
+        Returns the engine request id."""
+        msg.stage_stamp("admitted")
+        prompt = build_prompt(self.db, msg, self.tokenizer)
+        sampling = sampling_from_message(msg)
+        priority = int(msg.priority.value if hasattr(msg.priority, "value")
+                       else msg.priority)
+
+        def _done(rid: str, tokens: List[int], reason: str) -> None:
+            msg.stage_stamp("done")
+            text = self.tokenizer.decode(tokens)
+            reply_type = (
+                MessageType.FUNCTION_RESULT
+                if msg.type == MessageType.FUNCTION_CALL
+                else MessageType.CHAT
+            )
+            try:
+                reply_id = self.db.send_message(
+                    msg.receiver_id or self.backend_id,
+                    msg.sender_id,
+                    text,
+                    message_type=reply_type,
+                    priority=msg.priority,
+                    metadata={
+                        "reply_to": msg.id,
+                        "backend_id": self.backend_id,
+                        "finish_reason": reason,
+                        "completion_tokens": len(tokens),
+                    },
+                )
+                msg.metadata["reply_id"] = reply_id
+                self.db.mark_message_as_processed(msg.id)
+                # north-star gauge: completed chat messages/sec
+                self.db.metrics.rates["completed_messages"].mark()
+                self.db.metrics.counters["completed_messages"].inc()
+                lat = None
+                stages = msg.metadata.get("stages", {})
+                if "enqueued" in stages:
+                    lat = time.time() - stages["enqueued"]
+                    self.db.metrics.latencies["send_to_done_s"].observe(lat)
+            except Exception:
+                logger.exception("failed to emit reply for %s", msg.id)
+            if on_done is not None:
+                on_done(rid, tokens, reason)
+
+        def _tok(rid: str, token: int) -> None:
+            if "first_token" not in msg.metadata.get("stages", {}):
+                msg.stage_stamp("first_token")
+                stages = msg.metadata["stages"]
+                if "enqueued" in stages:
+                    self.db.metrics.latencies["send_to_first_token_s"].observe(
+                        stages["first_token"] - stages["enqueued"])
+            if on_token is not None:
+                on_token(rid, token)
+
+        req = GenRequest(
+            prompt=prompt, sampling=sampling, priority=priority,
+            on_token=_tok, on_done=_done,
+            metadata={"message_id": msg.id},
+        )
+        return self.engine.submit(req)
+
+    async def stream_reply(self, msg: Message) -> AsyncIterator[str]:
+        """Async token-text stream for SSE (api/app.py). Bridges engine-
+        thread callbacks into this loop's queue."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(rid: str, token: int) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, ("token", token))
+
+        def on_done(rid: str, tokens: List[int], reason: str) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, ("done", reason))
+
+        self.serve_message(msg, on_token=on_token, on_done=on_done)
+        pending: List[int] = []
+        while True:
+            kind, value = await q.get()
+            if kind == "token":
+                pending.append(value)
+                # decode greedily; UTF-8 continuation bytes may be incomplete,
+                # so flush only when decode round-trips cleanly
+                text = self.tokenizer.decode(pending)
+                if text and not text.endswith("�"):
+                    yield text
+                    pending = []
+            else:
+                if pending:
+                    yield self.tokenizer.decode(pending)
+                return
+
+    async def stream_group(self, msgs: List[Message]) -> AsyncIterator[Dict[str, Any]]:
+        """Fan-out streaming: serve every group message concurrently (they
+        occupy distinct engine slots => one data-parallel decode batch) and
+        interleave token events tagged by message id."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        remaining = 0
+
+        for msg in msgs:
+            if msg is None:
+                continue
+            remaining += 1
+
+            def mk(msg_id: str):
+                def on_token(rid: str, token: int) -> None:
+                    loop.call_soon_threadsafe(
+                        q.put_nowait,
+                        {"event": "token", "message_id": msg_id, "token": token},
+                    )
+
+                def on_done(rid: str, tokens: List[int], reason: str) -> None:
+                    loop.call_soon_threadsafe(
+                        q.put_nowait,
+                        {"event": "reply_done", "message_id": msg_id,
+                         "finish_reason": reason,
+                         "text": self.tokenizer.decode(tokens)},
+                    )
+
+                return on_token, on_done
+
+            on_token, on_done = mk(msg.id)
+            self.serve_message(msg, on_token=on_token, on_done=on_done)
+
+        while remaining > 0:
+            item = await q.get()
+            if item.get("event") == "reply_done":
+                remaining -= 1
+            yield item
+
+    # --------------------------------------------------------------- health
+
+    def health(self) -> Dict[str, Any]:
+        """Device liveness probe (SURVEY §5.3): run a tiny jitted op and
+        report engine state."""
+        try:
+            t0 = time.time()
+            val = _HEALTH_PROBE(jnp.ones((8, 8))).block_until_ready()
+            device_ok = bool(val == 128.0)
+            probe_ms = (time.time() - t0) * 1000
+        except Exception as exc:
+            return {"status": "unhealthy", "error": str(exc)}
+        return {
+            "status": "healthy" if device_ok else "degraded",
+            "device": str(jax.devices()[0]),
+            "probe_ms": round(probe_ms, 3),
+            "backend_id": self.backend_id,
+            "engine": self.engine.stats(),
+        }

@@ -375,9 +375,13 @@ class SwarmDB:
         deadline = time.time() + timeout
         while len(out) < max_messages:
             remaining = deadline - time.time()
-            if remaining <= 0:
-                break
-            rec = consumer.poll(min(remaining, self.config.consumer_timeout_ms / 1000.0))
+            # past the deadline, polls become non-blocking drains: the call
+            # keeps consuming records that are ALREADY available (bounded by
+            # max_messages) and exits on the first empty poll. timeout=0 is
+            # therefore "drain what's there without waiting".
+            rec = consumer.poll(
+                min(max(remaining, 0.0), self.config.consumer_timeout_ms / 1000.0)
+            )
             if rec is None:
                 break  # no data within poll window (reference breaks on EOF :566-568)
             try:

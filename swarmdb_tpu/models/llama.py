@@ -25,7 +25,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.layers import apply_rope, gqa_attention, rms_norm, swiglu, write_kv_cache
+from ..ops.layers import (
+    apply_rope,
+    gqa_attention,
+    rms_norm,
+    rope_cos_sin,
+    swiglu,
+    write_kv_cache,
+)
 from .configs import ModelConfig
 
 Params = Dict[str, Any]
@@ -133,6 +140,9 @@ def forward(
         raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral.forward")
     x = params["embed"][tokens]  # [B, T, D]; compute dtype = param dtype
     cache_k, cache_v = cache
+    # RoPE terms depend only on positions: compute once, reuse in every
+    # scanned layer (XLA can't hoist transcendentals out of the loop body)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     layer_params = params["layers"]
 
@@ -146,8 +156,8 @@ def forward(
             B, T, cfg.n_kv_heads, cfg.head_dim)
         v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
             B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
         ck, cv = write_kv_cache(ck, cv, k, v, positions)
         attn = gqa_attention(q, ck, cv, positions)
         attn_out = jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])

@@ -9,9 +9,9 @@ TPU notes:
   softmax run in fp32 for stability, logits are returned fp32.
 - all shapes are static under jit; the KV cache is a fixed [B, S, ...] slot
   buffer and validity is expressed by masking, never by dynamic shapes.
-- attention is plain einsum + masked softmax: XLA fuses this well on TPU;
-  the Pallas ragged/paged kernel in ``ops/pallas_attention.py`` replaces it
-  on the serving hot path when enabled.
+- attention is plain einsum + masked softmax: XLA fuses this well on TPU.
+  (A Pallas ragged/paged decode kernel is the planned replacement on the
+  serving hot path once it lands in ``ops/``.)
 """
 
 from __future__ import annotations
@@ -35,20 +35,28 @@ def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
     return 1.0 / (theta**exponent)
 
 
-def apply_rope(
-    x: jnp.ndarray, positions: jnp.ndarray, theta: float
-) -> jnp.ndarray:
-    """Rotary position embedding.
+def rope_cos_sin(
+    positions: jnp.ndarray, head_dim: int, theta: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute RoPE rotation terms for a batch of positions.
 
-    x: [B, T, H, D], positions: [B, T] (absolute token positions).
-    Pairs (x[..., :D/2], x[..., D/2:]) are rotated — the "split-half"
-    convention used by HF Llama, so checkpoints interoperate.
+    Returns (cos, sin), each [B, T, 1, D/2] fp32. Depends only on positions,
+    so callers compute it ONCE per forward and reuse it across every layer —
+    inside a scanned layer body XLA cannot hoist the transcendentals itself.
     """
-    d = x.shape[-1]
-    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    inv_freq = rope_frequencies(head_dim, theta)  # [D/2]
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, T, D/2]
-    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, D/2]
-    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotary position embedding with precomputed terms (`rope_cos_sin`).
+
+    x: [B, T, H, D]. Pairs (x[..., :D/2], x[..., D/2:]) are rotated — the
+    "split-half" convention used by HF Llama, so checkpoints interoperate.
+    """
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)

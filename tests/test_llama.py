@@ -94,6 +94,7 @@ def test_mixed_position_batch_decode(tiny_setup):
 
 def _hf_tiny_model(cfg):
     torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
     from transformers import LlamaConfig, LlamaForCausalLM
 
     hf_cfg = LlamaConfig(
@@ -150,6 +151,7 @@ def hf_to_params(model, cfg):
 def test_numerics_match_hf_reference():
     """Logits must match HF transformers' Llama (torch CPU) bit-for-nearly."""
     torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
     cfg = get_config("tiny-debug")
     model = _hf_tiny_model(cfg)
     params = hf_to_params(model, cfg)
