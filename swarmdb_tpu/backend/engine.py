@@ -8,9 +8,20 @@ fixed-shape decode loop under ``jax.jit`` with slot management —
 - ONE compiled decode step serves every population of slots: inactive slots
   run masked garbage that is ignored host-side (shapes never change, so XLA
   never recompiles).
+- Decode runs in CHUNKS of ``decode_chunk`` steps under one ``lax.scan``
+  per host round-trip: the sampled token feeds the next step entirely
+  on-device, and the host fetches a [K+1, B] token block with ONE sync.
+  This amortizes host<->device latency — on this image the TPU tunnel costs
+  ~80 ms per synchronous fetch, so per-token syncs would cap the whole
+  engine at ~12 steps/s regardless of batch. Slots that finish (EOS /
+  max_new_tokens) mid-chunk compute garbage for the remainder; the host
+  discards it. Their KV lanes are fully overwritten at next admission, so
+  the garbage is never read.
 - Prefill runs per-sequence at bucketed lengths (powers of two) to bound
   the number of compiled variants, then the prefix cache is inserted into
-  the slot's rows of the batch KV cache.
+  the slot's rows of the batch KV cache. Prefill never syncs: its sampled
+  first token is scattered into the on-device ``last_tokens`` vector and
+  reaches the host as row 0 of the next chunk's token block.
 - Admission is priority-ordered (MessagePriority: CRITICAL first — the
   reference stores priorities but never uses them, SURVEY §2.2).
 - Tokens stream to per-request callbacks as they are sampled; the HTTP
@@ -61,7 +72,7 @@ class _Slot:
     request: Optional[GenRequest] = None
     position: int = 0           # next absolute position to write
     generated: List[int] = field(default_factory=list)
-    last_token: int = 0
+    pending_first: bool = False  # prefill token not yet surfaced to host
     first_token_at: Optional[float] = None
 
 
@@ -82,6 +93,7 @@ class Engine:
         prefill_buckets: Optional[Sequence[int]] = None,
         metrics: Optional[MetricsRegistry] = None,
         donate_cache: bool = True,
+        decode_chunk: int = 8,
     ) -> None:
         self.forward_fn = forward_fn
         self.params = params
@@ -91,10 +103,15 @@ class Engine:
         self.pad_id = pad_id
         self.metrics = metrics or MetricsRegistry()
 
+        self.decode_chunk = max(1, int(decode_chunk))
         self.cache = init_cache_fn(max_batch, max_seq)
         self._prefill_cache_fn = init_cache_fn
         self.base_keys = make_slot_keys(seed, max_batch)
         self.slots = [_Slot() for _ in range(max_batch)]
+        # device-resident fed-token vector: slot i's next input token lives
+        # here between chunks so decode->decode and prefill->decode handoffs
+        # never touch the host
+        self._last_tokens = jnp.zeros((max_batch,), jnp.int32)
 
         if prefill_buckets is None:
             prefill_buckets = [
@@ -109,15 +126,14 @@ class Engine:
             prefill_buckets.append(max_seq - 1)
         self.prefill_buckets = prefill_buckets
 
-        # host-side mirrors of per-slot sampling params (device arrays built
-        # on change, not per step)
+        # host-side per-slot sampling params. These are handed to the jitted
+        # calls as RAW numpy arrays: on this image an explicit
+        # jnp.asarray(host) blocks ~400 ms on the TPU tunnel, while the same
+        # transfer folded into a jit call's argument path is ~0.1 ms — so
+        # the engine never calls jnp.asarray/device_put on the hot path.
         self._temp = np.zeros(max_batch, np.float32)
         self._topk = np.zeros(max_batch, np.int32)
         self._topp = np.ones(max_batch, np.float32)
-        self._params_dirty = True
-        self._temp_dev = None
-        self._topk_dev = None
-        self._topp_dev = None
 
         self._queue: List[Tuple[int, float, int, GenRequest]] = []  # heap
         self._tiebreak = itertools.count()
@@ -126,15 +142,27 @@ class Engine:
         self._thread: Optional[threading.Thread] = None
 
         donate = (3,) if donate_cache else ()
+        K = self.decode_chunk
 
-        # ---- compiled step: decode all slots by one token -----------------
-        def _decode(params, tokens, positions, cache, base_keys, temp, topk, topp):
-            # tokens [B,1], positions [B,1]
-            logits, cache = self.forward_fn(params, tokens, positions, cache)
-            next_tok = sample_tokens(
-                logits[:, -1], base_keys, positions[:, 0], temp, topk, topp
+        # ---- compiled chunk: K decode steps per host round-trip -----------
+        def _decode(params, last_tokens, positions, cache, base_keys, temp,
+                    topk, topp):
+            # last_tokens [B] fed tokens, positions [B] next write positions
+            def body(carry, _):
+                tok, pos, cache = carry
+                logits, cache = self.forward_fn(
+                    params, tok[:, None], pos[:, None], cache
+                )
+                nxt = sample_tokens(logits[:, -1], base_keys, pos, temp, topk, topp)
+                return (nxt, pos + 1, cache), nxt
+
+            (last, _, cache), sampled = jax.lax.scan(
+                body, (last_tokens, positions, cache), None, length=K
             )
-            return next_tok, cache
+            # row 0 = the fed tokens (surfaces prefill samples the host has
+            # never seen); rows 1..K = this chunk's samples
+            all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
+            return all_toks, last, cache
 
         self._decode = jax.jit(_decode, donate_argnums=donate)
 
@@ -152,6 +180,11 @@ class Engine:
             return next_tok[0], cache1
 
         self._prefill = jax.jit(_prefill)
+
+        # scatter one prefill token into the device fed-token vector (async)
+        self._set_last_token = jax.jit(
+            lambda lt, i, tok: lt.at[i].set(tok), donate_argnums=(0,)
+        )
 
         self.total_generated = 0
         self.total_requests = 0
@@ -223,10 +256,12 @@ class Engine:
             except Exception:
                 logger.exception("engine step failed; failing active requests")
                 self._fail_all("engine_error")
-                # the decode step donates the cache buffer: if it raised
-                # mid-step, self.cache may reference a deleted buffer —
-                # rebuild it so the engine survives the error
+                # the decode step donates the cache buffer (and the fed-token
+                # vector is donated through _set_last_token): if it raised
+                # mid-step they may reference deleted buffers — rebuild both
+                # so the engine survives the error
                 try:
+                    self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
                     self.cache = self._prefill_cache_fn(self.max_batch, self.max_seq)
                 except Exception:
                     logger.exception("cache re-init failed; stopping engine")
@@ -250,7 +285,18 @@ class Engine:
                 if not free or not self._queue:
                     return
                 _, _, _, req = heapq.heappop(self._queue)
-            self._prefill_into_slot(free[0], req)
+            try:
+                self._prefill_into_slot(free[0], req)
+            except Exception:
+                # the request is already off the queue and not yet in a slot:
+                # fail it here or its on_done would never fire (callers like
+                # generate_sync / SSE streams would hang to their timeouts)
+                logger.exception("prefill failed for %s", req.request_id)
+                if req.on_done is not None:
+                    try:
+                        req.on_done(req.request_id, [], "engine_error")
+                    except Exception:
+                        pass
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -261,7 +307,7 @@ class Engine:
     def _prefill_into_slot(self, slot_id: int, req: GenRequest) -> None:
         t0 = time.time()
         slot = self.slots[slot_id]
-        prompt = req.prompt[: self.max_seq - 1]
+        prompt = req.prompt  # submit() enforces len < max_seq
         bucket = self._bucket_for(len(prompt))
         padded = np.full((1, bucket), self.pad_id, np.int32)
         padded[0, : len(prompt)] = prompt
@@ -272,67 +318,84 @@ class Engine:
         self._temp[slot_id] = s.temperature
         self._topk[slot_id] = s.top_k
         self._topp[slot_id] = s.top_p
-        self._params_dirty = True
-        self._refresh_sampling_arrays()
 
         cache1 = self._prefill_cache_fn(1, self.max_seq)
         next_tok, cache1 = self._prefill(
             self.params,
-            jnp.asarray(padded),
-            jnp.int32(len(prompt)),
+            padded,                      # raw np: transfer rides the dispatch
+            np.int32(len(prompt)),
             cache1,
             self.base_keys[slot_id],
-            self._temp_dev[slot_id],
-            self._topk_dev[slot_id],
-            self._topp_dev[slot_id],
+            self._temp[slot_id],
+            self._topk[slot_id],
+            self._topp[slot_id],
         )
         # insert the prefix cache into this slot's rows: cache leaves are
-        # [L, B, S, ...]; prefill produced [L, 1, S, ...]
+        # [L, B, S, ...]; prefill produced [L, 1, S, ...]. The whole lane is
+        # overwritten, wiping any garbage a previous occupant left behind.
         self.cache = jax.tree.map(
             lambda full, one: full.at[:, slot_id].set(one[:, 0]), self.cache, cache1
         )
+        # NO host sync here (the tunnel costs ~80 ms per fetch): the sampled
+        # first token stays on device and surfaces as row 0 of the next
+        # chunk's token block.
+        self._last_tokens = self._set_last_token(
+            self._last_tokens, slot_id, next_tok
+        )
 
-        tok = int(next_tok)
         slot.active = True
         slot.request = req
         slot.position = len(prompt)   # next write position = prompt length
         slot.generated = []
+        slot.pending_first = True
         slot.first_token_at = None
         self.total_requests += 1
 
         self.metrics.latencies["prefill_s"].observe(time.time() - t0)
         self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
-        self._emit_token(slot_id, tok)
 
     # --------------------------------------------------------------- decode
 
-    def _refresh_sampling_arrays(self) -> None:
-        if self._params_dirty or self._temp_dev is None:
-            self._temp_dev = jnp.asarray(self._temp)
-            self._topk_dev = jnp.asarray(self._topk)
-            self._topp_dev = jnp.asarray(self._topp)
-            self._params_dirty = False
-
     def _step_decode(self) -> None:
-        tokens = np.zeros((self.max_batch, 1), np.int32)
-        positions = np.zeros((self.max_batch, 1), np.int32)
+        """Run one K-step decode chunk and process its token block.
+
+        ONE host sync per chunk: the [K+1, B] token block. Token (s+1, i)
+        was sampled at write position ``pos0_i + s`` — emission stops at a
+        slot's EOS / max_new_tokens / max_seq and the remainder of its lane
+        is discarded garbage.
+        """
+        positions = np.zeros((self.max_batch,), np.int32)
+        pos0 = [0] * self.max_batch
         for i, s in enumerate(self.slots):
             if s.active:
-                tokens[i, 0] = s.last_token
-                positions[i, 0] = s.position
-        self._refresh_sampling_arrays()
-        next_tok, self.cache = self._decode(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                positions[i] = s.position
+                pos0[i] = s.position
+        all_toks, self._last_tokens, self.cache = self._decode(
+            self.params, self._last_tokens, positions,
             self.cache, self.base_keys,
-            self._temp_dev, self._topk_dev, self._topp_dev,
+            self._temp, self._topk, self._topp,
         )
-        next_host = np.asarray(jax.device_get(next_tok))
+        block = np.asarray(jax.device_get(all_toks))  # [K+1, B] — the one sync
         now = time.time()
+        K = self.decode_chunk
         for i, s in enumerate(self.slots):
             if not s.active:
                 continue
-            s.position += 1
-            self._emit_token(i, int(next_host[i]), now)
+            if s.pending_first:
+                # row 0 is the fed token == this slot's prefill sample,
+                # which the host deliberately never fetched at admission
+                s.pending_first = False
+                self._emit_token(i, int(block[0, i]), now)
+            for step in range(K):
+                if not s.active:
+                    break
+                if pos0[i] + step >= self.max_seq:
+                    # the cache lane is full; later writes were dropped
+                    self._retire(i, "max_seq")
+                    break
+                self._emit_token(i, int(block[step + 1, i]), now)
+            if s.active:
+                s.position = pos0[i] + K
 
     def _emit_token(self, slot_id: int, token: int,
                     now: Optional[float] = None) -> None:
@@ -349,7 +412,6 @@ class Engine:
             finished_reason = "eos"
         else:
             slot.generated.append(token)
-            slot.last_token = token
             self.total_generated += 1
             self.metrics.rates["tokens_generated"].mark(now)
             if req.on_token is not None:
@@ -359,9 +421,6 @@ class Engine:
                     logger.exception("on_token callback failed")
             if len(slot.generated) >= req.sampling.max_new_tokens:
                 finished_reason = "length"
-            elif slot.position >= self.max_seq:
-                # position is the NEXT write index; at max_seq the cache is full
-                finished_reason = "max_seq"
 
         if finished_reason is not None:
             self._retire(slot_id, finished_reason)

@@ -62,7 +62,10 @@ def sample_tokens(
     # top-p: smallest prefix of the sorted distribution with mass >= top_p
     probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
     cum = jnp.cumsum(probs_sorted, axis=-1)
-    in_nucleus = (cum - probs_sorted) < top_p[:, None]   # always keeps argmax
+    in_nucleus = (cum - probs_sorted) < top_p[:, None]
+    # the argmax must survive any top_p (even <= 0, which would otherwise
+    # empty the nucleus and make every row sample token 0)
+    in_nucleus = in_nucleus.at[:, 0].set(True)
     cutoff = jnp.min(
         jnp.where(in_nucleus, sorted_desc, jnp.inf), axis=-1, keepdims=True
     )

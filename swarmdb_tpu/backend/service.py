@@ -73,11 +73,12 @@ def sampling_from_message(msg: Message) -> SamplingParams:
     """Sampling knobs ride in Message.metadata (free-form dict the reference
     already reserves for annotations, ` main.py:80`)."""
     g = msg.metadata.get("generation", {}) if isinstance(msg.metadata, dict) else {}
+    # clamp untrusted wire input to sane ranges
     return SamplingParams(
-        temperature=float(g.get("temperature", 0.0)),
-        top_k=int(g.get("top_k", 0)),
-        top_p=float(g.get("top_p", 1.0)),
-        max_new_tokens=int(g.get("max_new_tokens", 64)),
+        temperature=max(0.0, float(g.get("temperature", 0.0))),
+        top_k=max(0, int(g.get("top_k", 0))),
+        top_p=min(1.0, max(1e-3, float(g.get("top_p", 1.0)))),
+        max_new_tokens=min(4096, max(1, int(g.get("max_new_tokens", 64)))),
     )
 
 
@@ -112,6 +113,7 @@ class ServingService:
         max_seq: Optional[int] = None,
         seed: int = 0,
         tokenizer_path: Optional[str] = None,
+        decode_chunk: int = 8,
     ) -> "ServingService":
         """Build model + engine for a registry config. Weights are randomly
         initialized unless a checkpoint is loaded afterwards
@@ -136,7 +138,7 @@ class ServingService:
             fwd, init_cache, params,
             max_batch=max_batch, max_seq=seq,
             eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
-            metrics=db.metrics,
+            metrics=db.metrics, decode_chunk=decode_chunk,
         )
         return cls(db, engine, tokenizer, backend_id=backend_id)
 
@@ -211,6 +213,14 @@ class ServingService:
         msg.stage_stamp("admitted")
         prompt = build_prompt(self.db, msg, self.tokenizer)
         sampling = sampling_from_message(msg)
+        # Long-running conversations grow the prompt without bound; keep the
+        # TAIL (most recent turns) so a pair's history can never exceed the
+        # engine's window and brick the conversation (engine.submit rejects
+        # len >= max_seq outright).
+        budget = max(16, self.engine.max_seq - 1 - sampling.max_new_tokens)
+        budget = min(budget, self.engine.max_seq - 1)
+        if len(prompt) > budget:
+            prompt = prompt[-budget:]
         priority = int(msg.priority.value if hasattr(msg.priority, "value")
                        else msg.priority)
 

@@ -103,6 +103,10 @@ class SwarmDB:
         self.consumers: Dict[str, Consumer] = {}
         self.messages: Dict[str, Message] = {}
         self.agent_inbox: Dict[str, List[Message]] = {}
+        # unicast (a,b)-pair index so get_conversation — the prompt-builder
+        # hot path, called once per served LLM message — is O(limit), not an
+        # O(N log N) scan over every message (reference ` main.py:770-808`)
+        self._conversations: Dict[tuple, List[Message]] = {}
         self.agent_metadata: Dict[str, Dict[str, Any]] = {}
         self.metadata: Dict[str, Any] = {
             "agent_groups": {},  # reference stores groups here (` main.py:1208-1227`)
@@ -283,6 +287,8 @@ class SwarmDB:
             self._stats_record_new(msg)
             if receiver_id is not None:
                 self.agent_inbox.setdefault(receiver_id, []).append(msg)
+                pair = (min(sender_id, receiver_id), max(sender_id, receiver_id))
+                self._conversations.setdefault(pair, []).append(msg)
             else:
                 for agent in msg.visible_to:
                     self.agent_inbox.setdefault(agent, []).append(msg)
@@ -375,10 +381,13 @@ class SwarmDB:
         deadline = time.time() + timeout
         while len(out) < max_messages:
             remaining = deadline - time.time()
-            # past the deadline, polls become non-blocking drains: the call
-            # keeps consuming records that are ALREADY available (bounded by
-            # max_messages) and exits on the first empty poll. timeout=0 is
-            # therefore "drain what's there without waiting".
+            # timeout>0 honors the wall clock strictly — even a partition
+            # backlog of records filtered out below (other recipients,
+            # already-read broadcasts) cannot extend the call past the
+            # deadline. timeout<=0 is "drain what's already there" and exits
+            # on the first empty non-blocking poll.
+            if timeout > 0 and remaining <= 0:
+                break
             rec = consumer.poll(
                 min(max(remaining, 0.0), self.config.consumer_timeout_ms / 1000.0)
             )
@@ -518,10 +527,14 @@ class SwarmDB:
         ``limit`` per direction and trim the merge)."""
         if limit <= 0:
             return []
-        a_to_b = self.query_messages(sender_id=agent_a, receiver_id=agent_b, limit=limit)
-        b_to_a = self.query_messages(sender_id=agent_b, receiver_id=agent_a, limit=limit)
-        merged = sorted(a_to_b + b_to_a, key=lambda m: m.timestamp)
-        return merged[-limit:]
+        pair = (min(agent_a, agent_b), max(agent_a, agent_b))
+        with self._lock:
+            # the index is appended in send order (and rebuilt sorted on
+            # load), so the tail slice IS the newest window — O(limit), not
+            # O(history); sort only the slice to guard clock skew
+            tail = self._conversations.get(pair, ())[-limit:]
+            tail = list(tail)
+        return sorted(tail, key=lambda m: m.timestamp)
 
     # ------------------------------------------------------------- status mgmt
 
@@ -721,6 +734,12 @@ class SwarmDB:
             self._stats_record_removed(msg)
             for inbox in self.agent_inbox.values():
                 inbox[:] = [m for m in inbox if m.id != message_id]
+            if msg.receiver_id is not None:
+                pair = (min(msg.sender_id, msg.receiver_id),
+                        max(msg.sender_id, msg.receiver_id))
+                convo = self._conversations.get(pair)
+                if convo is not None:
+                    convo[:] = [m for m in convo if m.id != message_id]
             return True
 
     def flush_old_messages(self, max_age_seconds: float = 7 * 24 * 3600) -> int:
@@ -778,8 +797,13 @@ class SwarmDB:
         self._stats_by_type = {}
         self._stats_by_status = {}
         self._stats_by_agent = {}
-        for m in self.messages.values():
+        self._conversations = {}
+        for m in sorted(self.messages.values(), key=lambda m: m.timestamp):
             self._stats_record_new(m)
+            if m.receiver_id is not None:
+                pair = (min(m.sender_id, m.receiver_id),
+                        max(m.sender_id, m.receiver_id))
+                self._conversations.setdefault(pair, []).append(m)
 
     def get_stats(self) -> Dict[str, Any]:
         """Totals by type/status/agent (reference ` main.py:973-1024`) — O(1)

@@ -1,0 +1,37 @@
+"""Parallelism layer: named-mesh construction + sharded serving.
+
+Supplies the DP/TP/EP strategies the reference lacks entirely
+(SURVEY §2.4) via `jax.sharding` + GSPMD collectives over ICI/DCN.
+"""
+
+from .mesh import (
+    MESH_AXES,
+    make_mesh,
+    plan_mesh_shape,
+    replicated,
+    shard_pytree,
+    tree_shardings,
+)
+from .serving import (
+    CACHE_SPEC,
+    TOKEN_SPEC,
+    ShardedModel,
+    build_serving_engine,
+    build_sharded_model,
+    param_shardings_for,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "make_mesh",
+    "plan_mesh_shape",
+    "replicated",
+    "shard_pytree",
+    "tree_shardings",
+    "CACHE_SPEC",
+    "TOKEN_SPEC",
+    "ShardedModel",
+    "build_sharded_model",
+    "build_serving_engine",
+    "param_shardings_for",
+]

@@ -1,0 +1,128 @@
+"""Device-mesh construction and pytree sharding helpers.
+
+The reference has no parallelism layer at all (SURVEY §2.4: no DP/TP/EP, no
+collectives — its only concurrency is gunicorn process parallelism). This
+module supplies the TPU-native equivalent the north star demands: a named
+`jax.sharding.Mesh` with ``('data', 'model', 'expert')`` axes where
+
+- **data**  = broker partitions map 1:1 onto this axis (DP; group fan-out
+  becomes one data-parallel decode batch over ICI — BASELINE config 3),
+- **model** = Megatron-style tensor parallelism for Llama-3-70B
+  (BASELINE config 5, v5p-16),
+- **expert**= expert parallelism for Mixtral-8x7B (BASELINE config 4);
+  the capacity-based dispatch/combine einsums in models/mixtral.py lower
+  to all-to-alls over this axis.
+
+All collectives are emitted by GSPMD from `NamedSharding` annotations —
+never hand-written (SURVEY §5.8: ICI within a slice, DCN across hosts via
+`jax.distributed.initialize`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+MESH_AXES = ("data", "model", "expert")
+
+
+def _divisor_leq(n: int, cap: int) -> int:
+    """Largest divisor of n that is <= cap."""
+    best = 1
+    for d in range(1, min(n, cap) + 1):
+        if n % d == 0:
+            best = d
+    return best
+
+
+def plan_mesh_shape(
+    n_devices: int,
+    *,
+    max_model: int = 8,
+    max_expert: int = 8,
+    want_model: Optional[int] = None,
+    want_expert: Optional[int] = None,
+) -> Dict[str, int]:
+    """Factor ``n_devices`` into {data, model, expert} axis sizes.
+
+    Model (TP) degree is bounded by the smallest sharded weight dimension
+    (n_kv_heads for the KV cache — 8 for every north-star model), expert
+    degree by n_experts (8 for Mixtral). Remaining factor goes to data
+    (DP), which has no divisibility ceiling — it is the partition axis.
+    """
+    model = want_model if want_model else _divisor_leq(n_devices, max_model)
+    rest = n_devices // model
+    if n_devices % model:
+        raise ValueError(f"model axis {model} does not divide {n_devices}")
+    expert = want_expert if want_expert else _divisor_leq(rest, max_expert)
+    if rest % expert:
+        raise ValueError(f"expert axis {expert} does not divide {rest}")
+    return {"data": rest // expert, "model": model, "expert": expert}
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    data: Optional[int] = None,
+    model: Optional[int] = None,
+    expert: Optional[int] = None,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build a named 3-axis mesh over the available devices.
+
+    With explicit axis sizes they are used verbatim (their product must
+    equal the device count); otherwise `plan_mesh_shape` factorizes.
+    On multi-host deployments call `jax.distributed.initialize()` first;
+    `jax.devices()` then spans all hosts and ICI/DCN placement is handled
+    by `mesh_utils.create_device_mesh`.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    if data and model and expert:
+        shape = {"data": data, "model": model, "expert": expert}
+    else:
+        shape = plan_mesh_shape(n, want_model=model, want_expert=expert)
+        if data is not None and shape["data"] != data:
+            raise ValueError(f"requested data={data}, planned {shape}")
+    sizes = tuple(shape[a] for a in MESH_AXES)
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=list(devices))
+    except Exception:
+        dev_array = np.asarray(list(devices)).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def _is_spec(x: Any) -> bool:
+    return isinstance(x, P)
+
+
+def tree_shardings(mesh: Mesh, specs: Any) -> Any:
+    """Map a pytree of PartitionSpecs to NamedShardings (specs are tuples,
+    so the tree map must treat them as leaves)."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=_is_spec)
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a (host or single-device) pytree onto the mesh per specs."""
+    return jax.device_put(tree, tree_shardings(mesh, specs))
+
+
+def replicated(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

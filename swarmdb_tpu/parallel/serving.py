@@ -1,0 +1,161 @@
+"""Sharded serving: place a model family onto a mesh and expose the same
+``(forward_fn, init_cache_fn, params)`` contract the continuous-batching
+Engine consumes — multi-chip serving drops into the single-chip engine
+unchanged.
+
+Parallelism mapping (SURVEY §2.4 table):
+- DP: batch slots (= broker partitions) shard over ``data``.
+- TP: Megatron column/row sharding from ``models/*.param_specs`` over
+  ``model``; GSPMD inserts one all-reduce per attention/MLP block.
+- EP: Mixtral expert weights shard over ``expert``; token dispatch/combine
+  einsums lower to all-to-alls.
+
+Params are initialized *directly sharded* (``jax.jit`` with
+``out_shardings``) so no host ever materializes the full 70B weight tree —
+the same path an orbax sharded-checkpoint restore takes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models import llama, mixtral
+from ..models.configs import ModelConfig, get_config
+from .mesh import make_mesh, tree_shardings
+
+# Activations/tokens shard batch over data; cache shards batch over data and
+# KV heads over model (models/llama.py `cache_specs`).
+TOKEN_SPEC = P("data", None)
+CACHE_SPEC = P(None, "data", None, "model", None)
+
+
+@dataclass
+class ShardedModel:
+    """A model family placed on a mesh, Engine-ready."""
+
+    cfg: ModelConfig
+    mesh: Mesh
+    params: Any
+    forward_fn: Callable  # (params, tokens, positions, cache) -> (logits, cache)
+    init_cache_fn: Callable  # (batch, max_seq) -> cache pytree
+    param_shardings: Any
+
+    @property
+    def data_size(self) -> int:
+        return self.mesh.shape["data"]
+
+
+def _family(cfg: ModelConfig):
+    return mixtral if cfg.is_moe else llama
+
+
+def param_shardings_for(cfg: ModelConfig, mesh: Mesh) -> Any:
+    fam = _family(cfg)
+    if cfg.is_moe:
+        specs = fam.param_specs(cfg, model_axis="model", expert_axis="expert")
+    else:
+        specs = fam.param_specs(cfg, model_axis="model")
+    return tree_shardings(mesh, specs)
+
+
+def build_sharded_model(
+    model_name_or_cfg: Any,
+    mesh: Optional[Mesh] = None,
+    *,
+    seed: int = 0,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> ShardedModel:
+    """Init params sharded over the mesh and return Engine-compatible fns.
+
+    ``forward_fn`` pins activation and cache shardings with
+    ``with_sharding_constraint`` so the Engine's own ``jax.jit`` wrapper
+    (engine.py `_decode`/`_prefill`) compiles to the intended SPMD program
+    without knowing about the mesh.
+    """
+    cfg = (
+        model_name_or_cfg
+        if isinstance(model_name_or_cfg, ModelConfig)
+        else get_config(model_name_or_cfg)
+    )
+    mesh = mesh or make_mesh()
+    fam = _family(cfg)
+    shardings = param_shardings_for(cfg, mesh)
+
+    init = jax.jit(
+        partial(fam.init_params, cfg, dtype=dtype), out_shardings=shardings
+    )
+    params = init(jax.random.PRNGKey(seed))
+
+    cache_sharding = NamedSharding(mesh, CACHE_SPEC)
+    token_sharding = NamedSharding(mesh, TOKEN_SPEC)
+
+    def forward_fn(p, tokens, positions, cache):
+        # Prefill runs [1, T] (batch < data axis): leave the compiler free
+        # there; constrain only when the batch divides the data axis.
+        constrain = tokens.shape[0] % mesh.shape["data"] == 0
+        if constrain:
+            tokens = jax.lax.with_sharding_constraint(tokens, token_sharding)
+            positions = jax.lax.with_sharding_constraint(positions, token_sharding)
+            cache = jax.tree.map(
+                lambda c: jax.lax.with_sharding_constraint(c, cache_sharding), cache
+            )
+        logits, cache = fam.forward(p, cfg, tokens, positions, cache)
+        if constrain:
+            cache = jax.tree.map(
+                lambda c: jax.lax.with_sharding_constraint(c, cache_sharding), cache
+            )
+        return logits, cache
+
+    def init_cache_fn(batch: int, max_seq: int):
+        shape_fn = partial(fam.init_kv_cache, cfg, batch, max_seq)
+        if batch % mesh.shape["data"] == 0:
+            out_sh = jax.tree.map(lambda _: cache_sharding, jax.eval_shape(shape_fn))
+            return jax.jit(shape_fn, out_shardings=out_sh)()
+        return shape_fn()
+
+    return ShardedModel(
+        cfg=cfg,
+        mesh=mesh,
+        params=params,
+        forward_fn=forward_fn,
+        init_cache_fn=init_cache_fn,
+        param_shardings=shardings,
+    )
+
+
+def build_serving_engine(
+    model_name_or_cfg: Any,
+    mesh: Optional[Mesh] = None,
+    *,
+    max_batch: Optional[int] = None,
+    max_seq: int = 1024,
+    seed: int = 0,
+    **engine_kwargs: Any,
+):
+    """One-call multi-chip engine: sharded model + continuous batching.
+
+    ``max_batch`` defaults to 8 slots per data shard so every decode step
+    is a full data-parallel batch over ICI (SURVEY §3.4).
+    """
+    from ..backend.engine import Engine
+
+    sm = build_sharded_model(model_name_or_cfg, mesh, seed=seed)
+    if max_batch is None:
+        max_batch = 8 * sm.data_size
+    engine = Engine(
+        sm.forward_fn,
+        sm.init_cache_fn,
+        sm.params,
+        max_batch=max_batch,
+        max_seq=max_seq,
+        seed=seed,
+        **engine_kwargs,
+    )
+    return engine, sm
