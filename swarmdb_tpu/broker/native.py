@@ -1,0 +1,242 @@
+"""NativeBroker — ctypes binding over the C++ partitioned log engine.
+
+Implements the same ``Broker`` ABC as ``LocalBroker`` on top of
+``cpp/libswarmbroker.so`` (built by ``cpp/Makefile``; ``build_native()``
+invokes make on demand). This is the in-tree replacement for the
+reference's only native dependency, librdkafka + the external
+Kafka/Zookeeper containers (SURVEY §2.3; reference ` main.py:12-18`,
+`dockerfile-compose.yaml:5-48`): durable partitioned logs, consumer-group
+offsets, retention, and blocking consumption — no external brokers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import struct
+import subprocess
+import tempfile
+from typing import Dict, List, Optional
+
+from .base import Broker, BrokerError, Record, TopicMeta, UnknownTopicError
+
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libswarmbroker.so")
+
+_REC_HDR = struct.Struct("<qdii")  # offset, ts, key_len, val_len
+
+
+def build_native(force: bool = False) -> bool:
+    """Build the shared library if needed; True if it is now present."""
+    if not force and os.path.exists(_LIB_PATH):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-s", "libswarmbroker.so"],
+            cwd=_CPP_DIR, check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def native_available(autobuild: bool = True) -> bool:
+    if os.path.exists(_LIB_PATH):
+        return True
+    return build_native() if autobuild else False
+
+
+_lib = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not native_available():
+        raise ImportError("libswarmbroker.so not built (run make in broker/cpp)")
+    lib = ctypes.CDLL(_LIB_PATH)
+    c = ctypes.c_char_p
+    lib.swb_open.restype = ctypes.c_void_p
+    lib.swb_open.argtypes = [c]
+    lib.swb_shutdown.argtypes = [ctypes.c_void_p]
+    lib.swb_create_topic.restype = ctypes.c_int
+    lib.swb_create_topic.argtypes = [ctypes.c_void_p, c, ctypes.c_int,
+                                     ctypes.c_longlong]
+    lib.swb_list_topics_json.restype = ctypes.POINTER(ctypes.c_char)
+    lib.swb_list_topics_json.argtypes = [ctypes.c_void_p]
+    lib.swb_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.swb_create_partitions.restype = ctypes.c_int
+    lib.swb_create_partitions.argtypes = [ctypes.c_void_p, c, ctypes.c_int]
+    lib.swb_append.restype = ctypes.c_longlong
+    lib.swb_append.argtypes = [ctypes.c_void_p, c, ctypes.c_int, c,
+                               ctypes.c_int, c, ctypes.c_int, ctypes.c_double]
+    lib.swb_fetch.restype = ctypes.c_longlong
+    lib.swb_fetch.argtypes = [ctypes.c_void_p, c, ctypes.c_int,
+                              ctypes.c_longlong, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_uint8),
+                              ctypes.c_longlong,
+                              ctypes.POINTER(ctypes.c_int)]
+    lib.swb_end_offset.restype = ctypes.c_longlong
+    lib.swb_end_offset.argtypes = [ctypes.c_void_p, c, ctypes.c_int]
+    lib.swb_begin_offset.restype = ctypes.c_longlong
+    lib.swb_begin_offset.argtypes = [ctypes.c_void_p, c, ctypes.c_int]
+    lib.swb_wait_for_data.restype = ctypes.c_int
+    lib.swb_wait_for_data.argtypes = [ctypes.c_void_p, c, ctypes.c_int,
+                                      ctypes.c_longlong, ctypes.c_double]
+    lib.swb_commit_offset.argtypes = [ctypes.c_void_p, c, c, ctypes.c_int,
+                                      ctypes.c_longlong]
+    lib.swb_committed_offset.restype = ctypes.c_longlong
+    lib.swb_committed_offset.argtypes = [ctypes.c_void_p, c, c, ctypes.c_int]
+    lib.swb_trim_older_than.restype = ctypes.c_longlong
+    lib.swb_trim_older_than.argtypes = [ctypes.c_void_p, c, ctypes.c_double]
+    lib.swb_flush.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeBroker(Broker):
+    """Durable partitioned-log broker backed by the C++ engine."""
+
+    def __init__(self, log_dir: Optional[str] = None) -> None:
+        self._lib = _load()
+        if log_dir is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="swarmbroker_")
+            log_dir = self._tmp.name
+        else:
+            self._tmp = None
+            os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        self._h = self._lib.swb_open(log_dir.encode())
+        if not self._h:
+            raise BrokerError(f"swb_open failed for {log_dir}")
+        self._fetch_cap = 1 << 20
+        self._closed = False
+
+    # -- admin ---------------------------------------------------------------
+
+    def create_topic(self, name: str, num_partitions: int,
+                     retention_ms: int = 7 * 24 * 3600 * 1000) -> bool:
+        r = self._lib.swb_create_topic(
+            self._h, name.encode(), num_partitions, retention_ms
+        )
+        if r < 0:
+            raise BrokerError(f"create_topic({name}) failed")
+        return r == 1
+
+    def list_topics(self) -> Dict[str, TopicMeta]:
+        p = self._lib.swb_list_topics_json(self._h)
+        try:
+            raw = ctypes.cast(p, ctypes.c_char_p).value or b"{}"
+        finally:
+            self._lib.swb_free_buf(p)
+        return {
+            name: TopicMeta(name, nparts, ret)
+            for name, (nparts, ret) in json.loads(raw.decode()).items()
+        }
+
+    def create_partitions(self, name: str, new_total: int) -> None:
+        if self._lib.swb_create_partitions(self._h, name.encode(), new_total) < 0:
+            raise UnknownTopicError(name)
+
+    # -- data plane ----------------------------------------------------------
+
+    def append(self, topic: str, partition: int, value: bytes,
+               key: Optional[bytes] = None,
+               timestamp: Optional[float] = None) -> int:
+        import time as _t
+
+        off = self._lib.swb_append(
+            self._h, topic.encode(), partition,
+            key, -1 if key is None else len(key),
+            value, len(value),
+            timestamp if timestamp is not None else _t.time(),
+        )
+        if off < 0:
+            raise UnknownTopicError(f"{topic}[{partition}]")
+        return int(off)
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_records: int = 256) -> List[Record]:
+        while True:
+            buf = (ctypes.c_uint8 * self._fetch_cap)()
+            count = ctypes.c_int(0)
+            n = self._lib.swb_fetch(
+                self._h, topic.encode(), partition, offset, max_records,
+                buf, self._fetch_cap, ctypes.byref(count),
+            )
+            if n == -1:
+                raise UnknownTopicError(f"{topic}[{partition}]")
+            if n < -1:  # first record needs -n bytes
+                self._fetch_cap = max(self._fetch_cap * 2, int(-n))
+                continue
+            break
+        out: List[Record] = []
+        raw = bytes(buf[: int(n)])
+        pos = 0
+        for _ in range(count.value):
+            off, ts, klen, vlen = _REC_HDR.unpack_from(raw, pos)
+            pos += _REC_HDR.size
+            key = None
+            if klen >= 0:
+                key = raw[pos: pos + klen]
+                pos += klen
+            value = raw[pos: pos + vlen]
+            pos += vlen
+            out.append(Record(topic, partition, off, key, value, ts))
+        return out
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        off = self._lib.swb_end_offset(self._h, topic.encode(), partition)
+        if off < 0:
+            raise UnknownTopicError(f"{topic}[{partition}]")
+        return int(off)
+
+    def begin_offset(self, topic: str, partition: int) -> int:
+        off = self._lib.swb_begin_offset(self._h, topic.encode(), partition)
+        if off < 0:
+            raise UnknownTopicError(f"{topic}[{partition}]")
+        return int(off)
+
+    def wait_for_data(self, topic: str, partition: int, offset: int,
+                      timeout_s: float) -> bool:
+        return self._lib.swb_wait_for_data(
+            self._h, topic.encode(), partition, offset, timeout_s
+        ) == 1
+
+    # -- consumer-group offsets ---------------------------------------------
+
+    def commit_offset(self, group: str, topic: str, partition: int,
+                      offset: int) -> None:
+        self._lib.swb_commit_offset(
+            self._h, group.encode(), topic.encode(), partition, offset
+        )
+
+    def committed_offset(self, group: str, topic: str,
+                         partition: int) -> Optional[int]:
+        off = self._lib.swb_committed_offset(
+            self._h, group.encode(), topic.encode(), partition
+        )
+        return None if off < 0 else int(off)
+
+    # -- retention / durability ---------------------------------------------
+
+    def trim_older_than(self, topic: str, cutoff_ts: float) -> int:
+        n = self._lib.swb_trim_older_than(self._h, topic.encode(), cutoff_ts)
+        if n < 0:
+            raise UnknownTopicError(topic)
+        return int(n)
+
+    def flush(self) -> None:
+        self._lib.swb_flush(self._h)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.swb_flush(self._h)
+        self._lib.swb_shutdown(self._h)
+        self._h = None
+        if self._tmp is not None:
+            self._tmp.cleanup()

@@ -151,6 +151,11 @@ class SwarmDB:
             logger.warning("token counter failed: %s", exc)
             return None
 
+    @staticmethod
+    def _pair(a: str, b: str) -> tuple:
+        """Canonical key for the unicast conversation index."""
+        return (a, b) if a <= b else (b, a)
+
     def _get_partition(self, agent_id: str) -> int:
         """Stable agent → partition mapping (fixes defect D6;
         reference ` main.py:309-312`)."""
@@ -287,7 +292,7 @@ class SwarmDB:
             self._stats_record_new(msg)
             if receiver_id is not None:
                 self.agent_inbox.setdefault(receiver_id, []).append(msg)
-                pair = (min(sender_id, receiver_id), max(sender_id, receiver_id))
+                pair = self._pair(sender_id, receiver_id)
                 self._conversations.setdefault(pair, []).append(msg)
             else:
                 for agent in msg.visible_to:
@@ -424,6 +429,12 @@ class SwarmDB:
                     self.messages[msg.id] = msg
                     self.agent_inbox.setdefault(agent_id, []).append(msg)
                     self._stats_record_new(msg)
+                    if msg.receiver_id is not None:
+                        # keep the conversation index complete across
+                        # workers, or build_prompt drops adopted turns
+                        self._conversations.setdefault(
+                            self._pair(msg.sender_id, msg.receiver_id), []
+                        ).append(msg)
             out.append(target)
             self.metrics.counters["messages_received"].inc()
             self.metrics.rates[f"agent_recv:{agent_id}"].mark()
@@ -527,7 +538,7 @@ class SwarmDB:
         ``limit`` per direction and trim the merge)."""
         if limit <= 0:
             return []
-        pair = (min(agent_a, agent_b), max(agent_a, agent_b))
+        pair = self._pair(agent_a, agent_b)
         with self._lock:
             # the index is appended in send order (and rebuilt sorted on
             # load), so the tail slice IS the newest window — O(limit), not
@@ -735,9 +746,9 @@ class SwarmDB:
             for inbox in self.agent_inbox.values():
                 inbox[:] = [m for m in inbox if m.id != message_id]
             if msg.receiver_id is not None:
-                pair = (min(msg.sender_id, msg.receiver_id),
-                        max(msg.sender_id, msg.receiver_id))
-                convo = self._conversations.get(pair)
+                convo = self._conversations.get(
+                    self._pair(msg.sender_id, msg.receiver_id)
+                )
                 if convo is not None:
                     convo[:] = [m for m in convo if m.id != message_id]
             return True
@@ -801,9 +812,9 @@ class SwarmDB:
         for m in sorted(self.messages.values(), key=lambda m: m.timestamp):
             self._stats_record_new(m)
             if m.receiver_id is not None:
-                pair = (min(m.sender_id, m.receiver_id),
-                        max(m.sender_id, m.receiver_id))
-                self._conversations.setdefault(pair, []).append(m)
+                self._conversations.setdefault(
+                    self._pair(m.sender_id, m.receiver_id), []
+                ).append(m)
 
     def get_stats(self) -> Dict[str, Any]:
         """Totals by type/status/agent (reference ` main.py:973-1024`) — O(1)

@@ -1,0 +1,154 @@
+"""Native (C++) broker engine tests: durability, crash recovery, and
+concurrency — the semantics SwarmDB relies on from librdkafka in the
+reference (` main.py:192-199`: acks=all durability, delivery reports,
+consumer-group offset resume)."""
+
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("swarmdb_tpu.broker.native")
+from swarmdb_tpu.broker.native import NativeBroker, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="libswarmbroker.so not built"
+)
+
+
+def test_reopen_restores_log_and_offsets(tmp_path):
+    d = str(tmp_path / "log")
+    b = NativeBroker(log_dir=d)
+    b.create_topic("t", 2, retention_ms=12345)
+    for i in range(5):
+        b.append("t", 1, f"v{i}".encode(), key=f"k{i}".encode())
+    b.commit_offset("grp", "t", 1, 3)
+    b.close()
+
+    b2 = NativeBroker(log_dir=d)
+    meta = b2.list_topics()["t"]
+    assert meta.num_partitions == 2
+    assert meta.retention_ms == 12345
+    recs = b2.fetch("t", 1, 0, 100)
+    assert [r.value for r in recs] == [b"v0", b"v1", b"v2", b"v3", b"v4"]
+    assert recs[2].key == b"k2"
+    assert b2.end_offset("t", 1) == 5
+    assert b2.committed_offset("grp", "t", 1) == 3
+    b2.close()
+
+
+def test_torn_tail_truncated_on_reopen(tmp_path):
+    d = str(tmp_path / "log")
+    b = NativeBroker(log_dir=d)
+    b.create_topic("t", 1)
+    b.append("t", 0, b"good-1")
+    b.append("t", 0, b"good-2")
+    b.close()
+    # simulate a crash mid-append: append garbage half-record to the log
+    log = os.path.join(d, "t", "0.log")
+    with open(log, "ab") as f:
+        f.write(struct.pack("<IqdiI", 0x53574252, 2, time.time(), -1, 999)[:20])
+    b2 = NativeBroker(log_dir=d)
+    recs = b2.fetch("t", 0, 0, 10)
+    assert [r.value for r in recs] == [b"good-1", b"good-2"]
+    # and the engine keeps working after recovery
+    assert b2.append("t", 0, b"good-3") == 2
+    assert b2.fetch("t", 0, 2)[0].value == b"good-3"
+    b2.close()
+
+
+def test_trim_then_reopen_preserves_offsets(tmp_path):
+    d = str(tmp_path / "log")
+    b = NativeBroker(log_dir=d)
+    b.create_topic("t", 1)
+    now = time.time()
+    b.append("t", 0, b"old", timestamp=now - 100)
+    b.append("t", 0, b"new", timestamp=now)
+    assert b.trim_older_than("t", now - 50) == 1
+    assert b.begin_offset("t", 0) == 1
+    b.close()
+    b2 = NativeBroker(log_dir=d)
+    # the trimmed head is logical: reopen re-scans the file, but offsets of
+    # retained records must be stable
+    recs = b2.fetch("t", 0, 1, 10)
+    assert recs and recs[-1].value == b"new" and recs[-1].offset == 1
+    b2.close()
+
+
+def test_large_values_and_fetch_regrowth(tmp_path):
+    b = NativeBroker(log_dir=str(tmp_path / "log"))
+    b.create_topic("t", 1)
+    big = os.urandom(3 << 20)  # 3 MB > initial 1 MB fetch buffer
+    b.append("t", 0, big)
+    rec = b.fetch("t", 0, 0)[0]
+    assert rec.value == big
+    b.close()
+
+
+def test_concurrent_producers_consumers(tmp_path):
+    b = NativeBroker(log_dir=str(tmp_path / "log"))
+    b.create_topic("t", 4)
+    n_producers, per = 8, 200
+    errors = []
+
+    def produce(i):
+        try:
+            for j in range(per):
+                b.append("t", j % 4, f"{i}:{j}".encode())
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    seen = []
+    stop = threading.Event()
+
+    def consume(part):
+        off = 0
+        while not stop.is_set() or b.end_offset("t", part) > off:
+            recs = b.fetch("t", part, off, 64)
+            if not recs:
+                b.wait_for_data("t", part, off, 0.01)
+                continue
+            seen.extend(r.value for r in recs)
+            off = recs[-1].offset + 1
+
+    producers = [threading.Thread(target=produce, args=(i,)) for i in range(n_producers)]
+    consumers = [threading.Thread(target=consume, args=(p,)) for p in range(4)]
+    [t.start() for t in consumers]
+    [t.start() for t in producers]
+    [t.join() for t in producers]
+    stop.set()
+    [t.join(timeout=10) for t in consumers]
+    assert not errors
+    assert len(seen) == n_producers * per
+    assert len(set(seen)) == n_producers * per  # no dup, no loss
+    b.close()
+
+
+def test_swarmdb_over_native_broker(tmp_path):
+    """Full runtime stack on the C++ engine, including restart recovery."""
+    from swarmdb_tpu.core.runtime import SwarmDB
+
+    d = str(tmp_path / "log")
+    db = SwarmDB(
+        broker=NativeBroker(log_dir=d), save_dir=str(tmp_path / "hist")
+    )
+    db.register_agent("a")
+    db.register_agent("b")
+    mid = db.send_message("a", "b", "over native")
+    got = db.receive_messages("b", max_messages=5, timeout=1.0)
+    assert [m.id for m in got] == [mid]
+    db.broadcast_message("a", "all hands")
+    assert len(db.receive_messages("b", max_messages=5, timeout=1.0)) == 1
+    snap = db.save_message_history()
+    db.close()
+
+    db2 = SwarmDB(
+        broker=NativeBroker(log_dir=d), save_dir=str(tmp_path / "hist")
+    )
+    db2.load_message_history(snap)
+    assert db2.get_message(mid).content == "over native"
+    # committed offsets survived: nothing is redelivered
+    assert db2.receive_messages("b", max_messages=5, timeout=0.3) == []
+    db2.close()
