@@ -135,6 +135,39 @@ std::string part_path(const Broker& b, const std::string& topic, int part) {
   return b.dir + "/" + topic + "/" + std::to_string(part) + ".log";
 }
 
+// Sidecar persisting (base_offset, next_offset) across restarts. Without it
+// a fully-trimmed partition would reopen with next_offset=0 and reuse
+// offsets, stranding consumer groups committed past the trim point.
+std::string off_path(const Broker& b, const std::string& topic, int part) {
+  return b.dir + "/" + topic + "/" + std::to_string(part) + ".off";
+}
+
+void save_part_offsets(const Broker& b, const std::string& topic, int part,
+                       int64_t base, int64_t next) {
+  std::string path = off_path(b, topic, part);
+  std::string tmp = path + ".tmp";
+  FILE* f = ::fopen(tmp.c_str(), "w");
+  if (!f) return;
+  ::fprintf(f, "%lld %lld\n", static_cast<long long>(base),
+            static_cast<long long>(next));
+  ::fclose(f);
+  ::rename(tmp.c_str(), path.c_str());
+}
+
+bool load_part_offsets(const Broker& b, const std::string& topic, int part,
+                       int64_t* base, int64_t* next) {
+  FILE* f = ::fopen(off_path(b, topic, part).c_str(), "r");
+  if (!f) return false;
+  long long bb = 0, nn = 0;
+  bool ok = ::fscanf(f, "%lld %lld", &bb, &nn) == 2;
+  ::fclose(f);
+  if (ok) {
+    *base = bb;
+    *next = nn;
+  }
+  return ok;
+}
+
 // Rebuild a partition's index by scanning its log; truncates a torn tail.
 bool open_partition(Broker& b, const std::string& topic, int idx,
                     Partition& p) {
@@ -159,6 +192,15 @@ bool open_partition(Broker& b, const std::string& topic, int idx,
   if (!p.recs.empty()) {
     p.base_offset = p.recs.front().offset;
     p.next_offset = p.recs.back().offset + 1;
+  }
+  // a trim sidecar may advance past what the file scan shows (fully- or
+  // partially-trimmed logs keep their bytes; the head/tail are logical)
+  int64_t base = 0, next = 0;
+  if (load_part_offsets(b, topic, idx, &base, &next)) {
+    if (next > p.next_offset) p.next_offset = next;
+    if (base > p.base_offset) p.base_offset = base;
+    while (!p.recs.empty() && p.recs.front().offset < p.base_offset)
+      p.recs.pop_front();
   }
   return true;
 }
@@ -249,10 +291,21 @@ void* swb_open(const char* log_dir) {
       if (name == "." || name == ".." || name.rfind("__", 0) == 0) continue;
       Topic t;
       if (!load_topic_meta(*b, name, t)) continue;
+      bool ok = true;
       for (int i = 0; i < t.num_partitions; ++i) {
         auto p = std::make_unique<Partition>();
-        if (!open_partition(*b, name, i, *p)) continue;
+        if (!open_partition(*b, name, i, *p)) {
+          ok = false;
+          break;
+        }
         t.parts.push_back(std::move(p));
+      }
+      if (!ok) {
+        // never load a topic with parts.size() < num_partitions — the data
+        // plane indexes parts[partition] after a num_partitions bound check
+        ::fprintf(stderr, "swarmbroker: failed to open topic %s; skipping\n",
+                  name.c_str());
+        continue;
       }
       b->topics.emplace(name, std::move(t));
     }
@@ -417,17 +470,23 @@ long long swb_begin_offset(void* bp, const char* topic, int partition) {
 int swb_wait_for_data(void* bp, const char* topic, int partition,
                       long long offset, double timeout_s) {
   auto& b = *static_cast<Broker*>(bp);
-  std::shared_lock lk(b.topics_mu);
-  Topic* t = find_topic(b, topic);
-  if (!t || partition < 0 || partition >= t->num_partitions) return -1;
-  Partition& p = *t->parts[partition];
-  // NOTE: holds the topics shared lock while waiting — fine, because all
-  // writers (append) also take it shared; only topic create/grow takes it
-  // exclusive, and those are rare admin ops.
-  std::unique_lock plk(p.mu);
-  bool ok = p.cv.wait_for(
+  Partition* p = nullptr;
+  {
+    // Resolve the partition under the topics lock, then RELEASE it before
+    // blocking: a waiter holding it shared would queue create_partitions'
+    // exclusive acquisition, and writer-preferring rwlocks would then stall
+    // every append behind that — including the one being waited for.
+    // Safe because topics are never deleted and Partition objects are
+    // heap-owned (vector regrowth moves the unique_ptrs, not the objects).
+    std::shared_lock lk(b.topics_mu);
+    Topic* t = find_topic(b, topic);
+    if (!t || partition < 0 || partition >= t->num_partitions) return -1;
+    p = t->parts[partition].get();
+  }
+  std::unique_lock plk(p->mu);
+  bool ok = p->cv.wait_for(
       plk, std::chrono::duration<double>(timeout_s),
-      [&] { return p.next_offset > offset; });
+      [&] { return p->next_offset > offset; });
   return ok ? 1 : 0;
 }
 
@@ -465,9 +524,10 @@ long long swb_trim_older_than(void* bp, const char* topic, double cutoff_ts) {
   Topic* t = find_topic(b, topic);
   if (!t) return -1;
   long long dropped = 0;
-  for (auto& pp : t->parts) {
-    Partition& p = *pp;
+  for (int i = 0; i < t->num_partitions; ++i) {
+    Partition& p = *t->parts[i];
     std::unique_lock plk(p.mu);
+    long long before = dropped;
     while (!p.recs.empty() && p.recs.front().timestamp < cutoff_ts) {
       p.recs.pop_front();
       ++dropped;
@@ -480,6 +540,8 @@ long long swb_trim_older_than(void* bp, const char* topic, double cutoff_ts) {
     } else {
       p.base_offset = p.recs.front().offset;
     }
+    if (dropped != before)
+      save_part_offsets(b, topic, i, p.base_offset, p.next_offset);
   }
   return dropped;
 }

@@ -1,0 +1,95 @@
+"""Pallas TPU kernel: decode-step GQA attention over the slot KV cache.
+
+The serving hot path (engine decode chunks) issues attention with ONE query
+per slot against that slot's cache lane. The XLA einsum path materializes
+fp32 scores [B, Hq, S] in HBM between ops; this kernel keeps each
+(batch, kv-head) tile's scores in VMEM: one MXU dot for q·K, masked softmax
+in registers, one dot against V — per grid cell the only HBM traffic is the
+cache lane itself, which is the unavoidable read.
+
+Layout (grid = (B, Hkv)):
+- q block   [1, G, D]   — the G = Hq/Hkv query heads sharing this kv head
+- k/v block [1, S, 1, D] — the full cache lane for this (slot, kv head)
+- length    [1] in SMEM  — valid prefix length (= q position + 1)
+
+Single-chip path only: under tensor parallelism the cache's head axis is
+sharded and this call would force a gather; the engine enables the kernel
+when the model is unsharded (see ops/layers.gqa_attention dispatch).
+
+No reference counterpart (the reference has no model code, SURVEY §5.7);
+design per /opt/skills/guides/pallas_guide.md and the ragged-paged-attention
+pattern noted in PAPERS.md.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds of jax as well
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM = pltpu.SMEM
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _SMEM = _VMEM = None
+
+
+def _decode_attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref):
+    # q_ref [1, G, D]; k_ref/v_ref [1, S, 1, D]; len_ref [1] (SMEM)
+    q = q_ref[0].astype(jnp.float32)                   # [G, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [S, D]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)          # [S, D]
+    S = k.shape[0]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [G, S]
+
+    valid = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) < len_ref[0]
+    scores = jnp.where(valid, scores, -1e30)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / denom                                          # [G, D]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_gqa_attention(
+    q: jnp.ndarray,        # [B, Hq, D] (single decode query per slot)
+    cache_k: jnp.ndarray,  # [B, S, Hkv, D]
+    cache_v: jnp.ndarray,  # [B, S, Hkv, D]
+    lengths: jnp.ndarray,  # [B] int32 — valid prefix per slot (pos + 1)
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Returns [B, Hq, D] in q.dtype. ``interpret=True`` runs the kernel on
+    CPU for tests (pallas interpreter)."""
+    B, Hq, D = q.shape
+    S, Hkv = cache_k.shape[1], cache_k.shape[2]
+    G = Hq // Hkv
+
+    grid = (B, Hkv)
+    return pl.pallas_call(
+        _decode_attn_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, h: (b,), memory_space=_SMEM),
+            pl.BlockSpec((1, G, D), lambda b, h: (b, h, 0)),
+            pl.BlockSpec((1, S, 1, D), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, S, 1, D), lambda b, h: (b, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, D), lambda b, h: (b, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(lengths, q, cache_k, cache_v)

@@ -16,10 +16,40 @@ TPU notes:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+import contextlib
+import threading
+
+_pallas_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_disabled():
+    """Trace-time override: sharded (TP) forwards wrap their model call in
+    this so SWARMDB_PALLAS=1 cannot route a head-sharded KV cache through
+    pallas_call, which has no partitioning rule and would force a gather
+    of the whole cache every step (parallel/serving.py)."""
+    prev = getattr(_pallas_ctx, "disabled", False)
+    _pallas_ctx.disabled = True
+    try:
+        yield
+    finally:
+        _pallas_ctx.disabled = prev
+
+
+def _pallas_decode_enabled() -> bool:
+    """SWARMDB_PALLAS=1 routes single-token decode attention through the
+    Pallas kernel (ops/attention_pallas.py); 0/unset keeps the XLA einsum
+    path. Checked at trace time (static under jit)."""
+    if getattr(_pallas_ctx, "disabled", False):
+        return False
+    return os.environ.get("SWARMDB_PALLAS", "0") == "1"
 
 
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -106,6 +136,18 @@ def gqa_attention(
     B, S = cache_k.shape[0], cache_k.shape[1]
     Hq, Hkv = q.shape[2], cache_k.shape[2]
     group = Hq // Hkv
+
+    if q.shape[1] == 1 and window is None and _pallas_decode_enabled():
+        from .attention_pallas import decode_gqa_attention
+
+        out = decode_gqa_attention(
+            q[:, 0],
+            cache_k,
+            cache_v,
+            (q_positions[:, 0] + 1).astype(jnp.int32),
+            interpret=jax.default_backend() != "tpu",
+        )
+        return out[:, None]
 
     qf = q.astype(jnp.float32)
     kf = cache_k.astype(jnp.float32)

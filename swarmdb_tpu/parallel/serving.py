@@ -97,6 +97,8 @@ def build_sharded_model(
     token_sharding = NamedSharding(mesh, TOKEN_SPEC)
 
     def forward_fn(p, tokens, positions, cache):
+        from ..ops.layers import pallas_disabled
+
         # Prefill runs [1, T] (batch < data axis): leave the compiler free
         # there; constrain only when the batch divides the data axis.
         constrain = tokens.shape[0] % mesh.shape["data"] == 0
@@ -106,7 +108,8 @@ def build_sharded_model(
             cache = jax.tree.map(
                 lambda c: jax.lax.with_sharding_constraint(c, cache_sharding), cache
             )
-        logits, cache = fam.forward(p, cfg, tokens, positions, cache)
+        with pallas_disabled():
+            logits, cache = fam.forward(p, cfg, tokens, positions, cache)
         if constrain:
             cache = jax.tree.map(
                 lambda c: jax.lax.with_sharding_constraint(c, cache_sharding), cache

@@ -1,0 +1,162 @@
+"""Weight checkpointing and HF-checkpoint import.
+
+Two planes (SURVEY §5.4: the reference checkpoints broker state only —
+model weights are the TPU build's addition, loaded as read-only serving
+state):
+
+- ``save_params`` / ``restore_params``: orbax-backed pytree checkpointing.
+  Restore accepts a pytree of ``jax.sharding.NamedSharding`` so a 70B tree
+  restores directly onto a mesh without any host materializing the full
+  model (the same path ``parallel.build_sharded_model`` uses for random
+  init).
+- ``import_hf_llama`` / ``import_hf_mixtral``: map a locally available
+  HuggingFace ``transformers`` checkpoint (torch CPU) into this
+  framework's stacked-layer pytree layout (models/llama.py: weights are
+  stacked [L, ...] and scanned).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig
+
+
+# ------------------------------------------------------------------- orbax
+
+
+def save_params(params: Any, path: str) -> str:
+    """Write a pytree checkpoint (orbax StandardCheckpointer)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params)
+    ckptr.wait_until_finished()
+    return path
+
+
+def restore_params(path: str, target: Optional[Any] = None,
+                   shardings: Optional[Any] = None) -> Any:
+    """Restore a pytree checkpoint.
+
+    ``target`` is a pytree of arrays or ShapeDtypeStructs giving the
+    expected structure; with ``shardings`` (same structure, NamedShardings)
+    each leaf is restored directly onto its mesh placement.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if target is None:
+        return ckptr.restore(path)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), target
+    )
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, shardings,
+        )
+    return ckptr.restore(path, abstract)
+
+
+# ---------------------------------------------------------------- HF import
+
+
+def _t(w, dtype) -> jnp.ndarray:
+    """torch tensor -> transposed jnp array (HF Linear stores [out, in])."""
+    return jnp.asarray(np.asarray(w.detach().to("cpu").float()).T, dtype=dtype)
+
+
+def _n(w, dtype) -> jnp.ndarray:
+    """torch tensor -> jnp array, layout preserved."""
+    return jnp.asarray(np.asarray(w.detach().to("cpu").float()), dtype=dtype)
+
+
+def import_hf_llama(model, cfg: ModelConfig,
+                    dtype: jnp.dtype = jnp.bfloat16) -> Dict[str, Any]:
+    """Convert a transformers LlamaForCausalLM to the stacked pytree of
+    ``models/llama.py`` (RoPE split-half convention matches HF rotate_half).
+    """
+    hf = model.model
+    L = cfg.n_layers
+    assert len(hf.layers) == L, (len(hf.layers), L)
+
+    def stack(getter):
+        return jnp.stack([getter(hf.layers[i]) for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": _n(hf.embed_tokens.weight, dtype),
+        "layers": {
+            "attn_norm": stack(lambda l: _n(l.input_layernorm.weight, dtype)),
+            "wq": stack(lambda l: _t(l.self_attn.q_proj.weight, dtype)),
+            "wk": stack(lambda l: _t(l.self_attn.k_proj.weight, dtype)),
+            "wv": stack(lambda l: _t(l.self_attn.v_proj.weight, dtype)),
+            "wo": stack(lambda l: _t(l.self_attn.o_proj.weight, dtype)),
+            "mlp_norm": stack(
+                lambda l: _n(l.post_attention_layernorm.weight, dtype)),
+            "w_gate": stack(lambda l: _t(l.mlp.gate_proj.weight, dtype)),
+            "w_up": stack(lambda l: _t(l.mlp.up_proj.weight, dtype)),
+            "w_down": stack(lambda l: _t(l.mlp.down_proj.weight, dtype)),
+        },
+        "final_norm": _n(hf.norm.weight, dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _t(model.lm_head.weight, dtype)
+    return params
+
+
+def import_hf_mixtral(model, cfg: ModelConfig,
+                      dtype: jnp.dtype = jnp.bfloat16) -> Dict[str, Any]:
+    """Convert a transformers MixtralForCausalLM to the stacked pytree of
+    ``models/mixtral.py`` (w1=gate, w3=up, w2=down per HF naming)."""
+    hf = model.model
+    L, E = cfg.n_layers, cfg.n_experts
+    assert len(hf.layers) == L
+
+    def stack(getter):
+        return jnp.stack([getter(hf.layers[i]) for i in range(L)])
+
+    def stack_experts(getter):
+        return jnp.stack([
+            jnp.stack([getter(hf.layers[i].block_sparse_moe.experts[e])
+                       for e in range(E)])
+            for i in range(L)
+        ])
+
+    return {
+        "embed": _n(hf.embed_tokens.weight, dtype),
+        "layers": {
+            "attn_norm": stack(lambda l: _n(l.input_layernorm.weight, dtype)),
+            "wq": stack(lambda l: _t(l.self_attn.q_proj.weight, dtype)),
+            "wk": stack(lambda l: _t(l.self_attn.k_proj.weight, dtype)),
+            "wv": stack(lambda l: _t(l.self_attn.v_proj.weight, dtype)),
+            "wo": stack(lambda l: _t(l.self_attn.o_proj.weight, dtype)),
+            "mlp_norm": stack(
+                lambda l: _n(l.post_attention_layernorm.weight, dtype)),
+            "router": stack(lambda l: _t(l.block_sparse_moe.gate.weight, dtype)),
+            "w_gate": stack_experts(lambda e: _t(e.w1.weight, dtype)),
+            "w_up": stack_experts(lambda e: _t(e.w3.weight, dtype)),
+            "w_down": stack_experts(lambda e: _t(e.w2.weight, dtype)),
+        },
+        "final_norm": _n(hf.norm.weight, dtype),
+        "lm_head": _t(model.lm_head.weight, dtype),
+    }
+
+
+def load_hf_checkpoint(path: str, cfg: ModelConfig,
+                       dtype: jnp.dtype = jnp.bfloat16) -> Dict[str, Any]:
+    """Load a local HF checkpoint directory and convert (zero-egress image:
+    `path` must already be on disk)."""
+    import transformers
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(path)
+    if cfg.is_moe:
+        return import_hf_mixtral(model, cfg, dtype)
+    return import_hf_llama(model, cfg, dtype)

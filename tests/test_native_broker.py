@@ -152,3 +152,43 @@ def test_swarmdb_over_native_broker(tmp_path):
     # committed offsets survived: nothing is redelivered
     assert db2.receive_messages("b", max_messages=5, timeout=0.3) == []
     db2.close()
+
+
+def test_full_trim_reopen_preserves_next_offset(tmp_path):
+    """Review finding: a fully-trimmed partition must NOT reuse offsets
+    after reopen (committed consumers would be stranded forever)."""
+    d = str(tmp_path / "log")
+    b = NativeBroker(log_dir=d)
+    b.create_topic("t", 1)
+    now = time.time()
+    for i in range(5):
+        b.append("t", 0, f"v{i}".encode(), timestamp=now - 100)
+    b.commit_offset("g", "t", 0, 5)
+    assert b.trim_older_than("t", now - 50) == 5
+    assert b.end_offset("t", 0) == 5
+    b.close()
+
+    b2 = NativeBroker(log_dir=d)
+    assert b2.end_offset("t", 0) == 5      # offsets continue, never reset
+    assert b2.begin_offset("t", 0) == 5
+    off = b2.append("t", 0, b"fresh")
+    assert off == 5
+    # the committed consumer sees the new record immediately
+    recs = b2.fetch("t", 0, b2.committed_offset("g", "t", 0))
+    assert [r.value for r in recs] == [b"fresh"]
+    b2.close()
+
+
+def test_partial_trim_reopen_does_not_resurrect(tmp_path):
+    d = str(tmp_path / "log")
+    b = NativeBroker(log_dir=d)
+    b.create_topic("t", 1)
+    now = time.time()
+    b.append("t", 0, b"old", timestamp=now - 100)
+    b.append("t", 0, b"new", timestamp=now)
+    assert b.trim_older_than("t", now - 50) == 1
+    b.close()
+    b2 = NativeBroker(log_dir=d)
+    assert b2.begin_offset("t", 0) == 1    # trimmed head stays trimmed
+    assert [r.value for r in b2.fetch("t", 0, 0)] == [b"new"]
+    b2.close()
