@@ -166,24 +166,36 @@ class Engine:
 
         self._decode = jax.jit(_decode, donate_argnums=donate)
 
-        # ---- compiled prefill (B=1), one variant per bucket ---------------
-        def _prefill(params, tokens, length, cache1, base_key, temp, topk, topp):
-            # tokens [1, T] padded; length scalar = true length
-            T = tokens.shape[1]
-            positions = jnp.arange(T, dtype=jnp.int32)[None]
-            logits, cache1 = self.forward_fn(params, tokens, positions, cache1)
-            last = logits[jnp.arange(1), (length - 1)[None]]  # [1, V]
-            next_tok = sample_tokens(
-                last, base_key[None], (length - 1)[None],
-                temp[None], topk[None], topp[None],
+        # ---- compiled prefill, BATCHED: one variant per bucket ------------
+        # Prefill at small T is HBM-bound (a full parameter read), so
+        # prefilling up to ``prefill_batch`` admitted prompts in ONE call
+        # costs nearly the same as one. Rows beyond the real group are
+        # padding (length 1) whose results the host discards.
+        self.prefill_batch = max(1, min(8, max_batch))
+
+        def _prefill(params, tokens, lengths, cacheB, base_keys, temp, topk,
+                     topp):
+            # tokens [Bp, T] padded; lengths [Bp] true lengths. cacheB is
+            # sized [L, Bp, bucket, ...] — NOT max_seq — so the transient
+            # prefill memory scales with the prompt, not the decode window
+            # (review finding: a max_seq-sized temp cache per admission
+            # would transiently double the decode cache in HBM).
+            Bp, T = tokens.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None], (Bp, T)
             )
-            return next_tok[0], cache1
+            logits, cacheB = self.forward_fn(params, tokens, positions, cacheB)
+            last = logits[jnp.arange(Bp), lengths - 1]  # [Bp, V]
+            next_tok = sample_tokens(
+                last, base_keys, lengths - 1, temp, topk, topp
+            )
+            return next_tok, cacheB
 
-        self._prefill = jax.jit(_prefill)
+        self._prefill = jax.jit(_prefill, donate_argnums=(3,))
 
-        # scatter one prefill token into the device fed-token vector (async)
-        self._set_last_token = jax.jit(
-            lambda lt, i, tok: lt.at[i].set(tok), donate_argnums=(0,)
+        # scatter prefill tokens into the device fed-token vector (async)
+        self._set_last_tokens = jax.jit(
+            lambda lt, idx, tok: lt.at[idx].set(tok), donate_argnums=(0,)
         )
 
         self.total_generated = 0
@@ -278,25 +290,39 @@ class Engine:
 
     def _admit(self) -> None:
         """Move queued requests into free slots (highest priority first) and
-        run their prefill."""
+        run their prefill in groups of up to ``prefill_batch``.
+
+        Groups are split by bucket so a short prompt co-admitted with a
+        long one never pays the long bucket's O(T^2) attention (review
+        finding); every popped request is still admitted this round.
+        """
         while True:
             with self._cv:
                 free = self._free_slot_ids()
-                if not free or not self._queue:
+                take = min(len(free), len(self._queue), self.prefill_batch)
+                if take == 0:
                     return
-                _, _, _, req = heapq.heappop(self._queue)
-            try:
-                self._prefill_into_slot(free[0], req)
-            except Exception:
-                # the request is already off the queue and not yet in a slot:
-                # fail it here or its on_done would never fire (callers like
-                # generate_sync / SSE streams would hang to their timeouts)
-                logger.exception("prefill failed for %s", req.request_id)
-                if req.on_done is not None:
-                    try:
-                        req.on_done(req.request_id, [], "engine_error")
-                    except Exception:
-                        pass
+                popped = [heapq.heappop(self._queue)[3] for _ in range(take)]
+            groups: Dict[int, List[Tuple[int, GenRequest]]] = {}
+            for slot_id, req in zip(free, popped):
+                groups.setdefault(self._bucket_for(len(req.prompt)), []).append(
+                    (slot_id, req)
+                )
+            for batch in groups.values():
+                try:
+                    self._prefill_batch(batch)
+                except Exception:
+                    # the requests are already off the queue and not yet in
+                    # slots: fail them here or their on_done would never fire
+                    # (generate_sync / SSE streams would hang to the timeout)
+                    logger.exception("prefill failed for %s",
+                                     [r.request_id for _, r in batch])
+                    for _, req in batch:
+                        if req.on_done is not None:
+                            try:
+                                req.on_done(req.request_id, [], "engine_error")
+                            except Exception:
+                                pass
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -304,55 +330,72 @@ class Engine:
                 return b
         return self.prefill_buckets[-1]
 
-    def _prefill_into_slot(self, slot_id: int, req: GenRequest) -> None:
+    def _prefill_batch(self, batch: List[Tuple[int, GenRequest]]) -> None:
+        """One compiled prefill for up to ``prefill_batch`` admissions.
+
+        The call is padded to the fixed [Bp, bucket] shape (one compiled
+        variant per bucket); padding rows are discarded. NO host sync
+        happens here — sampled first tokens land in the device fed-token
+        vector and surface as row 0 of the next chunk's block.
+        """
         t0 = time.time()
-        slot = self.slots[slot_id]
-        prompt = req.prompt  # submit() enforces len < max_seq
-        bucket = self._bucket_for(len(prompt))
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, : len(prompt)] = prompt
+        Bp = self.prefill_batch
+        n = len(batch)
+        longest = max(len(req.prompt) for _, req in batch)
+        bucket = self._bucket_for(longest)
+        padded = np.full((Bp, bucket), self.pad_id, np.int32)
+        lengths = np.ones(Bp, np.int32)
+        # row -> slot gather index, padded to Bp (padding rows borrow slot 0's
+        # params/keys; their outputs are discarded)
+        gather = np.zeros(Bp, np.int64)
+        for row, (slot_id, req) in enumerate(batch):
+            prompt = req.prompt  # submit() enforces len < max_seq
+            padded[row, : len(prompt)] = prompt
+            lengths[row] = len(prompt)
+            gather[row] = slot_id
+            # slot sampling params must be set BEFORE prefill samples the
+            # first token, or the request inherits the previous occupant's
+            s = req.sampling
+            self._temp[slot_id] = s.temperature
+            self._topk[slot_id] = s.top_k
+            self._topp[slot_id] = s.top_p
 
-        # slot sampling params must be set BEFORE prefill samples its first
-        # token, or the new request inherits the previous occupant's knobs
-        s = req.sampling
-        self._temp[slot_id] = s.temperature
-        self._topk[slot_id] = s.top_k
-        self._topp[slot_id] = s.top_p
-
-        cache1 = self._prefill_cache_fn(1, self.max_seq)
-        next_tok, cache1 = self._prefill(
+        cacheB = self._prefill_cache_fn(Bp, bucket)
+        next_toks, cacheB = self._prefill(
             self.params,
             padded,                      # raw np: transfer rides the dispatch
-            np.int32(len(prompt)),
-            cache1,
-            self.base_keys[slot_id],
-            self._temp[slot_id],
-            self._topk[slot_id],
-            self._topp[slot_id],
+            lengths,
+            cacheB,
+            self.base_keys[gather],
+            self._temp[gather],
+            self._topk[gather],
+            self._topp[gather],
         )
-        # insert the prefix cache into this slot's rows: cache leaves are
-        # [L, B, S, ...]; prefill produced [L, 1, S, ...]. The whole lane is
-        # overwritten, wiping any garbage a previous occupant left behind.
+        # Insert the prefix caches into the admitted slots' lanes, first
+        # `bucket` positions only. Stale entries a previous occupant left at
+        # positions >= bucket are never read: decode writes position p in
+        # the same step that first attends to it, and proceeds sequentially
+        # from the prompt length (write-before-read invariant).
+        slot_ids = gather[:n]
         self.cache = jax.tree.map(
-            lambda full, one: full.at[:, slot_id].set(one[:, 0]), self.cache, cache1
+            lambda full, fresh: full.at[:, slot_ids, :bucket].set(fresh[:, :n]),
+            self.cache, cacheB,
         )
-        # NO host sync here (the tunnel costs ~80 ms per fetch): the sampled
-        # first token stays on device and surfaces as row 0 of the next
-        # chunk's token block.
-        self._last_tokens = self._set_last_token(
-            self._last_tokens, slot_id, next_tok
+        self._last_tokens = self._set_last_tokens(
+            self._last_tokens, slot_ids, next_toks[:n]
         )
 
-        slot.active = True
-        slot.request = req
-        slot.position = len(prompt)   # next write position = prompt length
-        slot.generated = []
-        slot.pending_first = True
-        slot.first_token_at = None
-        self.total_requests += 1
-
+        for slot_id, req in batch:
+            slot = self.slots[slot_id]
+            slot.active = True
+            slot.request = req
+            slot.position = len(req.prompt)  # next write position
+            slot.generated = []
+            slot.pending_first = True
+            slot.first_token_at = None
+            self.total_requests += 1
+            self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
         self.metrics.latencies["prefill_s"].observe(time.time() - t0)
-        self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
 
     # --------------------------------------------------------------- decode
 

@@ -142,16 +142,21 @@ std::string off_path(const Broker& b, const std::string& topic, int part) {
   return b.dir + "/" + topic + "/" + std::to_string(part) + ".off";
 }
 
-void save_part_offsets(const Broker& b, const std::string& topic, int part,
+bool save_part_offsets(const Broker& b, const std::string& topic, int part,
                        int64_t base, int64_t next) {
   std::string path = off_path(b, topic, part);
   std::string tmp = path + ".tmp";
   FILE* f = ::fopen(tmp.c_str(), "w");
-  if (!f) return;
+  if (!f) return false;
   ::fprintf(f, "%lld %lld\n", static_cast<long long>(base),
             static_cast<long long>(next));
+  // fsync BEFORE rename: callers truncate the log only after the sidecar is
+  // durable, otherwise a crash in between reopens with next_offset=0 and
+  // reuses offsets (the bug the sidecar exists to prevent)
+  ::fflush(f);
+  ::fsync(::fileno(f));
   ::fclose(f);
-  ::rename(tmp.c_str(), path.c_str());
+  return ::rename(tmp.c_str(), path.c_str()) == 0;
 }
 
 bool load_part_offsets(const Broker& b, const std::string& topic, int part,
@@ -532,16 +537,18 @@ long long swb_trim_older_than(void* bp, const char* topic, double cutoff_ts) {
       p.recs.pop_front();
       ++dropped;
     }
-    if (p.recs.empty()) {
+    if (p.recs.empty() && dropped != before) {
       p.base_offset = p.next_offset;
-      ::ftruncate(p.fd, 0);
-      p.file_end = 0;
-      p.dirty = true;
-    } else {
+      // durability order: sidecar first, THEN destroy the log bytes
+      if (save_part_offsets(b, topic, i, p.base_offset, p.next_offset)) {
+        ::ftruncate(p.fd, 0);
+        p.file_end = 0;
+        p.dirty = true;
+      }
+    } else if (dropped != before) {
       p.base_offset = p.recs.front().offset;
-    }
-    if (dropped != before)
       save_part_offsets(b, topic, i, p.base_offset, p.next_offset);
+    }
   }
   return dropped;
 }

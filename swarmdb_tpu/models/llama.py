@@ -176,3 +176,77 @@ def forward(
                         head.astype(jnp.float32))
     return logits, (new_k, new_v)
 
+
+# ------------------------------------------- sequence-parallel long prefill
+
+
+def forward_seq_parallel(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [B, T] with T = seq_axis_size * T_local
+    positions: jnp.ndarray,   # [B, T] absolute positions
+    mesh,                     # jax.sharding.Mesh
+    seq_axis: str = "data",
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Long-prompt prefill with the SEQUENCE sharded over a mesh axis.
+
+    Context parallelism (SURVEY §5.7 design hook, made real): each device
+    holds T/axis_size tokens; attention is `ops.ring_attention` — K/V
+    chunks rotate over ICI with ppermute while softmax accumulates online,
+    so peak memory per device is O(T/axis) and no [T, T] scores exist.
+    During prefill of one long prompt the batch axis is idle, so the
+    ``data`` axis doubles as the ring (no dedicated mesh axis needed).
+
+    Returns fp32 logits [B, T, V] and the prompt KV [L, B, T, Hkv, D],
+    both seq-sharded on device; callers either read the last-token logits
+    or scatter the KV into a slot cache for decode.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.ring_attention import ring_attention
+
+    def local_fwd(params, tokens, positions):
+        x = params["embed"][tokens]
+        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+        def layer_step(x, lp):
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            B, T = h.shape[0], h.shape[1]
+            q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
+                B, T, cfg.n_heads, cfg.head_dim)
+            k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
+                B, T, cfg.n_kv_heads, cfg.head_dim)
+            v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
+                B, T, cfg.n_kv_heads, cfg.head_dim)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            attn = ring_attention(q, k, v, positions, positions, seq_axis)
+            x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+            return x, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(layer_step, x, params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                            head.astype(jnp.float32))
+        return logits, ks, vs
+
+    sharded = shard_map(
+        local_fwd,
+        mesh=mesh,
+        in_specs=(P(), P(None, seq_axis), P(None, seq_axis)),
+        out_specs=(
+            P(None, seq_axis, None),
+            P(None, None, seq_axis, None, None),
+            P(None, None, seq_axis, None, None),
+        ),
+        check_vma=False,
+    )
+    logits, ks, vs = sharded(params, tokens, positions)
+    return logits, (ks, vs)
+

@@ -1,0 +1,112 @@
+"""Ring attention — causal attention with K/V sharded over a mesh axis.
+
+Long-context prefill support (SURVEY §5.7 notes the reference has nothing
+here; the north-star build treats long-sequence handling as first-class):
+a prompt longer than one chip's HBM/VMEM budget is sharded over a ``seq``
+mesh axis; each device holds one Q/K/V chunk and K/V chunks rotate around
+the ring with ``lax.ppermute`` while attention accumulates online
+(flash-style running max / denominator), so no device ever materializes
+the full [T, T] score matrix or the full K/V.
+
+Written for use inside ``shard_map`` (see ``models/llama.py
+forward_seq_parallel``): all collectives are XLA ``ppermute`` steps that
+ride ICI neighbor links — total traffic per device is exactly one K/V
+rotation around the ring, the canonical overlap-friendly pattern.
+
+Causality is by GLOBAL position: each chunk carries its absolute
+positions, so the mask is exact regardless of how chunks are laid out.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attend(
+    q: jnp.ndarray,        # [B, Tq, Hq, D] fp32
+    k: jnp.ndarray,        # [B, Tk, Hkv, D] fp32
+    v: jnp.ndarray,        # [B, Tk, Hkv, D] fp32
+    q_pos: jnp.ndarray,    # [B, Tq]
+    kv_pos: jnp.ndarray,   # [B, Tk]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One block of masked attention: returns (scores-exp sum `l`,
+    running max `m`, weighted values `o`) for online-softmax merging."""
+    B, Tq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    qg = q.reshape(B, Tq, Hkv, group, D)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k) / jnp.sqrt(jnp.float32(D))
+    mask = (kv_pos[:, None, :] <= q_pos[:, :, None])[:, None, None]  # [B,1,1,Tq,Tk]
+    scores = jnp.where(mask, scores, -jnp.inf)
+
+    m = jnp.max(scores, axis=-1)                      # [B,Hkv,G,Tq]
+    # fully-masked rows (no valid kv in this block) must not produce NaNs
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)                           # [B,Hkv,G,Tq]
+    o = jnp.einsum("bkgts,bskd->bkgtd", p, v)         # [B,Hkv,G,Tq,D]
+    return o, l, jnp.where(jnp.isfinite(m), m, -jnp.inf)
+
+
+def ring_attention(
+    q: jnp.ndarray,        # [B, Tq, Hq, D] local query chunk
+    k: jnp.ndarray,        # [B, Tk, Hkv, D] local key chunk
+    v: jnp.ndarray,        # [B, Tk, Hkv, D] local value chunk
+    q_pos: jnp.ndarray,    # [B, Tq] global positions of the local queries
+    kv_pos: jnp.ndarray,   # [B, Tk] global positions of the local keys
+    axis_name: str,
+) -> jnp.ndarray:
+    """Causal GQA attention across a ring of devices (call under shard_map
+    with ``axis_name`` bound). Returns [B, Tq, Hq, D] in q.dtype."""
+    axis_size = jax.lax.psum(1, axis_name)
+    B, Tq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge(acc, block):
+        o, l, m = acc
+        o_b, l_b, m_b = block
+        m_new = jnp.maximum(m, m_b)
+        m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        s_old = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new_safe), 0.0)
+        s_blk = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - m_new_safe), 0.0)
+        return (
+            o * s_old[..., None] + o_b * s_blk[..., None],
+            l * s_old + l_b * s_blk,
+            m_new,
+        )
+
+    def attend(k_cur, v_cur, pos_cur, acc):
+        return merge(acc, _block_attend(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            q_pos, pos_cur,
+        ))
+
+    def step(carry, _):
+        k_cur, v_cur, pos_cur, *acc = carry
+        acc = attend(k_cur, v_cur, pos_cur, tuple(acc))
+        # rotate K/V (+ their positions) one step around the ring
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        p_nxt = jax.lax.ppermute(pos_cur, axis_name, perm)
+        return (k_nxt, v_nxt, p_nxt, *acc), None
+
+    o0 = jnp.zeros((B, Hkv, group, Tq, D), jnp.float32)
+    l0 = jnp.zeros((B, Hkv, group, Tq), jnp.float32)
+    m0 = jnp.full((B, Hkv, group, Tq), -jnp.inf, jnp.float32)
+    # axis_size - 1 rotations suffice: the last block is attended WITHOUT
+    # rotating, since a final ppermute would only return chunks home
+    (k_l, v_l, pos_l, *acc), _ = jax.lax.scan(
+        step, (k, v, kv_pos, o0, l0, m0), None, length=axis_size - 1
+    )
+    o, l, _ = attend(k_l, v_l, pos_l, tuple(acc))
+    out = o / jnp.maximum(l, 1e-30)[..., None]          # [B,Hkv,G,Tq,D]
+    out = jnp.moveaxis(out, 3, 1).reshape(B, Tq, Hq, D)
+    return out.astype(q.dtype)

@@ -1,0 +1,76 @@
+"""Multi-host initialization: the DCN plane.
+
+The reference has no distributed backend at all (SURVEY §2.4/§5.8 — no
+NCCL/MPI/Gloo; its only cross-process story is an external Kafka broker).
+The TPU build's two communication planes are:
+
+1. *Tensor plane*: XLA/GSPMD collectives over ICI within a slice and DCN
+   across hosts — enabled here via ``jax.distributed.initialize`` so
+   ``jax.devices()`` spans every host and any ``Mesh`` built from it lays
+   collectives onto the right fabric automatically.
+2. *Message plane*: the broker (C++ engine). Cross-host agents reach it
+   through the HTTP API on the coordinator host; partition->mesh mapping
+   is unchanged because the mesh itself is global after init.
+
+Env contract (standard TPU pod conventions; all optional on single host):
+  SWARMDB_COORDINATOR   host:port of process 0 (JAX coordinator)
+  SWARMDB_NUM_PROCESSES total process count
+  SWARMDB_PROCESS_ID    this process's index
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("swarmdb_tpu.distributed")
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize the JAX distributed runtime if configured; idempotent.
+
+    Returns True when running multi-process (after init), False when
+    single-process (nothing to do). Call before any backend use; then
+    ``parallel.make_mesh()`` over ``jax.devices()`` spans the pod and
+    GSPMD routes intra-slice collectives over ICI, cross-host over DCN.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("SWARMDB_COORDINATOR")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes or int(os.environ.get("SWARMDB_NUM_PROCESSES", "1"))
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("SWARMDB_PROCESS_ID", "0"))
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "distributed init: process %d/%d, %d global devices",
+        process_id, num_processes, jax.device_count(),
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    """True on the process that should own the HTTP ingress (host 0) —
+    the single-controller-vs-SPMD split (SURVEY §7 'hard parts'): every
+    process runs the same decode program over the global mesh; only the
+    coordinator runs the API server and the broker."""
+    return jax.process_index() == 0
