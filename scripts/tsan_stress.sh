@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# ThreadSanitizer job for the native broker (SURVEY §5.2: the reference has
+# no race detection; its state is demonstrably race-prone). Builds the
+# -fsanitize=thread library and hammers it with the concurrent
+# producer/consumer stress test; any data race aborts with a TSAN report.
+#
+# Requires a TSAN-capable toolchain; run from the repo root:
+#   scripts/tsan_stress.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C swarmdb_tpu/broker/cpp tsan
+
+export SWARMDB_BROKER_LIB="$PWD/swarmdb_tpu/broker/cpp/libswarmbroker_tsan.so"
+# TSAN must be loaded first when the instrumented .so is dlopen'd
+TSAN_RT="$(g++ -print-file-name=libtsan.so)"
+export LD_PRELOAD="$TSAN_RT"
+export TSAN_OPTIONS="halt_on_error=1"
+
+python -m pytest tests/test_native_broker.py::test_concurrent_producers_consumers -q
+echo "TSAN stress passed: no data races detected"

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -97,6 +98,7 @@ def _error(status_code: int, detail: Any) -> web.HTTPException:
         401: web.HTTPUnauthorized,
         403: web.HTTPForbidden,
         404: web.HTTPNotFound,
+        409: web.HTTPConflict,
         422: web.HTTPUnprocessableEntity,
         429: web.HTTPTooManyRequests,
         503: web.HTTPServiceUnavailable,
@@ -513,6 +515,46 @@ def create_app(
         n = await _run_sync(db.auto_scale_partitions)
         return _json({"status": "scaled", "num_partitions": n})
 
+    async def agent_load(request: web.Request) -> web.Response:
+        """GET /agents/{agent_id}/load — inbox size, unread count, trailing
+        msgs/sec. The reference computes this (` main.py:1049-1094`) but
+        never exposes it over HTTP (SURVEY §5.5); here it is first-class.
+        Self or admin."""
+        agent = current_agent(request)
+        target = request.match_info["agent_id"]
+        if agent != target and agent != ADMIN_USERNAME:
+            raise _error(403, "can only read your own load (or be admin)")
+        return _json(await _run_sync(db.get_agent_load, target))
+
+    async def profile_start(request: web.Request) -> web.Response:
+        """POST /admin/profile/start — begin a jax.profiler trace
+        (SURVEY §5.1: the reference has no tracing at all; this captures
+        XLA/TPU timelines viewable in TensorBoard/Perfetto)."""
+        require_admin(current_agent(request))
+        import jax
+
+        trace_dir = (request.query.get("dir")
+                     or os.path.join(db.save_dir, "profiles"))
+        try:
+            # off the event loop: trace setup touches the device backend
+            await _run_sync(jax.profiler.start_trace, trace_dir)
+        except Exception as exc:  # already tracing / profiler unavailable
+            raise _error(409, str(exc))
+        return _json({"status": "tracing", "trace_dir": trace_dir})
+
+    async def profile_stop(request: web.Request) -> web.Response:
+        """POST /admin/profile/stop — end the jax.profiler trace."""
+        require_admin(current_agent(request))
+        import jax
+
+        try:
+            # stop serializes the whole collected trace (can be seconds) —
+            # never on the event loop or every live SSE stream stalls
+            await _run_sync(jax.profiler.stop_trace)
+        except Exception as exc:  # not tracing
+            raise _error(409, str(exc))
+        return _json({"status": "stopped"})
+
     # ----------------------------------------------------------- SSE helpers
 
     async def _sse_response(request: web.Request) -> web.StreamResponse:
@@ -620,6 +662,10 @@ def create_app(
         web.post("/admin/flush", admin_flush),
         web.post("/admin/resend_failed", admin_resend),
         web.post("/admin/scale_partitions", admin_scale),
+        # TPU-build additions (no reference routes)
+        web.get("/agents/{agent_id}/load", agent_load),
+        web.post("/admin/profile/start", profile_start),
+        web.post("/admin/profile/stop", profile_stop),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

@@ -58,6 +58,20 @@ def build_serving(db: SwarmDB):
 
 def main() -> None:
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    from ..parallel.distributed import init_distributed, is_coordinator
+
+    if init_distributed():
+        # Multi-host pod: one HTTP ingress (coordinator) owns the broker
+        # and API; every process sees the global mesh via jax.devices().
+        # Non-coordinator worker participation in the SPMD decode program
+        # is driven by the engine's multi-host path; running a second,
+        # independent API here would silently serve duplicate traffic —
+        # refuse loudly instead (SURVEY §7 single-controller-vs-SPMD).
+        if not is_coordinator():
+            raise SystemExit(
+                "this process is not the coordinator (SWARMDB_PROCESS_ID != 0); "
+                "the HTTP API runs on host 0 only"
+            )
     db = build_db()
     serving = build_serving(db)
     cfg = ApiConfig.from_env()

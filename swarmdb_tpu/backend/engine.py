@@ -218,6 +218,25 @@ class Engine:
             self._thread.join(timeout=30)
             self._thread = None
 
+    def alive(self) -> bool:
+        """True while the decode loop thread is running."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def restart(self) -> None:
+        """Recover from a fatal engine death (SURVEY §5.3 failure
+        detection): fail whatever was in flight (callers see
+        ``engine_restart`` and the runtime's FAILED/resend machinery takes
+        over), rebuild device state, and bring the loop back up."""
+        if self._thread is not None and not self._thread.is_alive():
+            self._thread = None
+        with self._cv:
+            self._stop = False
+        self._fail_all("engine_restart")
+        self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
+        self.cache = self._prefill_cache_fn(self.max_batch, self.max_seq)
+        self.metrics.counters["engine_restarts"].inc()
+        self.start()
+
     # ------------------------------------------------------------ submission
 
     def submit(self, request: GenRequest) -> str:

@@ -169,6 +169,18 @@ class ServingService:
         all of its assigned agents.
         """
         while not self._stop.is_set():
+            # watchdog (SURVEY §5.3): a dead decode loop strands every
+            # in-flight and queued request — restart it, failing them fast
+            # so lineage/resend applies instead of silent timeouts
+            if not self.engine.alive():
+                logger.error("engine loop dead; restarting backend %s",
+                             self.backend_id)
+                try:
+                    self.engine.restart()
+                except Exception:
+                    logger.exception("engine restart failed; backing off")
+                    self._stop.wait(1.0)
+                    continue
             agents = self.db.agents_for_backend(self.backend_id)
             served = 0
             for agent in agents:

@@ -148,14 +148,18 @@ bool save_part_offsets(const Broker& b, const std::string& topic, int part,
   std::string tmp = path + ".tmp";
   FILE* f = ::fopen(tmp.c_str(), "w");
   if (!f) return false;
-  ::fprintf(f, "%lld %lld\n", static_cast<long long>(base),
-            static_cast<long long>(next));
-  // fsync BEFORE rename: callers truncate the log only after the sidecar is
-  // durable, otherwise a crash in between reopens with next_offset=0 and
-  // reuses offsets (the bug the sidecar exists to prevent)
-  ::fflush(f);
-  ::fsync(::fileno(f));
-  ::fclose(f);
+  // every step checked: callers destroy the log ONLY on a durably-written
+  // sidecar — an ENOSPC/partial write returning success here would recreate
+  // the offset-reuse corruption the sidecar exists to prevent
+  bool ok = ::fprintf(f, "%lld %lld\n", static_cast<long long>(base),
+                      static_cast<long long>(next)) > 0;
+  ok = ::fflush(f) == 0 && ok;
+  ok = ::fsync(::fileno(f)) == 0 && ok;
+  ok = ::fclose(f) == 0 && ok;
+  if (!ok) {
+    ::unlink(tmp.c_str());
+    return false;
+  }
   return ::rename(tmp.c_str(), path.c_str()) == 0;
 }
 

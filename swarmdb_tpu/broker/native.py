@@ -25,7 +25,11 @@ import numpy as np
 from .base import Broker, BrokerError, Record, TopicMeta, UnknownTopicError
 
 _CPP_DIR = os.path.join(os.path.dirname(__file__), "cpp")
-_LIB_PATH = os.path.join(_CPP_DIR, "libswarmbroker.so")
+# SWARMDB_BROKER_LIB overrides the library path — used by the TSAN job
+# (scripts/tsan_stress.sh) to load the -fsanitize=thread build.
+_LIB_PATH = os.environ.get(
+    "SWARMDB_BROKER_LIB", os.path.join(_CPP_DIR, "libswarmbroker.so")
+)
 
 _REC_HDR = struct.Struct("<qdii")  # offset, ts, key_len, val_len
 

@@ -461,3 +461,91 @@ def test_cors_empty_allowlist_denies(tmp_path):
         assert "Access-Control-Allow-Origin" not in r.headers
 
     api_drive(drive, tmp_path, config=cfg)
+
+
+def test_agent_load_endpoint(tmp_path):
+    """TPU addition: GET /agents/{id}/load (self or admin; SURVEY §5.5)."""
+
+    async def drive(client, db):
+        hdrs = await get_token(client, "loady")
+        r = await client.post("/agents/register", json={"agent_id": "loady"},
+                              headers=hdrs)
+        assert r.status == 200
+        r = await client.get("/agents/loady/load", headers=hdrs)
+        assert r.status == 200
+        body = await r.json()
+        assert body["agent_id"] == "loady"
+        assert {"inbox_size", "unread_count", "messages_per_second"} <= set(body)
+        # cannot read someone else's load
+        other = await get_token(client, "nosy")
+        r = await client.get("/agents/loady/load", headers=other)
+        assert r.status == 403
+        # admin can
+        admin = await get_token(client, "admin")
+        r = await client.get("/agents/loady/load", headers=admin)
+        assert r.status == 200
+
+    api_drive(drive, tmp_path)
+
+
+def test_profile_routes_admin_only(tmp_path):
+    async def drive(client, db):
+        hdrs = await get_token(client, "pleb")
+        r = await client.post("/admin/profile/start", headers=hdrs)
+        assert r.status == 403
+        admin = await get_token(client, "admin")
+        r = await client.post(f"/admin/profile/start?dir={tmp_path}/tr",
+                              headers=admin)
+        assert r.status == 200
+        # double-start conflicts
+        r2 = await client.post(f"/admin/profile/start?dir={tmp_path}/tr",
+                               headers=admin)
+        assert r2.status == 409
+        r = await client.post("/admin/profile/stop", headers=admin)
+        assert r.status == 200
+        # stop again conflicts
+        r = await client.post("/admin/profile/stop", headers=admin)
+        assert r.status == 409
+
+    api_drive(drive, tmp_path)
+
+
+def test_engine_watchdog_restarts_dead_loop(tmp_path):
+    """SURVEY §5.3: a dead decode loop is detected and restarted by the
+    backend consumer; in-flight requests fail fast with engine_restart."""
+    import threading
+    import time as _time
+
+    from swarmdb_tpu.backend.service import ServingService
+
+    async def drive(client, db):
+        serving = ServingService.from_model_name(
+            db, "tiny-debug", max_batch=2, max_seq=64)
+        serving.start()
+        try:
+            eng = serving.engine
+            deadline = _time.time() + 30
+            while not eng.alive() and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert eng.alive()
+            # kill the decode loop the hard way
+            with eng._cv:
+                eng._stop = True
+                eng._cv.notify_all()
+            eng._thread.join(timeout=10)
+            assert not eng.alive()
+            # the consumer watchdog must bring it back
+            deadline = _time.time() + 30
+            while not eng.alive() and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert eng.alive(), "watchdog did not restart the engine"
+            # and it still serves
+            from swarmdb_tpu.backend.sampling import SamplingParams
+
+            toks, reason = eng.generate_sync(
+                [1, 5], SamplingParams(max_new_tokens=3), timeout=120)
+            assert reason in ("length", "eos")
+        finally:
+            serving.stop()
+
+    api_drive(drive, tmp_path)
