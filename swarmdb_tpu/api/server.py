@@ -53,7 +53,13 @@ def build_serving(db: SwarmDB):
             f"SERVE_MODEL={model_name!r} requires the serving backend "
             f"(swarmdb_tpu.backend.service): {exc}"
         )
-    return ServingService.from_model_name(db, model_name)
+    serving = ServingService.from_model_name(db, model_name)
+    if db.token_counter is None:
+        # explicit wiring (not a constructor side effect): the deployment's
+        # single backend tokenizer fills Message.token_count — the counter
+        # the reference keeps pluggable but never supplies (` main.py:295`)
+        db.token_counter = serving.tokenizer.count
+    return serving
 
 
 def main() -> None:

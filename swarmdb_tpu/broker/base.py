@@ -27,6 +27,7 @@ from __future__ import annotations
 import abc
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -207,6 +208,7 @@ class _PartitionCursor:
     topic: str
     partition: int
     next_offset: int
+    buffer: "deque" = field(default_factory=lambda: deque())
 
 
 class Consumer:
@@ -217,6 +219,12 @@ class Consumer:
     partition)`` pairs — normally exactly the one partition its agent hashes
     to — and round-robins across them.
     """
+
+    # prefetch granularity and auto-commit cadence (rdkafka-style periodic
+    # commits: at-least-once, bounded redelivery window after a crash)
+    FETCH_BATCH = 64
+    COMMIT_EVERY_RECORDS = 64
+    COMMIT_EVERY_S = 1.0
 
     def __init__(
         self,
@@ -232,6 +240,8 @@ class Consumer:
         self._cursors: List[_PartitionCursor] = []
         self._rr = 0  # round-robin index
         self._closed = False
+        self._uncommitted = 0
+        self._last_commit = time.time()
 
     def assign(self, assignments: Sequence[Tuple[str, int]]) -> None:
         """Subscribe to explicit (topic, partition) pairs."""
@@ -280,8 +290,26 @@ class Consumer:
             raise UnknownTopicError(topic)
         self.assign([(topic, p) for p in range(meta.num_partitions)])
 
+    def _take(self, cur: _PartitionCursor) -> Record:
+        rec = cur.buffer.popleft()
+        cur.next_offset = rec.offset + 1
+        if self._auto_commit:
+            # periodic commit, not per record: a commit is a durable-log
+            # append broker-side, so per-record committing puts one file
+            # write on every consumed message
+            self._uncommitted += 1
+            now = time.time()
+            if (self._uncommitted >= self.COMMIT_EVERY_RECORDS
+                    or now - self._last_commit >= self.COMMIT_EVERY_S):
+                self.commit()
+        return rec
+
     def poll(self, timeout: float = 0.0) -> Optional[Record]:
-        """Next record from any assigned partition, or None on timeout."""
+        """Next record from any assigned partition, or None on timeout.
+
+        Records are prefetched in batches of ``FETCH_BATCH`` per broker
+        call; offsets auto-commit periodically (see _take).
+        """
         if self._closed or not self._cursors:
             return None
         deadline = time.time() + max(0.0, timeout)
@@ -289,19 +317,18 @@ class Consumer:
             for _ in range(len(self._cursors)):
                 cur = self._cursors[self._rr % len(self._cursors)]
                 self._rr += 1
+                if cur.buffer:
+                    return self._take(cur)
                 # Retention may have trimmed past our cursor — skip forward.
                 begin = self._broker.begin_offset(cur.topic, cur.partition)
                 if cur.next_offset < begin:
                     cur.next_offset = begin
-                recs = self._broker.fetch(cur.topic, cur.partition, cur.next_offset, 1)
+                recs = self._broker.fetch(
+                    cur.topic, cur.partition, cur.next_offset, self.FETCH_BATCH
+                )
                 if recs:
-                    rec = recs[0]
-                    cur.next_offset = rec.offset + 1
-                    if self._auto_commit:
-                        self._broker.commit_offset(
-                            self.group_id, cur.topic, cur.partition, cur.next_offset
-                        )
-                    return rec
+                    cur.buffer.extend(recs)
+                    return self._take(cur)
             remaining = deadline - time.time()
             if remaining <= 0:
                 return None
@@ -317,6 +344,8 @@ class Consumer:
             self._broker.commit_offset(
                 self.group_id, cur.topic, cur.partition, cur.next_offset
             )
+        self._uncommitted = 0
+        self._last_commit = time.time()
 
     def close(self) -> None:
         if not self._closed:

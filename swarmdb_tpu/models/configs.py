@@ -28,6 +28,10 @@ class ModelConfig:
     # MoE (Mixtral-style); n_experts=0 => dense FFN
     n_experts: int = 0
     experts_per_token: int = 2
+    # Mistral-style sliding-window attention; None = full causal.
+    # (Mixtral-8x7B's official config disables it — null — so the
+    # registry entry keeps None; the plumbing exists for windowed configs.)
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:

@@ -159,7 +159,7 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         ck, cv = write_kv_cache(ck, cv, k, v, positions)
-        attn = gqa_attention(q, ck, cv, positions)
+        attn = gqa_attention(q, ck, cv, positions, window=cfg.sliding_window)
         attn_out = jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
         x = x + attn_out
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -221,7 +221,8 @@ def forward_seq_parallel(
                 B, T, cfg.n_kv_heads, cfg.head_dim)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-            attn = ring_attention(q, k, v, positions, positions, seq_axis)
+            attn = ring_attention(q, k, v, positions, positions, seq_axis,
+                                  window=cfg.sliding_window)
             x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
             x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])

@@ -200,7 +200,7 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         ck, cv = write_kv_cache(ck, cv, k, v, positions)
-        attn = gqa_attention(q, ck, cv, positions)
+        attn = gqa_attention(q, ck, cv, positions, window=cfg.sliding_window)
         x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
 
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

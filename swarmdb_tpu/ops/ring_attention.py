@@ -31,6 +31,7 @@ def _block_attend(
     v: jnp.ndarray,        # [B, Tk, Hkv, D] fp32
     q_pos: jnp.ndarray,    # [B, Tq]
     kv_pos: jnp.ndarray,   # [B, Tk]
+    window=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One block of masked attention: returns (scores-exp sum `l`,
     running max `m`, weighted values `o`) for online-softmax merging."""
@@ -39,7 +40,10 @@ def _block_attend(
     group = Hq // Hkv
     qg = q.reshape(B, Tq, Hkv, group, D)
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k) / jnp.sqrt(jnp.float32(D))
-    mask = (kv_pos[:, None, :] <= q_pos[:, :, None])[:, None, None]  # [B,1,1,Tq,Tk]
+    causal = kv_pos[:, None, :] <= q_pos[:, :, None]  # [B,Tq,Tk]
+    if window is not None:
+        causal &= kv_pos[:, None, :] > (q_pos[:, :, None] - window)
+    mask = causal[:, None, None]                      # [B,1,1,Tq,Tk]
     scores = jnp.where(mask, scores, -jnp.inf)
 
     m = jnp.max(scores, axis=-1)                      # [B,Hkv,G,Tq]
@@ -59,6 +63,7 @@ def ring_attention(
     q_pos: jnp.ndarray,    # [B, Tq] global positions of the local queries
     kv_pos: jnp.ndarray,   # [B, Tk] global positions of the local keys
     axis_name: str,
+    window=None,           # sliding-window size (None = full causal)
 ) -> jnp.ndarray:
     """Causal GQA attention across a ring of devices (call under shard_map
     with ``axis_name`` bound). Returns [B, Tq, Hq, D] in q.dtype."""
@@ -86,7 +91,7 @@ def ring_attention(
     def attend(k_cur, v_cur, pos_cur, acc):
         return merge(acc, _block_attend(
             qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
-            q_pos, pos_cur,
+            q_pos, pos_cur, window=window,
         ))
 
     def step(carry, _):

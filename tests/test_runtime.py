@@ -343,12 +343,13 @@ def test_snapshot_with_separator_chars_in_ids(tmp_path):
     db = SwarmDB(broker=b, save_dir=str(tmp_path / "h"))
     mid = db.send_message("team|alpha", "user|beta", "pipes everywhere")
     db.receive_messages("user|beta", timeout=0.5)
-    b.flush()
+    part = db._get_partition("user|beta")
+    # offsets commit periodically / on close (rdkafka-style), so close the
+    # runtime (committing + flushing) before checking the persisted state
+    db.close()
     b2 = LocalBroker(snapshot_path=path)  # must not crash on restore
     assert b2.committed_offset(
-        f"{db.config.group_id}_user|beta", db.topic_name,
-        db._get_partition("user|beta")) is not None
-    db.close()
+        f"{db.config.group_id}_user|beta", db.topic_name, part) is not None
 
 
 def test_broadcast_no_duplicate_after_scale(tmp_swarm):
