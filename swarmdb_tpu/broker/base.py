@@ -130,6 +130,18 @@ class Broker(abc.ABC):
     def trim_older_than(self, topic: str, cutoff_ts: float) -> int:
         """Drop records older than cutoff; returns number dropped."""
 
+    def durable_offset(self, topic: str, partition: int) -> int:
+        """Offsets below this are crash-durable. The default (== end_offset)
+        is correct for brokers whose append IS the durability point (the
+        in-memory LocalBroker); the native broker reports its group-commit
+        fsync watermark instead."""
+        return self.end_offset(topic, partition)
+
+    def wait_durable(self, topic: str, partition: int, offset: int,
+                     timeout_s: float) -> bool:
+        """Block until the record at ``offset`` is durable (or timeout)."""
+        return self.durable_offset(topic, partition) > offset
+
     def flush(self) -> None:
         """Force durability (fsync segment logs). No-op for in-memory."""
 
@@ -148,19 +160,29 @@ class Broker(abc.ABC):
 
 
 class Producer:
-    """Client-side producer with delivery reports.
+    """Client-side producer with acks=all delivery reports.
 
     Mirrors the confluent Producer surface the reference uses
     (` main.py:476-484`): ``produce(topic, value, key, partition,
     on_delivery)`` + ``poll`` + ``flush``. Callbacks are queued at produce
-    time and fired from ``poll``/``flush``, matching rdkafka's
-    callback-on-poll contract.
+    time and fired from ``poll``/``flush`` — but ONLY once the record's
+    offset clears the broker's durability watermark
+    (``Broker.durable_offset``), matching the reference's ``acks=all``
+    contract (` main.py:196-197`): a delivery report implies the record
+    survives a broker crash. For the in-memory LocalBroker the watermark is
+    the end offset, so callbacks fire on the next poll; for the native
+    broker they fire after its group-commit fsync (~sync_interval_ms).
     """
 
     def __init__(self, broker: Broker) -> None:
         self._broker = broker
         self._pending: List[Tuple[DeliveryCallback, Optional[str], Record]] = []
         self._pending_lock = threading.Lock()
+        # serializes whole poll() invocations: two concurrent pollers (the
+        # runtime's delivery-poll thread + send_message's inline poll) could
+        # otherwise swap out separate batches and fire per-partition
+        # callbacks out of order
+        self._poll_lock = threading.Lock()
 
     def produce(
         self,
@@ -190,17 +212,63 @@ class Producer:
         return record
 
     def poll(self, timeout: float = 0.0) -> int:
-        """Fire queued delivery callbacks; returns how many fired."""
-        with self._pending_lock:
-            batch, self._pending = self._pending, []
-        for cb, err, rec in batch:
-            cb(err, rec)
-        return len(batch)
+        """Fire delivery callbacks for durably-committed records.
+
+        Returns how many fired. Records not yet past the durability
+        watermark stay queued for a later poll (or ``flush``). A positive
+        ``timeout`` blocks up to that long for the oldest pending record to
+        become durable.
+        """
+        with self._poll_lock:
+            with self._pending_lock:
+                batch, self._pending = self._pending, []
+            if not batch:
+                return 0
+            if timeout > 0:
+                oldest = batch[0][2]
+                self._broker.wait_durable(
+                    oldest.topic, oldest.partition, oldest.offset, timeout
+                )
+            fired = 0
+            requeue: List[Tuple[DeliveryCallback, Optional[str], Record]] = []
+            watermarks: Dict[Tuple[str, int], int] = {}
+            part_errors: Dict[Tuple[str, int], str] = {}
+            for cb, err, rec in batch:
+                tp = (rec.topic, rec.partition)
+                if tp not in watermarks and tp not in part_errors:
+                    try:
+                        watermarks[tp] = self._broker.durable_offset(*tp)
+                    except BrokerError as exc:
+                        # topic gone or partition poisoned (failed fsync):
+                        # durability can never be confirmed — report the
+                        # ERROR, never a false DELIVERED
+                        part_errors[tp] = str(exc)
+                if tp in part_errors and err is None:
+                    err = part_errors[tp]
+                if err is not None or rec.offset < watermarks[tp]:
+                    cb(err, rec)
+                    fired += 1
+                else:
+                    requeue.append((cb, err, rec))
+            if requeue:
+                with self._pending_lock:
+                    # prepend to preserve per-partition callback order
+                    self._pending = requeue + self._pending
+            return fired
 
     def flush(self, timeout: float = -1.0) -> int:
-        self.poll(0)
+        """Force durability, then fire every pending callback."""
         self._broker.flush()
-        return 0
+        self.poll(0)
+        with self._pending_lock:
+            remaining = len(self._pending)
+        return remaining
+
+    @property
+    def pending_count(self) -> int:
+        """Delivery callbacks queued but not yet past the durability gate."""
+        with self._pending_lock:
+            return len(self._pending)
 
 
 @dataclass

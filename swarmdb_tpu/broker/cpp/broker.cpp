@@ -17,7 +17,13 @@
 //     compacted on open;
 //   - wait_for_data via per-partition condition variables (the blocking
 //     poll the Python Consumer uses);
-//   - flush() = fsync of every dirty fd (the `acks=all` durability point).
+//   - group-commit durability: a background flusher thread fsyncs dirty
+//     partitions every sync_interval_ms and advances a per-partition
+//     synced_offset; producers defer delivery reports until their record's
+//     offset is below synced_offset (the `acks=all` durability point —
+//     reference ` main.py:196-197` — a DELIVERED report implies the record
+//     survives a crash);
+//   - flush() = immediate fsync of every dirty fd + synced_offset advance.
 //
 // Exposed as a flat C API for ctypes (no pybind11 in this image).
 // Threading: a shared_mutex over the topic map; one mutex+condvar per
@@ -25,6 +31,7 @@
 // thread-safe.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -35,6 +42,7 @@
 #include <mutex>
 #include <shared_mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include <dirent.h>
@@ -67,13 +75,20 @@ struct RecordMeta {
 
 struct Partition {
   std::mutex mu;
-  std::condition_variable cv;
+  std::condition_variable cv;  // notified on append AND on durability advance
   int fd = -1;
   std::deque<RecordMeta> recs;
-  int64_t next_offset = 0;  // end (next to assign)
-  int64_t base_offset = 0;  // begin (earliest retained)
-  uint64_t file_end = 0;    // append position
+  int64_t next_offset = 0;    // end (next to assign)
+  int64_t base_offset = 0;    // begin (earliest retained)
+  int64_t synced_offset = 0;  // offsets < this are fsynced (group commit)
+  uint64_t file_end = 0;      // append position
   bool dirty = false;
+  // A failed fsync POISONS the partition: Linux clears the kernel error
+  // state and marks the lost pages clean, so a retried fsync would succeed
+  // without the data — advancing the watermark over records that are not on
+  // disk. Once set, appends fail and the watermark is frozen; producers see
+  // error delivery reports instead of false DELIVERED acks.
+  bool io_failed = false;
 
   ~Partition() {
     if (fd >= 0) ::close(fd);
@@ -96,10 +111,92 @@ struct Broker {
   int offsets_fd = -1;
   bool offsets_dirty = false;
 
+  // group-commit flusher
+  std::thread flusher;
+  std::atomic<bool> stop{false};
+  std::mutex stop_mu;
+  std::condition_variable stop_cv;  // wakes the flusher early on shutdown
+  int sync_interval_ms = 5;
+  // serializes flush rounds: an explicit swb_flush that races the background
+  // flusher must not return before in-flight fsyncs advance synced_offset
+  std::mutex flush_mu;
+
   ~Broker() {
     if (offsets_fd >= 0) ::close(offsets_fd);
   }
 };
+
+// Topic names become filesystem paths and offsets-log fields; reject anything
+// that could escape the log dir or corrupt the tab/newline-framed offsets log.
+bool valid_topic_name(const char* name) {
+  if (!name || !*name) return false;
+  size_t len = ::strlen(name);
+  if (len > 255) return false;
+  if (name[0] == '_' && name[1] == '_') return false;  // reserved (__offsets__)
+  if (::strcmp(name, ".") == 0) return false;  // would write into the log root
+  if (::strstr(name, "..")) return false;
+  for (size_t i = 0; i < len; ++i) {
+    unsigned char c = static_cast<unsigned char>(name[i]);
+    if (c < 0x20 || c == 0x7f || c == '/' || c == '\\') return false;
+  }
+  return true;
+}
+
+// Percent-escape the separator/control bytes so arbitrary group ids (they are
+// derived from agent ids arriving over HTTP) round-trip the offsets log.
+std::string esc_field(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char ch : s) {
+    switch (ch) {
+      case '%': out += "%25"; break;
+      case '\t': out += "%09"; break;
+      case '\n': out += "%0A"; break;
+      case '\r': out += "%0D"; break;
+      case '\x1f': out += "%1F"; break;  // offsets_key field separator
+      default: out += ch;
+    }
+  }
+  return out;
+}
+
+int hex_val(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  return -1;
+}
+
+std::string unesc_field(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      int hi = hex_val(s[i + 1]), lo = hex_val(s[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out += static_cast<char>(hi * 16 + lo);
+        i += 2;
+        continue;
+      }
+    }
+    out += s[i];
+  }
+  return out;
+}
+
+bool append_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
 
 bool write_all(int fd, const void* buf, size_t n, uint64_t pos) {
   const char* p = static_cast<const char*>(buf);
@@ -202,6 +299,8 @@ bool open_partition(Broker& b, const std::string& topic, int idx,
     p.base_offset = p.recs.front().offset;
     p.next_offset = p.recs.back().offset + 1;
   }
+  // everything that survived the scan is on disk already
+  p.synced_offset = p.next_offset;
   // a trim sidecar may advance past what the file scan shows (fully- or
   // partially-trimmed logs keep their bytes; the head/tail are logical)
   int64_t base = 0, next = 0;
@@ -210,6 +309,7 @@ bool open_partition(Broker& b, const std::string& topic, int idx,
     if (base > p.base_offset) p.base_offset = base;
     while (!p.recs.empty() && p.recs.front().offset < p.base_offset)
       p.recs.pop_front();
+    p.synced_offset = p.next_offset;
   }
   return true;
 }
@@ -248,18 +348,46 @@ std::string offsets_key(const char* group, const char* topic, int part) {
   return k;
 }
 
+// One offsets-log line: esc(group)<TAB>esc(topic)<TAB>part<TAB>offset<LF>.
+std::string format_offset_line(const std::string& group,
+                               const std::string& topic, int part,
+                               long long off) {
+  return esc_field(group) + '\t' + esc_field(topic) + '\t' +
+         std::to_string(part) + '\t' + std::to_string(off) + '\n';
+}
+
 void load_offsets(Broker& b) {
   std::string path = b.dir + "/__offsets__.log";
   FILE* f = ::fopen(path.c_str(), "r");
   if (f) {
-    char group[512], topic[512];
-    int part;
-    long long off;
-    // lines: group<TAB>topic<TAB>part<TAB>offset
-    while (::fscanf(f, "%511[^\t]\t%511[^\t]\t%d\t%lld\n", group, topic, &part,
-                    &off) == 4) {
-      b.offsets[offsets_key(group, topic, part)] = off;
+    // line-at-a-time with defensive parsing: a malformed line (torn tail,
+    // short write merged with its successor) loses only itself — the parser
+    // resyncs at the next newline instead of abandoning the rest of the log
+    char* line = nullptr;
+    size_t cap = 0;
+    ssize_t n;
+    while ((n = ::getline(&line, &cap, f)) >= 0) {
+      std::string s(line, static_cast<size_t>(n));
+      while (!s.empty() && (s.back() == '\n' || s.back() == '\r')) s.pop_back();
+      size_t a = s.find('\t');
+      size_t c = a == std::string::npos ? a : s.find('\t', a + 1);
+      size_t d = c == std::string::npos ? c : s.find('\t', c + 1);
+      if (d == std::string::npos || s.find('\t', d + 1) != std::string::npos)
+        continue;
+      errno = 0;
+      char *pe = nullptr, *oe = nullptr;
+      std::string ps = s.substr(c + 1, d - c - 1);
+      std::string os = s.substr(d + 1);
+      long part = ::strtol(ps.c_str(), &pe, 10);
+      long long off = ::strtoll(os.c_str(), &oe, 10);
+      if (errno || !pe || *pe || !oe || *oe || ps.empty() || os.empty())
+        continue;
+      std::string group = unesc_field(s.substr(0, a));
+      std::string topic = unesc_field(s.substr(a + 1, c - a - 1));
+      b.offsets[offsets_key(group.c_str(), topic.c_str(),
+                            static_cast<int>(part))] = off;
     }
+    ::free(line);
     ::fclose(f);
   }
   // compact: rewrite current state, then append from there
@@ -267,11 +395,12 @@ void load_offsets(Broker& b) {
   FILE* out = ::fopen(tmp.c_str(), "w");
   if (out) {
     for (auto& kv : b.offsets) {
-      std::string k = kv.first;
+      const std::string& k = kv.first;
       size_t a = k.find('\x1f'), c = k.rfind('\x1f');
-      ::fprintf(out, "%s\t%s\t%s\t%lld\n", k.substr(0, a).c_str(),
-                k.substr(a + 1, c - a - 1).c_str(), k.substr(c + 1).c_str(),
-                static_cast<long long>(kv.second));
+      std::string ln = format_offset_line(
+          k.substr(0, a), k.substr(a + 1, c - a - 1),
+          ::atoi(k.substr(c + 1).c_str()), kv.second);
+      ::fwrite(ln.data(), 1, ln.size(), out);
     }
     ::fclose(out);
     ::rename(tmp.c_str(), path.c_str());
@@ -284,13 +413,74 @@ Topic* find_topic(Broker& b, const char* name) {
   return it == b.topics.end() ? nullptr : &it->second;
 }
 
+// One group-commit round: fsync every dirty partition, advance its
+// synced_offset to the pre-fsync end, and wake durability waiters. The fsync
+// runs with the partition lock RELEASED (appends proceed concurrently; bytes
+// written during the fsync are covered by the next round).
+void flush_impl(Broker& b) {
+  // Rounds are serialized: a caller that races an in-flight round blocks
+  // here until that round's fsyncs have advanced synced_offset, so an
+  // explicit flush returning implies every pre-call append is durable.
+  std::unique_lock flush_lk(b.flush_mu);
+  {
+    std::shared_lock lk(b.topics_mu);
+    for (auto& kv : b.topics) {
+      for (auto& pp : kv.second.parts) {
+        Partition& p = *pp;
+        int fd;
+        int64_t target;
+        {
+          std::unique_lock plk(p.mu);
+          if (!p.dirty || p.fd < 0 || p.io_failed) continue;
+          fd = p.fd;
+          target = p.next_offset;
+          p.dirty = false;
+        }
+        bool synced = ::fsync(fd) == 0;
+        {
+          std::unique_lock plk(p.mu);
+          if (synced && !p.io_failed) {
+            if (target > p.synced_offset) p.synced_offset = target;
+          } else if (!synced) {
+            // see Partition::io_failed: a retry would falsely succeed
+            p.io_failed = true;
+          }
+          p.cv.notify_all();  // wake durability waiters either way
+        }
+      }
+    }
+  }
+  std::unique_lock lk(b.offsets_mu);
+  if (b.offsets_dirty && b.offsets_fd >= 0) {
+    // keep dirty on failure; unlike the data log this is safe to retry —
+    // commits are append-superseded, so a lost page only means replay
+    // (at-least-once), never false durability
+    if (::fsync(b.offsets_fd) == 0) b.offsets_dirty = false;
+  }
+}
+
+void flusher_main(Broker* b) {
+  for (;;) {
+    {
+      // stop-aware wait: shutdown must not block a full sync interval
+      std::unique_lock lk(b->stop_mu);
+      b->stop_cv.wait_for(lk, std::chrono::milliseconds(b->sync_interval_ms),
+                          [&] { return b->stop.load(); });
+    }
+    if (b->stop.load()) break;
+    flush_impl(*b);
+  }
+  flush_impl(*b);
+}
+
 }  // namespace
 
 extern "C" {
 
-void* swb_open(const char* log_dir) {
+void* swb_open2(const char* log_dir, int sync_interval_ms) {
   auto* b = new Broker();
   b->dir = log_dir;
+  b->sync_interval_ms = sync_interval_ms > 0 ? sync_interval_ms : 5;
   ::mkdir(b->dir.c_str(), 0755);
   // discover existing topics (directories with a meta file)
   DIR* d = ::opendir(b->dir.c_str());
@@ -321,15 +511,28 @@ void* swb_open(const char* log_dir) {
     ::closedir(d);
   }
   load_offsets(*b);
+  b->flusher = std::thread(flusher_main, b);
   return b;
 }
 
-void swb_shutdown(void* bp) { delete static_cast<Broker*>(bp); }
+void* swb_open(const char* log_dir) { return swb_open2(log_dir, 5); }
 
-// 1 = created, 0 = existed, -1 = error
+void swb_shutdown(void* bp) {
+  auto* b = static_cast<Broker*>(bp);
+  {
+    std::unique_lock lk(b->stop_mu);
+    b->stop.store(true);
+  }
+  b->stop_cv.notify_all();
+  if (b->flusher.joinable()) b->flusher.join();
+  delete b;
+}
+
+// 1 = created, 0 = existed, -1 = error (invalid name / partitions)
 int swb_create_topic(void* bp, const char* name, int num_partitions,
                      long long retention_ms) {
   auto& b = *static_cast<Broker*>(bp);
+  if (!valid_topic_name(name)) return -1;
   std::unique_lock lk(b.topics_mu);
   if (b.topics.count(name)) return 0;
   if (num_partitions <= 0) return -1;
@@ -396,6 +599,7 @@ long long swb_append(void* bp, const char* topic, int partition,
     return -1;
   Partition& p = *t->parts[partition];
   std::unique_lock plk(p.mu);
+  if (p.io_failed) return -1;
   RecordHeader h{kMagic, p.next_offset, timestamp, key ? key_len : -1, val_len};
   uint64_t klen = key ? static_cast<uint64_t>(key_len) : 0;
   std::vector<char> frame(sizeof(h) + klen + static_cast<uint64_t>(val_len));
@@ -505,15 +709,47 @@ void swb_commit_offset(void* bp, const char* group, const char* topic,
   std::unique_lock lk(b.offsets_mu);
   b.offsets[offsets_key(group, topic, partition)] = offset;
   if (b.offsets_fd >= 0) {
-    char line[1600];
-    int n = ::snprintf(line, sizeof(line), "%s\t%s\t%d\t%lld\n", group, topic,
-                       partition, offset);
-    if (n > 0) {
-      ssize_t w = ::write(b.offsets_fd, line, static_cast<size_t>(n));
-      (void)w;
+    std::string line = format_offset_line(group, topic, partition, offset);
+    // full-line write loop: a short write (ENOSPC) may still leave a partial
+    // line, but load_offsets resyncs at the next newline so only this commit
+    // is lost, and a later commit for the same key supersedes it anyway
+    if (append_all(b.offsets_fd, line.data(), line.size()))
       b.offsets_dirty = true;
-    }
   }
+}
+
+// Durability plane: offsets < synced_offset are fsynced to the log. The
+// Python Producer defers delivery callbacks until the record clears this
+// watermark (`acks=all` semantics).
+// -1 unknown topic/partition; -2 partition poisoned by a failed fsync
+long long swb_durable_offset(void* bp, const char* topic, int partition) {
+  auto& b = *static_cast<Broker*>(bp);
+  std::shared_lock lk(b.topics_mu);
+  Topic* t = find_topic(b, topic);
+  if (!t || partition < 0 || partition >= t->num_partitions) return -1;
+  Partition& p = *t->parts[partition];
+  std::unique_lock plk(p.mu);
+  if (p.io_failed) return -2;
+  return p.synced_offset;
+}
+
+// 1 = record at `offset` is durable, 0 = timeout, -1 = error
+int swb_wait_durable(void* bp, const char* topic, int partition,
+                     long long offset, double timeout_s) {
+  auto& b = *static_cast<Broker*>(bp);
+  Partition* p = nullptr;
+  {
+    std::shared_lock lk(b.topics_mu);
+    Topic* t = find_topic(b, topic);
+    if (!t || partition < 0 || partition >= t->num_partitions) return -1;
+    p = t->parts[partition].get();
+  }
+  std::unique_lock plk(p->mu);
+  bool ok = p->cv.wait_for(
+      plk, std::chrono::duration<double>(timeout_s),
+      [&] { return p->synced_offset > offset || p->io_failed; });
+  if (p->io_failed && p->synced_offset <= offset) return -2;
+  return ok ? 1 : 0;
 }
 
 long long swb_committed_offset(void* bp, const char* group, const char* topic,
@@ -548,6 +784,9 @@ long long swb_trim_older_than(void* bp, const char* topic, double cutoff_ts) {
         ::ftruncate(p.fd, 0);
         p.file_end = 0;
         p.dirty = true;
+        // trimmed records are gone by policy; release any durability waiters
+        p.synced_offset = p.next_offset;
+        p.cv.notify_all();
       }
     } else if (dropped != before) {
       p.base_offset = p.recs.front().offset;
@@ -557,26 +796,6 @@ long long swb_trim_older_than(void* bp, const char* topic, double cutoff_ts) {
   return dropped;
 }
 
-void swb_flush(void* bp) {
-  auto& b = *static_cast<Broker*>(bp);
-  {
-    std::shared_lock lk(b.topics_mu);
-    for (auto& kv : b.topics) {
-      for (auto& pp : kv.second.parts) {
-        Partition& p = *pp;
-        std::unique_lock plk(p.mu);
-        if (p.dirty && p.fd >= 0) {
-          ::fsync(p.fd);
-          p.dirty = false;
-        }
-      }
-    }
-  }
-  std::unique_lock lk(b.offsets_mu);
-  if (b.offsets_dirty && b.offsets_fd >= 0) {
-    ::fsync(b.offsets_fd);
-    b.offsets_dirty = false;
-  }
-}
+void swb_flush(void* bp) { flush_impl(*static_cast<Broker*>(bp)); }
 
 }  // extern "C"

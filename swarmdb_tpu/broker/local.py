@@ -38,12 +38,27 @@ class _Partition:
 
 
 class LocalBroker(Broker):
+    # floor between durability-driven snapshots (wait_durable): one snapshot
+    # covers every record pending at that moment (group commit), so this
+    # bounds snapshot I/O at ~5/s regardless of message rate instead of
+    # letting the 5ms delivery poller rewrite full state per cycle.
+    SNAPSHOT_MIN_INTERVAL_S = 0.2
+
     def __init__(self, snapshot_path: Optional[str] = None) -> None:
         self._topics: Dict[str, TopicMeta] = {}
         self._parts: Dict[Tuple[str, int], _Partition] = {}
         self._offsets: Dict[Tuple[str, str, int], int] = {}  # (group, topic, part)
         self._meta_lock = threading.Lock()
         self._snapshot_path = snapshot_path
+        # durability watermark per (topic, partition): end offsets captured by
+        # the last snapshot. Only meaningful in snapshot mode — pure in-memory
+        # operation has no crash durability, so append IS its durability point
+        # and durable_offset == end_offset (see Broker.durable_offset).
+        self._snap_ends: Dict[Tuple[str, int], int] = {}
+        self._last_snapshot = 0.0
+        # serializes snapshot writes: concurrent flush() callers (delivery
+        # poller + explicit flush) share one fixed tmp path
+        self._snap_lock = threading.Lock()
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore(snapshot_path)
 
@@ -174,9 +189,42 @@ class LocalBroker(Broker):
         if self._snapshot_path:
             self.save_snapshot(self._snapshot_path)
 
+    def durable_offset(self, topic: str, partition: int) -> int:
+        """In snapshot mode the durability point is the last snapshot, not
+        append — delivery reports (acks=all) must not outrun it."""
+        if not self._snapshot_path:
+            return self.end_offset(topic, partition)
+        self._part(topic, partition)  # raises on unknown topic/partition
+        with self._meta_lock:
+            return self._snap_ends.get((topic, partition), 0)
+
+    def wait_durable(self, topic: str, partition: int, offset: int,
+                     timeout_s: float) -> bool:
+        if not self._snapshot_path:
+            return self.end_offset(topic, partition) > offset
+        if self.durable_offset(topic, partition) > offset:
+            return True
+        # group commit, degenerate form: snapshot now (covers every pending
+        # record at once) — rate-limited so a tight delivery-poll loop can't
+        # turn the send path into O(full state) disk writes per cycle; an
+        # explicit Producer.flush() -> Broker.flush() still snapshots
+        # unconditionally. Honor timeout_s: wait out the rate-limit window
+        # (or as much of it as the timeout allows) instead of returning
+        # immediately and inviting a caller busy-spin.
+        hold = self.SNAPSHOT_MIN_INTERVAL_S - (time.time() - self._last_snapshot)
+        if hold > 0:
+            time.sleep(min(hold, timeout_s))
+        if time.time() - self._last_snapshot >= self.SNAPSHOT_MIN_INTERVAL_S:
+            self.flush()
+        return self.durable_offset(topic, partition) > offset
+
     def save_snapshot(self, path: str) -> None:
         """Full-state JSON snapshot (reference persistence shape analog,
         ` main.py:852-892`, applied at the broker layer)."""
+        with self._snap_lock:
+            self._save_snapshot_locked(path)
+
+    def _save_snapshot_locked(self, path: str) -> None:
         with self._meta_lock:
             topics = {
                 n: {"num_partitions": m.num_partitions, "retention_ms": m.retention_ms}
@@ -192,8 +240,10 @@ class LocalBroker(Broker):
             "offsets": offsets,
             "timestamp": time.time(),
         }
+        ends: Dict[Tuple[str, int], int] = {}
         for (topic, p), part in parts.items():
             with part.cond:
+                ends[(topic, p)] = part.end_offset()
                 state["partitions"].append({
                     "topic": topic,
                     "partition": p,
@@ -215,6 +265,9 @@ class LocalBroker(Broker):
         with open(tmp, "w") as f:
             json.dump(state, f)
         os.replace(tmp, path)
+        with self._meta_lock:
+            self._snap_ends.update(ends)
+            self._last_snapshot = time.time()
 
     def _restore(self, path: str) -> None:
         with open(path) as f:
@@ -238,3 +291,6 @@ class LocalBroker(Broker):
             ]
         for group, topic, pnum, off in state.get("offsets", []):
             self._offsets[(group, topic, pnum)] = off
+        with self._meta_lock:
+            for (topic, p), part in self._parts.items():
+                self._snap_ends[(topic, p)] = part.end_offset()

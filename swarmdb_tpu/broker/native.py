@@ -34,24 +34,31 @@ _LIB_PATH = os.environ.get(
 _REC_HDR = struct.Struct("<qdii")  # offset, ts, key_len, val_len
 
 
-def build_native(force: bool = False) -> bool:
-    """Build the shared library if needed; True if it is now present."""
-    if not force and os.path.exists(_LIB_PATH):
-        return True
+def build_native() -> bool:
+    """Build (or freshen) the shared library; True if it is now present.
+
+    Always invokes make when targeting the in-tree library — the Makefile's
+    ``broker.cpp`` dependency makes it a no-op when fresh, and it guarantees
+    edits to broker.cpp are never shadowed by a stale binary (the .so is
+    gitignored, never committed). A custom SWARMDB_BROKER_LIB (e.g. the TSAN
+    build) is loaded as-is.
+    """
+    if _LIB_PATH != os.path.join(_CPP_DIR, "libswarmbroker.so"):
+        return os.path.exists(_LIB_PATH)
     try:
         subprocess.run(
             ["make", "-s", "libswarmbroker.so"],
             cwd=_CPP_DIR, check=True, capture_output=True, timeout=120,
         )
     except Exception:
-        return False
+        pass  # no toolchain: fall back to an existing binary if present
     return os.path.exists(_LIB_PATH)
 
 
 def native_available(autobuild: bool = True) -> bool:
-    if os.path.exists(_LIB_PATH):
-        return True
-    return build_native() if autobuild else False
+    if autobuild:
+        return build_native()
+    return os.path.exists(_LIB_PATH)
 
 
 _lib = None
@@ -67,6 +74,13 @@ def _load() -> ctypes.CDLL:
     c = ctypes.c_char_p
     lib.swb_open.restype = ctypes.c_void_p
     lib.swb_open.argtypes = [c]
+    lib.swb_open2.restype = ctypes.c_void_p
+    lib.swb_open2.argtypes = [c, ctypes.c_int]
+    lib.swb_durable_offset.restype = ctypes.c_longlong
+    lib.swb_durable_offset.argtypes = [ctypes.c_void_p, c, ctypes.c_int]
+    lib.swb_wait_durable.restype = ctypes.c_int
+    lib.swb_wait_durable.argtypes = [ctypes.c_void_p, c, ctypes.c_int,
+                                     ctypes.c_longlong, ctypes.c_double]
     lib.swb_shutdown.argtypes = [ctypes.c_void_p]
     lib.swb_create_topic.restype = ctypes.c_int
     lib.swb_create_topic.argtypes = [ctypes.c_void_p, c, ctypes.c_int,
@@ -106,7 +120,8 @@ def _load() -> ctypes.CDLL:
 class NativeBroker(Broker):
     """Durable partitioned-log broker backed by the C++ engine."""
 
-    def __init__(self, log_dir: Optional[str] = None) -> None:
+    def __init__(self, log_dir: Optional[str] = None,
+                 sync_interval_ms: int = 5) -> None:
         self._lib = _load()
         if log_dir is None:
             self._tmp = tempfile.TemporaryDirectory(prefix="swarmbroker_")
@@ -115,7 +130,7 @@ class NativeBroker(Broker):
             self._tmp = None
             os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
-        self._h = self._lib.swb_open(log_dir.encode())
+        self._h = self._lib.swb_open2(log_dir.encode(), sync_interval_ms)
         if not self._h:
             raise BrokerError(f"swb_open failed for {log_dir}")
         self._fetch_cap = 1 << 18
@@ -239,6 +254,23 @@ class NativeBroker(Broker):
         return None if off < 0 else int(off)
 
     # -- retention / durability ---------------------------------------------
+
+    def durable_offset(self, topic: str, partition: int) -> int:
+        off = self._lib.swb_durable_offset(self._h, topic.encode(), partition)
+        if off == -2:
+            # poisoned by a failed fsync: records can never become durable
+            raise BrokerError(
+                f"{topic}[{partition}]: partition poisoned by fsync failure"
+            )
+        if off < 0:
+            raise UnknownTopicError(f"{topic}[{partition}]")
+        return int(off)
+
+    def wait_durable(self, topic: str, partition: int, offset: int,
+                     timeout_s: float) -> bool:
+        return self._lib.swb_wait_durable(
+            self._h, topic.encode(), partition, offset, timeout_s
+        ) == 1
 
     def trim_older_than(self, topic: str, cutoff_ts: float) -> int:
         n = self._lib.swb_trim_older_than(self._h, topic.encode(), cutoff_ts)

@@ -94,6 +94,17 @@ class SwarmDB:
         self.token_counter = token_counter
         self.metrics = metrics or MetricsRegistry()
 
+        # Single-node broker: replication happens at the fsync group-commit
+        # level, not across broker replicas. Accepting replication_factor > 1
+        # and silently ignoring it would misrepresent the durability class a
+        # DELIVERED report implies, so reject it loudly.
+        if self.config.replication_factor > 1:
+            raise ValueError(
+                "replication_factor > 1 is not supported by the in-tree "
+                "single-node broker (durability = group-commit fsync; see "
+                "broker/cpp/broker.cpp). Use replication_factor=1."
+            )
+
         self.broker: Broker = broker if broker is not None else _default_broker(self.config)
         self.producer = Producer(self.broker)
         self._ensure_topics_exist()
@@ -127,6 +138,29 @@ class SwarmDB:
         self._stats_by_agent: Dict[str, Dict[str, int]] = {}
 
         os.makedirs(self.save_dir, exist_ok=True)
+
+        # Delivery-report poller: with acks=all semantics the broker's
+        # group-commit fsync completes AFTER produce returns, so callbacks
+        # queued at send time need a later poll to fire (rdkafka solves this
+        # with its background poll thread — same shape here). Wakes only
+        # while reports are pending; exits on close().
+        self._poller_stop = threading.Event()
+        self._poller = threading.Thread(
+            target=self._delivery_poll_loop, name="swarmdb-delivery-poll",
+            daemon=True,
+        )
+        self._poller.start()
+
+    def _delivery_poll_loop(self) -> None:
+        while not self._poller_stop.wait(0.005):
+            if self.producer.pending_count:
+                try:
+                    # positive timeout: blocks on the broker's durability
+                    # watermark (native: group-commit condvar; snapshot-mode
+                    # local: forces the snapshot) so reports actually fire
+                    self.producer.poll(0.02)
+                except Exception:
+                    logger.exception("delivery poll failed")
 
     # ------------------------------------------------------------------ setup
 
@@ -232,7 +266,10 @@ class SwarmDB:
             if msg is None:
                 return
             if err is None:
-                self._set_status(msg, MessageStatus.DELIVERED)
+                # upgrade only: the consumer may have READ the record before
+                # its durability-gated report fired — never walk that back
+                if msg.status == MessageStatus.PENDING:
+                    self._set_status(msg, MessageStatus.DELIVERED)
                 # first report wins: on broadcast fan-out the (partition,
                 # offset) of copy #1 is as good an anchor as any
                 msg.metadata.setdefault("partition", record.partition)
@@ -912,6 +949,15 @@ class SwarmDB:
         if self._closed:
             return
         self._closed = True
+        self._poller_stop.set()
+        self._poller.join(timeout=1.0)
+        # flush BEFORE the final snapshot: pending durability-gated delivery
+        # reports must land so the saved history doesn't freeze messages at
+        # a stale PENDING status
+        try:
+            self.producer.flush()
+        except Exception:
+            logger.exception("final producer flush failed")
         try:
             self.save_message_history()
         except Exception:

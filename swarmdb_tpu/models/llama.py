@@ -201,10 +201,10 @@ def forward_seq_parallel(
     both seq-sharded on device; callers either read the last-token logits
     or scatter the KV into a slot cache for decode.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..ops.ring_attention import ring_attention
+    from ..utils.compat import shard_map
 
     def local_fwd(params, tokens, positions):
         x = params["embed"][tokens]
@@ -246,7 +246,6 @@ def forward_seq_parallel(
             P(None, None, seq_axis, None, None),
             P(None, None, seq_axis, None, None),
         ),
-        check_vma=False,
     )
     logits, ks, vs = sharded(params, tokens, positions)
     return logits, (ks, vs)

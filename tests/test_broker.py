@@ -92,7 +92,9 @@ def test_producer_delivery_callback(broker):
     p.produce("t", b"v", key=b"k", partition=0,
               on_delivery=lambda err, rec: reports.append((err, rec.offset)))
     assert reports == []  # callbacks fire on poll, like rdkafka
-    assert p.poll(0) == 1
+    # acks=all: the report fires only once the record is durable (for the
+    # native broker that is the group-commit fsync, ~sync_interval_ms away)
+    assert p.poll(1.0) == 1
     assert reports == [(None, 0)]
 
 
@@ -197,3 +199,22 @@ def test_snapshot_binary_safe(tmp_path):
     rec = b2.fetch("t", 0, 0)[0]
     assert rec.value == blob
     assert rec.key == b"\xff\xfe\x00key"
+
+
+def test_snapshot_local_broker_delivery_gated_on_snapshot(tmp_path):
+    """acks=all for snapshot-mode LocalBroker: a delivery report implies the
+    record is IN a snapshot on disk (code-review r2 finding)."""
+    snap = str(tmp_path / "snap.json")
+    b = LocalBroker(snapshot_path=snap)
+    b.create_topic("t", 1)
+    p = Producer(b)
+    acked = []
+    p.produce("t", b"v", partition=0, on_delivery=lambda e, r: acked.append(r))
+    assert p.poll(0) == 0 and not acked  # no snapshot yet -> no report
+    import os as _os
+    assert not _os.path.exists(snap)
+    assert p.poll(1.0) == 1              # blocking poll forces the snapshot
+    assert _os.path.exists(snap) and len(acked) == 1
+    # the acked record really is in the snapshot
+    b2 = LocalBroker(snapshot_path=snap)
+    assert [r.value for r in b2.fetch("t", 0, 0)] == [b"v"]

@@ -192,3 +192,139 @@ def test_partial_trim_reopen_does_not_resurrect(tmp_path):
     assert b2.begin_offset("t", 0) == 1    # trimmed head stays trimmed
     assert [r.value for r in b2.fetch("t", 0, 0)] == [b"new"]
     b2.close()
+
+
+# ---------------------------------------------------------------------------
+# acks=all durability: a DELIVERED report must survive a hard crash
+# (reference semantics ` main.py:192-199`; VERDICT r1 missing #3)
+
+
+def test_delivered_means_durable_across_kill(tmp_path):
+    """Child process produces with delivery callbacks, reports which offsets
+    were acked, then dies via os._exit (no flush, no close). Every acked
+    offset must still be present when the log is reopened."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path / "log")
+    child = (
+        "import os, sys\n"
+        "from swarmdb_tpu.broker.native import NativeBroker\n"
+        "from swarmdb_tpu.broker.base import Producer\n"
+        "b = NativeBroker(log_dir=sys.argv[1], sync_interval_ms=2)\n"
+        "b.create_topic('t', 1)\n"
+        "p = Producer(b)\n"
+        "acked = []\n"
+        "for i in range(50):\n"
+        "    p.produce('t', b'v%d' % i, partition=0,\n"
+        "              on_delivery=lambda e, r: acked.append(r.offset))\n"
+        "    p.poll(0)\n"
+        "import time\n"
+        "deadline = time.time() + 5\n"
+        "while len(acked) < 10 and time.time() < deadline:\n"
+        "    time.sleep(0.005); p.poll(0)\n"
+        "sys.stdout.write(','.join(map(str, acked)))\n"
+        "sys.stdout.flush()\n"
+        "os._exit(1)\n"  # hard crash: no flush, no close, no atexit
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", child, d], capture_output=True, text=True,
+        timeout=60, cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+    acked = [int(x) for x in proc.stdout.split(",") if x]
+    assert len(acked) >= 10, f"child acked too few: {proc.stderr[-2000:]}"
+
+    b = NativeBroker(log_dir=d)
+    end = b.end_offset("t", 0)
+    assert end > max(acked), "acked offsets lost across crash"
+    recs = b.fetch("t", 0, 0, 100)
+    present = {r.offset for r in recs}
+    for off in acked:
+        assert off in present
+    b.close()
+
+
+def test_unacked_callbacks_defer_until_durable(tmp_path):
+    from swarmdb_tpu.broker.base import Producer
+
+    b = NativeBroker(log_dir=str(tmp_path / "log"), sync_interval_ms=2000)
+    b.create_topic("t", 1)
+    p = Producer(b)
+    acked = []
+    p.produce("t", b"v", partition=0, on_delivery=lambda e, r: acked.append(r))
+    # flusher interval is 2s: an immediate poll must NOT fire the report
+    assert p.poll(0) == 0 and acked == []
+    assert p.pending_count == 1
+    # explicit flush forces the group commit; report fires
+    p.flush()
+    assert len(acked) == 1
+    assert b.durable_offset("t", 0) == 1
+    b.close()
+
+
+def test_wait_durable(tmp_path):
+    b = NativeBroker(log_dir=str(tmp_path / "log"), sync_interval_ms=2)
+    b.create_topic("t", 1)
+    off = b.append("t", 0, b"v")
+    assert b.wait_durable("t", 0, off, timeout_s=5.0)
+    assert b.durable_offset("t", 0) > off
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# input hardening (ADVICE r1: topic names are filesystem paths; group ids
+# arrive over HTTP and land in the tab/newline-framed offsets log)
+
+
+def test_topic_name_sanitization(tmp_path):
+    from swarmdb_tpu.broker.base import BrokerError
+
+    b = NativeBroker(log_dir=str(tmp_path / "log"))
+    for bad in ["../evil", "a/b", "a\\b", "__reserved", "a\tb", "a\nb",
+                "", "x" * 256]:
+        with pytest.raises(BrokerError):
+            b.create_topic(bad, 1)
+    assert b.create_topic("fine-topic.v1", 1)
+    b.close()
+
+
+def test_offsets_log_escaping_roundtrip(tmp_path):
+    d = str(tmp_path / "log")
+    b = NativeBroker(log_dir=d)
+    b.create_topic("t", 1)
+    nasty = "agent\twith\nnasty%chars" + "x" * 600  # >511 bytes, tab, newline
+    b.commit_offset(nasty, "t", 0, 7)
+    b.commit_offset("plain", "t", 0, 3)
+    b.close()
+
+    b2 = NativeBroker(log_dir=d)  # reopen parses + compacts the offsets log
+    assert b2.committed_offset(nasty, "t", 0) == 7
+    assert b2.committed_offset("plain", "t", 0) == 3
+    b2.close()
+
+
+def test_dot_topic_name_rejected(tmp_path):
+    from swarmdb_tpu.broker.base import BrokerError
+
+    b = NativeBroker(log_dir=str(tmp_path / "log"))
+    with pytest.raises(BrokerError):
+        b.create_topic(".", 1)  # would write meta/0.log into the log root
+    b.close()
+
+
+def test_explicit_flush_racing_background_flusher(tmp_path):
+    """swb_flush must not return before a concurrently-running background
+    group-commit round has advanced synced_offset (code-review r2 finding).
+    Stress: many append+flush cycles against a 1ms background flusher."""
+    from swarmdb_tpu.broker.base import Producer
+
+    b = NativeBroker(log_dir=str(tmp_path / "log"), sync_interval_ms=1)
+    b.create_topic("t", 1)
+    p = Producer(b)
+    acked = []
+    for i in range(200):
+        p.produce("t", b"v%d" % i, partition=0,
+                  on_delivery=lambda e, r: acked.append(r.offset))
+        p.flush()  # contract: returns only once the record is durable
+        assert len(acked) == i + 1, f"flush returned without firing ack {i}"
+    b.close()
