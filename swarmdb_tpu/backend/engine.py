@@ -476,6 +476,7 @@ class Engine:
             slot.generated.append(token)
             self.total_generated += 1
             self.metrics.rates["tokens_generated"].mark(now)
+            self.metrics.counters["tokens_generated"].inc()
             if req.on_token is not None:
                 try:
                     req.on_token(req.request_id, token)

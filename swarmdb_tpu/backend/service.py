@@ -365,14 +365,20 @@ class ServingService:
         report engine state."""
         try:
             t0 = time.time()
-            val = _HEALTH_PROBE(jnp.ones((8, 8))).block_until_ready()
+            probe = _HEALTH_PROBE(jnp.ones((8, 8)))
+            val = probe.block_until_ready()
             device_ok = bool(val == 128.0)
             probe_ms = (time.time() - t0) * 1000
+            # device identity from the probe array itself — a bare
+            # jax.devices() re-enumerates backends and can hang when the
+            # TPU tunnel is flaky, which is exactly what this probe exists
+            # to detect
+            device = str(next(iter(probe.devices())))
         except Exception as exc:
             return {"status": "unhealthy", "error": str(exc)}
         return {
             "status": "healthy" if device_ok else "degraded",
-            "device": str(jax.devices()[0]),
+            "device": device,
             "probe_ms": round(probe_ms, 3),
             "backend_id": self.backend_id,
             "engine": self.engine.stats(),

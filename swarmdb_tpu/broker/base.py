@@ -219,16 +219,22 @@ class Producer:
         ``timeout`` blocks up to that long for the oldest pending record to
         become durable.
         """
+        if timeout > 0:
+            # blocking wait happens OUTSIDE _poll_lock: the background
+            # delivery poller parks here for its whole timeout, and holding
+            # the lock through it would stall every send_message's inline
+            # poll(0) behind the wait
+            with self._pending_lock:
+                oldest = self._pending[0][2] if self._pending else None
+            if oldest is not None:
+                self._broker.wait_durable(
+                    oldest.topic, oldest.partition, oldest.offset, timeout
+                )
         with self._poll_lock:
             with self._pending_lock:
                 batch, self._pending = self._pending, []
             if not batch:
                 return 0
-            if timeout > 0:
-                oldest = batch[0][2]
-                self._broker.wait_durable(
-                    oldest.topic, oldest.partition, oldest.offset, timeout
-                )
             fired = 0
             requeue: List[Tuple[DeliveryCallback, Optional[str], Record]] = []
             watermarks: Dict[Tuple[str, int], int] = {}

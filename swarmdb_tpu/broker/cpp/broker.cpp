@@ -120,6 +120,11 @@ struct Broker {
   // serializes flush rounds: an explicit swb_flush that races the background
   // flusher must not return before in-flight fsyncs advance synced_offset
   std::mutex flush_mu;
+  // external threads blocked in swb_wait_for_data / swb_wait_durable:
+  // shutdown wakes every partition cv and spins until this drains before
+  // deleting the Broker (otherwise a parked waiter's mutex/condvar would be
+  // destroyed under it — use-after-free)
+  std::atomic<int> waiters{0};
 
   ~Broker() {
     if (offsets_fd >= 0) ::close(offsets_fd);
@@ -525,6 +530,18 @@ void swb_shutdown(void* bp) {
   }
   b->stop_cv.notify_all();
   if (b->flusher.joinable()) b->flusher.join();
+  // wake every parked waiter and wait for them to leave before freeing the
+  // mutexes/condvars they are blocked on
+  {
+    std::shared_lock lk(b->topics_mu);
+    for (auto& kv : b->topics)
+      for (auto& pp : kv.second.parts) {
+        std::unique_lock plk(pp->mu);
+        pp->cv.notify_all();
+      }
+  }
+  while (b->waiters.load(std::memory_order_acquire) > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
   delete b;
 }
 
@@ -679,10 +696,21 @@ long long swb_begin_offset(void* bp, const char* topic, int partition) {
   return p.base_offset;
 }
 
+// RAII registration of a blocked external waiter; see Broker::waiters.
+struct WaiterGuard {
+  Broker& b;
+  explicit WaiterGuard(Broker& broker) : b(broker) {
+    b.waiters.fetch_add(1, std::memory_order_acq_rel);
+  }
+  ~WaiterGuard() { b.waiters.fetch_sub(1, std::memory_order_acq_rel); }
+};
+
 // 1 = data available at >= offset, 0 = timeout, -1 = error
 int swb_wait_for_data(void* bp, const char* topic, int partition,
                       long long offset, double timeout_s) {
   auto& b = *static_cast<Broker*>(bp);
+  WaiterGuard guard(b);
+  if (b.stop.load()) return 0;
   Partition* p = nullptr;
   {
     // Resolve the partition under the topics lock, then RELEASE it before
@@ -699,8 +727,8 @@ int swb_wait_for_data(void* bp, const char* topic, int partition,
   std::unique_lock plk(p->mu);
   bool ok = p->cv.wait_for(
       plk, std::chrono::duration<double>(timeout_s),
-      [&] { return p->next_offset > offset; });
-  return ok ? 1 : 0;
+      [&] { return p->next_offset > offset || b.stop.load(); });
+  return (ok && p->next_offset > offset) ? 1 : 0;
 }
 
 void swb_commit_offset(void* bp, const char* group, const char* topic,
@@ -733,10 +761,12 @@ long long swb_durable_offset(void* bp, const char* topic, int partition) {
   return p.synced_offset;
 }
 
-// 1 = record at `offset` is durable, 0 = timeout, -1 = error
+// 1 = record at `offset` is durable, 0 = timeout, -1 = error, -2 = poisoned
 int swb_wait_durable(void* bp, const char* topic, int partition,
                      long long offset, double timeout_s) {
   auto& b = *static_cast<Broker*>(bp);
+  WaiterGuard guard(b);
+  if (b.stop.load()) return 0;
   Partition* p = nullptr;
   {
     std::shared_lock lk(b.topics_mu);
@@ -747,9 +777,9 @@ int swb_wait_durable(void* bp, const char* topic, int partition,
   std::unique_lock plk(p->mu);
   bool ok = p->cv.wait_for(
       plk, std::chrono::duration<double>(timeout_s),
-      [&] { return p->synced_offset > offset || p->io_failed; });
+      [&] { return p->synced_offset > offset || p->io_failed || b.stop.load(); });
   if (p->io_failed && p->synced_offset <= offset) return -2;
-  return ok ? 1 : 0;
+  return (ok && p->synced_offset > offset) ? 1 : 0;
 }
 
 long long swb_committed_offset(void* bp, const char* group, const char* topic,

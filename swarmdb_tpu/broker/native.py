@@ -255,7 +255,12 @@ class NativeBroker(Broker):
 
     # -- retention / durability ---------------------------------------------
 
+    def _check_open(self) -> None:
+        if self._closed or self._h is None:
+            raise BrokerError("broker is closed")
+
     def durable_offset(self, topic: str, partition: int) -> int:
+        self._check_open()
         off = self._lib.swb_durable_offset(self._h, topic.encode(), partition)
         if off == -2:
             # poisoned by a failed fsync: records can never become durable
@@ -268,6 +273,7 @@ class NativeBroker(Broker):
 
     def wait_durable(self, topic: str, partition: int, offset: int,
                      timeout_s: float) -> bool:
+        self._check_open()
         return self._lib.swb_wait_durable(
             self._h, topic.encode(), partition, offset, timeout_s
         ) == 1

@@ -1,0 +1,84 @@
+"""Bench harness resilience tests (VERDICT r1: the round-1 bench produced
+`parsed: null`; the harness must now ALWAYS emit one parsed JSON line)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def test_chip_peak_flops_mapping():
+    assert bench.chip_peak_flops("TPU v5e") == 197e12
+    assert bench.chip_peak_flops("TPU v5p") == 459e12
+    assert bench.chip_peak_flops("TPU v4") == 275e12
+    assert bench.chip_peak_flops("cpu") is None
+    assert bench.chip_peak_flops("") is None
+
+
+def test_active_params_dense_vs_moe():
+    from swarmdb_tpu.models.configs import TINY_DEBUG, TINY_MOE
+
+    assert bench.active_params(1000, TINY_DEBUG) == 1000
+    total = 287552  # measured param count of tiny-moe
+    act = bench.active_params(total, TINY_MOE)
+    expert_ffn = 3 * TINY_MOE.dim * TINY_MOE.ffn_dim
+    expected = total - TINY_MOE.n_layers * expert_ffn * (
+        TINY_MOE.n_experts - TINY_MOE.experts_per_token
+    )
+    assert act == expected
+    assert 0 < act < total
+
+
+def test_probe_backend_failure_is_contained():
+    # a probe that cannot succeed (bogus interpreter) must return ok=False
+    # within its bounds, never raise
+    real = sys.executable
+    try:
+        sys.executable = "/nonexistent/python"
+        out = bench.probe_backend(timeout_s=2.0, retries=0)
+    finally:
+        sys.executable = real
+    assert out["ok"] is False
+    assert "error" in out
+
+
+def test_echo_mode_runs():
+    result = bench.bench_echo(seconds=0.5)
+    assert result["metric"] == "echo_messages_per_sec"
+    assert result["value"] > 0
+    assert result["unit"] == "msgs/sec"
+
+
+def test_unknown_mode_emits_parsed_json_line():
+    env = dict(os.environ, SWARMDB_BENCH_MODE="bogus-mode")
+    out = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "error" in line
+    assert line["vs_baseline"] == 0.0
+
+
+def test_failing_llm_mode_still_prints_line_with_echo_fallback():
+    env = dict(os.environ, SWARMDB_BENCH_MODE="serve",
+               SWARMDB_BENCH_PLATFORM="cpu",
+               SWARMDB_BENCH_MODEL="definitely-not-a-model",
+               SWARMDB_BENCH_SECONDS="1")
+    out = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "serve_error"
+    assert "error" in line
+    assert line.get("echo_fallback_msgs_per_sec", 0) > 0
