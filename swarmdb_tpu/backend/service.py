@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from ..core.messages import Message, MessagePriority, MessageType
 from ..core.runtime import SwarmDB
-from .engine import Engine, GenRequest
+from .engine import Engine, GenRequest, PagedKV
 from .sampling import SamplingParams
 from .tokenizer import Tokenizer, default_tokenizer
 
@@ -114,11 +114,22 @@ class ServingService:
         seed: int = 0,
         tokenizer_path: Optional[str] = None,
         decode_chunk: int = 8,
+        paged: Optional[bool] = None,
+        page_size: int = 16,
+        kv_pool_tokens: Optional[int] = None,
     ) -> "ServingService":
         """Build model + engine for a registry config. Weights are randomly
         initialized unless a checkpoint is loaded afterwards
         (``utils/checkpoint.py``) — shapes/compute are identical either way.
+
+        ``paged`` switches the decode cache to the block-paged pool
+        (ops/paged_kv.py; default = SWARMDB_PAGED env, off otherwise);
+        ``kv_pool_tokens`` bounds pool HBM (default: full max_batch*max_seq
+        coverage, i.e. no savings but no admission stalls — benches pass a
+        budget to realize the savings).
         """
+        import os
+
         from ..models import llama, mixtral
         from ..models.configs import get_config
 
@@ -129,16 +140,41 @@ class ServingService:
             params = mixtral.init_params(cfg, key)
             fwd = lambda p, t, pos, c: mixtral.forward(p, cfg, t, pos, c)
             init_cache = lambda b, s: mixtral.init_kv_cache(cfg, b, s)
+            paged_fwd = lambda p, t, pos, c: mixtral.forward_paged(p, cfg, t, pos, c)
+            init_pool_model = mixtral.init_paged_cache
         else:
             params = llama.init_params(cfg, key)
             fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
             init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+            paged_fwd = lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c)
+            init_pool_model = llama.init_paged_cache
+
+        if paged is None:
+            paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
+        paged_spec = None
+        if paged:
+            from ..ops.paged_kv import PageAllocator, pages_per_slot
+
+            maxp = pages_per_slot(seq, page_size)
+            if kv_pool_tokens is None and "SWARMDB_KV_POOL_TOKENS" in os.environ:
+                kv_pool_tokens = int(os.environ["SWARMDB_KV_POOL_TOKENS"])
+            pool_tokens = kv_pool_tokens or max_batch * maxp * page_size
+            num_pages = 1 + -(-pool_tokens // page_size)  # +1 trash page
+            paged_spec = PagedKV(
+                decode_forward=paged_fwd,
+                init_pool=lambda: init_pool_model(
+                    cfg, max_batch, seq, num_pages, page_size),
+                page_size=page_size,
+                num_pages=num_pages,
+                allocator=PageAllocator(num_pages, page_size, seq, max_batch),
+            )
+
         tokenizer = default_tokenizer(cfg.vocab_size, tokenizer_path)
         engine = Engine(
             fwd, init_cache, params,
             max_batch=max_batch, max_seq=seq,
             eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
-            metrics=db.metrics, decode_chunk=decode_chunk,
+            metrics=db.metrics, decode_chunk=decode_chunk, paged=paged_spec,
         )
         return cls(db, engine, tokenizer, backend_id=backend_id)
 

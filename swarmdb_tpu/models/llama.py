@@ -121,6 +121,24 @@ def cache_specs(model_axis: str = "model") -> Tuple[P, P]:
     return spec, spec
 
 
+def init_paged_cache(
+    cfg: ModelConfig,
+    batch: int,
+    max_seq: int,
+    num_pages: int,
+    page_size: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+):
+    """Block-paged KV pool (ops/paged_kv.py): HBM ∝ num_pages*page_size,
+    not batch*max_seq. Returns {"k", "v", "page_table"}."""
+    from ..ops.paged_kv import init_paged_kv_cache
+
+    return init_paged_kv_cache(
+        cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim,
+        batch, max_seq, dtype,
+    )
+
+
 # ------------------------------------------------------------------- forward
 
 
@@ -175,6 +193,62 @@ def forward(
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                         head.astype(jnp.float32))
     return logits, (new_k, new_v)
+
+
+def forward_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, 1] int32 — DECODE steps only
+    positions: jnp.ndarray,    # [B, 1] int32 absolute positions per row
+    cache,                     # {"k": [L,P,ps,Hkv,D], "v": ..., "page_table"}
+):
+    """Decode forward over the block-paged KV pool (ops/paged_kv.py).
+
+    Prefill stays on the dense bucket path (`forward` with a temp cache);
+    the engine scatters the prefix into pages at admission
+    (ops.paged_kv.paged_insert_prefill). Attention uses the ragged Pallas
+    kernel on TPU (reads only live pages) with an XLA gather fallback.
+    Returns fp32 logits [B, 1, V] and the updated cache dict.
+    """
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral.forward_paged")
+    from ..ops.layers import paged_attention_dispatch
+    from ..ops.paged_kv import paged_write_decode
+
+    x = params["embed"][tokens]  # [B, 1, D]
+    table = cache["page_table"]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
+            B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kp, vp = paged_write_decode(kp, vp, k, v, positions, table)
+        attn = paged_attention_dispatch(
+            q, kp, vp, table, positions, window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return logits, {"k": new_k, "v": new_v, "page_table": table}
 
 
 # ------------------------------------------- sequence-parallel long prefill

@@ -222,3 +222,71 @@ def forward(
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                         params["lm_head"].astype(jnp.float32))
     return logits, (new_k, new_v)
+
+
+def init_paged_cache(
+    cfg: ModelConfig,
+    batch: int,
+    max_seq: int,
+    num_pages: int,
+    page_size: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+):
+    """Block-paged KV pool; see ``llama.init_paged_cache``."""
+    from ..ops.paged_kv import init_paged_kv_cache
+
+    return init_paged_kv_cache(
+        cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim,
+        batch, max_seq, dtype,
+    )
+
+
+def forward_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B, 1] — DECODE steps only
+    positions: jnp.ndarray,  # [B, 1]
+    cache,                   # {"k", "v", "page_table"}
+):
+    """Decode forward over the block-paged KV pool; MoE FFN unchanged.
+    Same contract as ``llama.forward_paged``."""
+    if not cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is dense; use models.llama.forward_paged")
+    from ..ops.layers import paged_attention_dispatch
+    from ..ops.paged_kv import paged_write_decode
+
+    x = params["embed"][tokens]
+    table = cache["page_table"]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
+            B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kp, vp = paged_write_decode(kp, vp, k, v, positions, table)
+        attn = paged_attention_dispatch(
+            q, kp, vp, table, positions, window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        moe_out, _load = moe_block(
+            h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.experts_per_token,
+        )
+        x = x + moe_out
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits, {"k": new_k, "v": new_v, "page_table": table}

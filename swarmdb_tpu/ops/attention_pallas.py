@@ -1,4 +1,5 @@
-"""Pallas TPU kernel: decode-step GQA attention over the slot KV cache.
+"""Pallas TPU kernels: decode-step GQA attention (dense slot cache and
+ragged block-paged cache).
 
 The serving hot path (engine decode chunks) issues attention with ONE query
 per slot against that slot's cache lane. The XLA einsum path materializes
@@ -93,3 +94,127 @@ def decode_gqa_attention(
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=interpret,
     )(lengths, q, cache_k, cache_v)
+
+
+# ---------------------------------------------------------------------------
+# Ragged PAGED decode attention (ops/paged_kv.py pool layout).
+#
+# Grid (B, Hkv, maxp) with the page axis innermost; the page TABLE and the
+# per-slot lengths ride as scalar-prefetch operands so each grid step's
+# BlockSpec index_map can pick the right physical page — the standard TPU
+# paged-attention pattern (PrefetchScalarGridSpec). Two properties give the
+# bandwidth win over the XLA gather path:
+#   1. dead iterations (j beyond the slot's live pages) remap to the SAME
+#      page as the last live step, and Pallas skips the DMA for a block
+#      whose indices didn't change — so HBM traffic is ~live pages, not
+#      maxp pages;
+#   2. scores/softmax state stay in VMEM scratch across the page loop
+#      (online softmax), so nothing but the output tile is written back.
+
+
+def _paged_attn_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, page_size: int,
+                       window):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    maxp = pl.num_programs(2)
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * page_size < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [ps, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)      # [ps, D]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                      # [G, ps]
+
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)              # [1, ps] global pos
+        valid = pos < length
+        if window is not None:
+            valid &= pos > (length - 1 - window)
+        s = jnp.where(valid, s, -1e30)
+
+        m_prev = m_ref[:, :1]                          # [G, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)     # [G, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                # rescale old state
+        p = jnp.exp(s - m_new)                         # [G, ps]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == maxp - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)       # inactive slot: 0/eps
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "interpret")
+)
+def paged_decode_gqa_attention(
+    q: jnp.ndarray,           # [B, Hq, D] one decode query per slot
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] single-layer page pool
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp] int32
+    lengths: jnp.ndarray,     # [B] int32 valid prefix (q position + 1)
+    window=None,              # sliding-window size (None = full causal)
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged paged decode attention; returns [B, Hq, D] in q.dtype."""
+    B, Hq, D = q.shape
+    _, ps, Hkv, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    G = Hq // Hkv
+    qg = q.reshape(B, Hkv, G, D)
+    table = page_table.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    def q_map(b, h, j, table_ref, len_ref):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, j, table_ref, len_ref):
+        # dead iterations re-point at the last live page so their DMA is
+        # skipped (same indices as the previous step); length 0 -> trash 0
+        last_live = jnp.maximum((len_ref[b] - 1) // ps, 0)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, h, 0)
+
+    def o_map(b, h, j, table_ref, len_ref):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), q_map),
+            pl.BlockSpec((1, ps, 1, D), kv_map),
+            pl.BlockSpec((1, ps, 1, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),    # acc
+            pltpu.VMEM((G, 128), jnp.float32),  # running max (broadcast)
+            pltpu.VMEM((G, 128), jnp.float32),  # running denom (broadcast)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=ps, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=interpret,
+    )(table, lengths, qg, k_pages, v_pages)
+    return out.reshape(B, Hq, D)

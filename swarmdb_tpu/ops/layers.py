@@ -52,6 +52,47 @@ def _pallas_decode_enabled() -> bool:
     return os.environ.get("SWARMDB_PALLAS", "0") == "1"
 
 
+def _paged_pallas_enabled() -> bool:
+    """The ragged paged kernel DEFAULTS ON for TPU (it is the point of the
+    paged cache: HBM reads ∝ live pages); SWARMDB_PALLAS=0 forces the XLA
+    gather fallback, =1 forces the kernel even off-TPU (interpret mode —
+    slow, for tests)."""
+    if getattr(_pallas_ctx, "disabled", False):
+        return False
+    env = os.environ.get("SWARMDB_PALLAS", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def paged_attention_dispatch(
+    q: jnp.ndarray,          # [B, 1, Hq, D] (decode only)
+    k_pages: jnp.ndarray,    # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp]
+    q_positions: jnp.ndarray,  # [B, 1]
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Decode attention over the paged pool: ragged Pallas kernel on TPU,
+    XLA page-gather fallback elsewhere. Returns [B, 1, Hq, D]."""
+    if _paged_pallas_enabled():
+        from .attention_pallas import paged_decode_gqa_attention
+
+        lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
+        out = paged_decode_gqa_attention(
+            q[:, 0], k_pages, v_pages, page_table, lengths,
+            window=window, interpret=jax.default_backend() != "tpu",
+        )
+        return out[:, None]
+    from .paged_kv import paged_gather_kv
+
+    kg, vg = paged_gather_kv(k_pages, v_pages, page_table)
+    return gqa_attention(q, kg, vg, q_positions, window=window)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     """RMSNorm with fp32 statistics, output in x.dtype."""
     x32 = x.astype(jnp.float32)

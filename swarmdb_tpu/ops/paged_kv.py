@@ -1,0 +1,264 @@
+"""Block-paged KV cache (SURVEY §5.7 / §7 design hook, made real).
+
+The round-1 cache was a dense ``[L, B, max_seq, Hkv, D]`` slot buffer: HBM
+scales with ``max_batch x max_seq`` regardless of occupancy, which caps
+batch x context well below what the 100-agent config needs (VERDICT r1
+missing #2). Here K/V live in a shared POOL of fixed-size pages:
+
+    k_pages, v_pages: [L, num_pages, page_size, Hkv, D]
+    page_table:       [B, pages_per_slot] int32  (page ids per slot)
+
+HBM is provisioned for the EXPECTED total live tokens (num_pages x
+page_size), not worst-case ``B x S``. A host-side :class:`PageAllocator`
+hands pages to slots at admission and reclaims them at retirement.
+
+Pool invariants (all enforced here and in the engine):
+- Page 0 is the TRASH page: never allocated. Inactive/retired slots keep a
+  zeroed page-table row, so the decode step's masked garbage writes land in
+  page 0 instead of corrupting pages that were freed and reallocated.
+- Decode writes at positions >= max_seq are routed to the trash page (the
+  dense cache dropped them via out-of-bounds scatter semantics; the paged
+  indirection would otherwise CLAMP the page column and overwrite live
+  entries).
+- A retired slot's pages are freed only AFTER its page-table row is zeroed
+  (``PageAllocator.flush_frees`` pairs the two), closing the
+  stale-table/reused-page race.
+
+All device functions are shape-static and jit-safe. The XLA attention path
+gathers the slot's pages into a dense view (same HBM traffic as the dense
+cache — correctness fallback); the bandwidth win on TPU comes from the
+ragged Pallas kernel in ``ops/attention_pallas.py`` which reads only live
+pages. No reference counterpart (the reference has no model code); pattern
+follows the ragged paged attention design noted in PAPERS.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PagedCache = Dict[str, jnp.ndarray]  # {"k", "v", "page_table"}
+
+
+def pages_per_slot(max_seq: int, page_size: int) -> int:
+    return -(-max_seq // page_size)  # ceil
+
+
+def init_paged_kv_cache(
+    n_layers: int,
+    num_pages: int,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    batch: int,
+    max_seq: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> PagedCache:
+    """Zeroed page pool + all-trash page table. ``num_pages`` INCLUDES the
+    reserved trash page 0."""
+    shape = (n_layers, num_pages, page_size, n_kv_heads, head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "page_table": jnp.zeros(
+            (batch, pages_per_slot(max_seq, page_size)), jnp.int32
+        ),
+    }
+
+
+def paged_write_decode(
+    k_pages: jnp.ndarray,   # [P, ps, Hkv, D] (single layer)
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,         # [B, 1, Hkv, D]
+    v: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, 1] absolute write positions
+    page_table: jnp.ndarray,  # [B, maxp]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter one decode token per slot into its page.
+
+    Writes at positions >= maxp*ps (chunk overshoot on full lanes; the
+    engine keeps max_seq a page multiple so this cap == max_seq) and
+    writes from inactive slots (zeroed table rows) both land in trash
+    page 0 — see module invariants.
+    """
+    ps = k_pages.shape[1]
+    maxp = page_table.shape[1]
+    pos = positions[:, 0]                                # [B]
+    col = jnp.minimum(pos // ps, maxp - 1)
+    page = jnp.take_along_axis(page_table, col[:, None], axis=1)[:, 0]
+    page = jnp.where(pos < maxp * ps, page, 0)           # overshoot -> trash
+    off = pos % ps
+    k_pages = k_pages.at[page, off].set(k[:, 0].astype(k_pages.dtype))
+    v_pages = v_pages.at[page, off].set(v[:, 0].astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+def paged_gather_kv(
+    k_pages: jnp.ndarray,   # [P, ps, Hkv, D] (single layer)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense [B, maxp*ps, Hkv, D] view of each slot's pages (XLA fallback
+    attention input; bandwidth equals the dense cache, so use the Pallas
+    ragged kernel on TPU for the savings)."""
+    B, maxp = page_table.shape
+    ps = k_pages.shape[1]
+    kg = k_pages[page_table]  # [B, maxp, ps, Hkv, D]
+    vg = v_pages[page_table]
+    new_shape = (B, maxp * ps) + k_pages.shape[2:]
+    return kg.reshape(new_shape), vg.reshape(new_shape)
+
+
+def paged_insert_prefill(
+    k_pages: jnp.ndarray,    # [L, P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    dense_k: jnp.ndarray,    # [L, Bp, bucket, Hkv, D] prefill temp cache
+    dense_v: jnp.ndarray,
+    target_pages: jnp.ndarray,  # [n, bucket/ps] int32 page ids per admitted row
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter the first n rows of a dense bucket prefill cache into pages.
+
+    ``bucket`` must be a multiple of the page size (buckets are powers of
+    two >= page_size by construction). Hot callers should use
+    :func:`paged_insert_prefill_donating` — eager ``.at[].set`` on the full
+    pool would otherwise materialize a second pool copy per admission."""
+    L = k_pages.shape[0]
+    ps = k_pages.shape[2]
+    n, chunks = target_pages.shape
+    bucket = dense_k.shape[2]
+    assert bucket == chunks * ps, (bucket, chunks, ps)
+    tail = dense_k.shape[3:]
+    # [L, n, chunks, ps, Hkv, D] -> scatter chunks into the page axis
+    kc = dense_k[:, :n].reshape((L, n * chunks, ps) + tail)
+    vc = dense_v[:, :n].reshape((L, n * chunks, ps) + tail)
+    flat = target_pages.reshape(-1)  # [n*chunks]
+    k_pages = k_pages.at[:, flat].set(kc.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, flat].set(vc.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+# Jitted + pool-donating variant for the engine's admission path: the old
+# pool buffers are dead the moment the engine rebinds self.cache, so XLA can
+# scatter in place — no transient 2x-pool HBM, no full-pool copy bandwidth.
+paged_insert_prefill_donating = jax.jit(
+    paged_insert_prefill, donate_argnums=(0, 1)
+)
+
+
+@jax.jit
+def set_page_table_rows(
+    page_table: jnp.ndarray, rows: jnp.ndarray, values: jnp.ndarray
+) -> jnp.ndarray:
+    """Replace whole page-table rows (admission assigns, retirement zeroes)."""
+    return page_table.at[rows].set(values)
+
+
+@dataclass
+class _SlotPages:
+    pages: List[int]
+
+
+class PageAllocator:
+    """Host-side page pool bookkeeping (engine admission/retirement path).
+
+    Thread-safety: engine calls happen on the engine thread only, but the
+    lock keeps stats()/external probes safe. Page 0 (trash) is never
+    handed out.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, max_seq: int,
+                 batch: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.page_size = page_size
+        self.max_seq = max_seq
+        self.maxp = pages_per_slot(max_seq, page_size)
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # stack
+        self._by_slot: Dict[int, _SlotPages] = {}
+        self._pending_free: List[int] = []  # slot ids retired, not yet flushed
+        self._lock = threading.Lock()
+        self.batch = batch
+
+    # -- admission -----------------------------------------------------------
+
+    def pages_needed(self, prompt_len: int, max_new: int, chunk: int) -> int:
+        """Pages covering every position this request can ever WRITE:
+        prompt + generated tokens + up to one chunk of overshoot, capped at
+        max_seq (beyond-cap writes are trash-routed)."""
+        worst = min(self.max_seq, prompt_len + max_new + chunk)
+        return min(self.maxp, -(-worst // self.page_size))
+
+    def can_allocate(self, n: int) -> bool:
+        with self._lock:
+            return len(self._free) >= n
+
+    def allocate(self, slot_id: int, n: int) -> Optional[np.ndarray]:
+        """Take n pages for a slot; None if the pool can't cover it.
+        Returns the slot's FULL page-table row (maxp wide, trash-padded)."""
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            if slot_id in self._by_slot:
+                raise RuntimeError(f"slot {slot_id} already holds pages")
+            pages = [self._free.pop() for _ in range(n)]
+            self._by_slot[slot_id] = _SlotPages(pages)
+            row = np.zeros(self.maxp, np.int32)
+            row[: len(pages)] = pages
+            return row
+
+    def pages_for(self, slot_id: int) -> List[int]:
+        with self._lock:
+            sp = self._by_slot.get(slot_id)
+            return list(sp.pages) if sp else []
+
+    # -- retirement ----------------------------------------------------------
+
+    def mark_retired(self, slot_id: int) -> None:
+        """Queue a slot's pages for reclaim. The pages stay OWNED (absorbing
+        end-of-chunk garbage writes) until flush_frees() zeroes the slot's
+        table row and returns them to the pool."""
+        with self._lock:
+            if slot_id in self._by_slot:
+                self._pending_free.append(slot_id)
+
+    def flush_frees(self, page_table: jnp.ndarray) -> jnp.ndarray:
+        """Zero retired slots' table rows on device, then free their pages.
+        Call at the START of each admission round."""
+        with self._lock:
+            pending, self._pending_free = self._pending_free, []
+        if not pending:
+            return page_table
+        rows = np.asarray(pending, np.int32)
+        zeros = np.zeros((len(pending), self.maxp), np.int32)
+        page_table = set_page_table_rows(page_table, rows, zeros)
+        # free only after the zeroing update is enqueued: the device order
+        # (zero row -> later writes by a new owner) is program order
+        with self._lock:
+            for slot_id in pending:
+                sp = self._by_slot.pop(slot_id, None)
+                if sp is not None:
+                    self._free.extend(reversed(sp.pages))
+        return page_table
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_pages": self.num_pages,
+                "free_pages": len(self._free),
+                "live_slots": len(self._by_slot),
+                "page_size": self.page_size,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._free = list(range(self.num_pages - 1, 0, -1))
+            self._by_slot.clear()
+            self._pending_free.clear()

@@ -1,0 +1,308 @@
+"""Block-paged KV cache tests: kernel parity vs the dense path, allocator
+invariants, and end-to-end engine equivalence (VERDICT r1 next-round #3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swarmdb_tpu.models import llama
+from swarmdb_tpu.models.configs import TINY_DEBUG, TINY_MOE
+from swarmdb_tpu.ops.attention_pallas import paged_decode_gqa_attention
+from swarmdb_tpu.ops.layers import gqa_attention
+from swarmdb_tpu.ops.paged_kv import (
+    PageAllocator,
+    init_paged_kv_cache,
+    paged_gather_kv,
+    paged_insert_prefill,
+    paged_write_decode,
+    pages_per_slot,
+)
+
+
+# ---------------------------------------------------------------------------
+# kernel / op parity
+
+
+def _ragged_fixture(seed=0, B=4, Hq=8, Hkv=2, D=32, ps=16, maxp=4,
+                    lengths=(5, 33, 64, 0)):
+    rng = np.random.default_rng(seed)
+    S = ps * maxp
+    P = 1 + B * maxp
+    lengths = np.asarray(lengths, np.int32)
+    kp = np.zeros((P, ps, Hkv, D), np.float32)
+    vp = np.zeros((P, ps, Hkv, D), np.float32)
+    table = np.zeros((B, maxp), np.int32)
+    dense_k = np.zeros((B, S, Hkv, D), np.float32)
+    dense_v = np.zeros((B, S, Hkv, D), np.float32)
+    nxt = 1
+    for b in range(B):
+        L = int(lengths[b])
+        kv = rng.standard_normal((L, Hkv, D)).astype(np.float32)
+        vv = rng.standard_normal((L, Hkv, D)).astype(np.float32)
+        dense_k[b, :L] = kv
+        dense_v[b, :L] = vv
+        for j in range(-(-L // ps)):
+            table[b, j] = nxt
+            kp[nxt, : len(kv[j * ps:(j + 1) * ps])] = kv[j * ps:(j + 1) * ps]
+            vp[nxt, : len(vv[j * ps:(j + 1) * ps])] = vv[j * ps:(j + 1) * ps]
+            nxt += 1
+    q = rng.standard_normal((B, Hq, D)).astype(np.float32)
+    return q, kp, vp, table, lengths, dense_k, dense_v
+
+
+@pytest.mark.parametrize("window", [None, 8])
+def test_paged_kernel_matches_dense_attention(window):
+    q, kp, vp, table, lengths, dk, dv = _ragged_fixture()
+    qpos = np.maximum(lengths - 1, 0)
+    ref = gqa_attention(jnp.asarray(q)[:, None], jnp.asarray(dk),
+                        jnp.asarray(dv), jnp.asarray(qpos)[:, None],
+                        window=window)[:, 0]
+    out = paged_decode_gqa_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(lengths),
+        window=window, interpret=True,
+    )
+    active = lengths > 0
+    np.testing.assert_allclose(np.asarray(out)[active],
+                               np.asarray(ref)[active], atol=2e-5)
+
+
+def test_paged_gather_matches_dense():
+    q, kp, vp, table, lengths, dk, dv = _ragged_fixture()
+    qpos = np.maximum(lengths - 1, 0)
+    kg, vg = paged_gather_kv(jnp.asarray(kp), jnp.asarray(vp),
+                             jnp.asarray(table))
+    out = gqa_attention(jnp.asarray(q)[:, None], kg, vg,
+                        jnp.asarray(qpos)[:, None])[:, 0]
+    ref = gqa_attention(jnp.asarray(q)[:, None], jnp.asarray(dk),
+                        jnp.asarray(dv), jnp.asarray(qpos)[:, None])[:, 0]
+    active = lengths > 0
+    np.testing.assert_allclose(np.asarray(out)[active],
+                               np.asarray(ref)[active], atol=1e-6)
+
+
+def test_paged_write_routes_overshoot_and_inactive_to_trash():
+    B, ps, maxp, Hkv, D = 2, 4, 2, 1, 4
+    P = 4
+    kp = jnp.zeros((P, ps, Hkv, D))
+    vp = jnp.zeros((P, ps, Hkv, D))
+    table = jnp.asarray([[1, 2], [0, 0]], jnp.int32)  # slot1 inactive
+    k = jnp.ones((B, 1, Hkv, D))
+    v = jnp.ones((B, 1, Hkv, D))
+    # slot0 writes at position >= maxp*ps (overshoot), slot1 at 0 (inactive)
+    pos = jnp.asarray([[maxp * ps + 1], [0]], jnp.int32)
+    kp2, _ = paged_write_decode(kp, vp, k, v, pos, table)
+    assert np.asarray(kp2[1]).sum() == 0  # live pages untouched
+    assert np.asarray(kp2[2]).sum() == 0
+    assert np.asarray(kp2[0]).sum() > 0   # both landed in trash page 0
+
+
+def test_paged_insert_prefill_scatters_chunks():
+    L, Bp, bucket, Hkv, D, ps = 2, 3, 8, 1, 4, 4
+    P = 6
+    kp = jnp.zeros((L, P, ps, Hkv, D))
+    vp = jnp.zeros((L, P, ps, Hkv, D))
+    dense = jnp.arange(L * Bp * bucket * Hkv * D, dtype=jnp.float32).reshape(
+        L, Bp, bucket, Hkv, D)
+    target = jnp.asarray([[1, 2], [3, 0]], jnp.int32)  # n=2; row1 chunk2->trash
+    kp2, vp2 = paged_insert_prefill(kp, vp, dense, dense, target)
+    np.testing.assert_array_equal(np.asarray(kp2[:, 1]),
+                                  np.asarray(dense[:, 0, :ps]))
+    np.testing.assert_array_equal(np.asarray(kp2[:, 2]),
+                                  np.asarray(dense[:, 0, ps:]))
+    np.testing.assert_array_equal(np.asarray(kp2[:, 3]),
+                                  np.asarray(dense[:, 1, :ps]))
+
+
+# ---------------------------------------------------------------------------
+# allocator
+
+
+def test_allocator_lifecycle():
+    a = PageAllocator(num_pages=9, page_size=4, max_seq=16, batch=4)
+    assert a.maxp == 4
+    row = a.allocate(0, 3)
+    assert row is not None and row.shape == (4,)
+    assert (row[:3] > 0).all() and row[3] == 0  # trash-padded
+    assert a.stats()["free_pages"] == 5
+    assert a.allocate(1, 6) is None  # doesn't fit
+    a.mark_retired(0)
+    # pages are NOT free until flush pairs the table-row zeroing
+    assert a.stats()["free_pages"] == 5
+    table = jnp.asarray(np.tile(row, (4, 1)))
+    table = a.flush_frees(table)
+    assert a.stats()["free_pages"] == 8
+    assert np.asarray(table[0]).sum() == 0  # row zeroed on device
+
+
+def test_allocator_double_allocate_rejected():
+    a = PageAllocator(num_pages=5, page_size=4, max_seq=16, batch=2)
+    a.allocate(0, 1)
+    with pytest.raises(RuntimeError):
+        a.allocate(0, 1)
+
+
+def test_pages_needed_caps_at_maxp():
+    a = PageAllocator(num_pages=64, page_size=4, max_seq=16, batch=2)
+    assert a.pages_needed(prompt_len=2, max_new=2, chunk=2) == 2
+    assert a.pages_needed(prompt_len=1000, max_new=1000, chunk=8) == a.maxp
+
+
+# ---------------------------------------------------------------------------
+# model forward parity (dense vs paged cache, decode steps)
+
+
+def test_llama_forward_paged_matches_dense():
+    cfg = TINY_DEBUG
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key)
+    B, max_seq, ps = 2, 32, 8
+    maxp = pages_per_slot(max_seq, ps)
+
+    # prefill a short prompt through the DENSE forward
+    prompt = jnp.asarray([[1, 5, 9, 2], [3, 3, 0, 0]], jnp.int32)
+    plen = np.asarray([4, 2])
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32)[None], (B, 4))
+    dense_cache = llama.init_kv_cache(cfg, B, max_seq)
+    logits_p, dense_cache = llama.forward(params, cfg, prompt, pos, dense_cache)
+
+    # mirror the prefix into a paged pool (bucket=4 -> pad to one 8-page)
+    pool = llama.init_paged_cache(cfg, B, max_seq, num_pages=1 + B * maxp,
+                                  page_size=ps, dtype=jnp.bfloat16)
+    table = np.zeros((B, maxp), np.int32)
+    table[0, :] = [1, 2, 3, 4][:maxp]
+    table[1, :] = [5, 6, 7, 8][:maxp]
+    dk, dv = dense_cache
+    padk = jnp.pad(dk[:, :, :4], [(0, 0), (0, 0), (0, 4), (0, 0), (0, 0)])
+    padv = jnp.pad(dv[:, :, :4], [(0, 0), (0, 0), (0, 4), (0, 0), (0, 0)])
+    pk, pv = paged_insert_prefill(
+        pool["k"], pool["v"], padk, padv,
+        jnp.asarray([[1], [5]], jnp.int32),
+    )
+    cache_paged = {"k": pk, "v": pv, "page_table": jnp.asarray(table)}
+
+    # run a few decode steps through both paths; logits must match
+    tok = jnp.asarray([[7], [11]], jnp.int32)
+    for step in range(3):
+        dpos = jnp.asarray([[int(plen[0]) + step], [int(plen[1]) + step]],
+                           jnp.int32)
+        ld, dense_cache = llama.forward(params, cfg, tok, dpos, dense_cache)
+        lp, cache_paged = llama.forward_paged(params, cfg, tok, dpos,
+                                              cache_paged)
+        np.testing.assert_allclose(np.asarray(ld), np.asarray(lp),
+                                   rtol=1e-4, atol=1e-4)
+        tok = jnp.argmax(ld[:, -1], axis=-1).astype(jnp.int32)[:, None]
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end: paged == dense generations
+
+
+@pytest.fixture(scope="module")
+def engines():
+    from swarmdb_tpu.backend.engine import Engine, PagedKV
+
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+    init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+    max_batch, max_seq, ps = 4, 96, 16
+    maxp = pages_per_slot(max_seq, ps)
+
+    dense = Engine(fwd, init_cache, params, max_batch=max_batch,
+                   max_seq=max_seq, eos_id=2, seed=0,
+                   prefill_buckets=[16, 32, 64])
+    dense.start()
+
+    from swarmdb_tpu.ops.paged_kv import PageAllocator
+    # pool HALF of full coverage: 2 slots' worth -> exercises admission
+    # stalls + page reuse
+    num_pages = 1 + 2 * maxp
+    paged_spec = PagedKV(
+        decode_forward=lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c),
+        init_pool=lambda: llama.init_paged_cache(
+            cfg, max_batch, max_seq, num_pages, ps),
+        page_size=ps,
+        num_pages=num_pages,
+        allocator=PageAllocator(num_pages, ps, max_seq, max_batch),
+    )
+    paged = Engine(fwd, init_cache, params, max_batch=max_batch,
+                   max_seq=max_seq, eos_id=2, seed=0,
+                   prefill_buckets=[16, 32, 64], paged=paged_spec)
+    paged.start()
+    yield dense, paged
+    dense.stop()
+    paged.stop()
+
+
+def test_engine_paged_matches_dense_greedy(engines):
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    dense, paged = engines
+    prompts = [[1, 5, 9], [4, 4, 4, 4, 4, 4, 4, 4, 4], [7], [2, 3]]
+    for prompt in prompts:
+        td, rd = dense.generate_sync(prompt, SamplingParams(max_new_tokens=10))
+        tp, rp = paged.generate_sync(prompt, SamplingParams(max_new_tokens=10))
+        assert td == tp, (prompt, td, tp)
+        assert rd == rp
+
+
+def test_engine_paged_pool_contention(engines):
+    """More concurrent requests than the pool covers: all must complete
+    (admission stalls then proceeds as pages free up)."""
+    import threading
+
+    from swarmdb_tpu.backend.engine import GenRequest
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    _, paged = engines
+    done = threading.Event()
+    results = {}
+
+    def on_done(rid, toks, reason):
+        results[rid] = (toks, reason)
+        if len(results) == 6:
+            done.set()
+
+    for i in range(6):
+        paged.submit(GenRequest(
+            prompt=[1, i + 1] * 8,  # 16 tokens: full page footprints
+            sampling=SamplingParams(max_new_tokens=8),
+            on_done=on_done,
+        ))
+    assert done.wait(180), f"only {len(results)}/6 completed"
+    for toks, reason in results.values():
+        assert reason in ("eos", "length")
+    stats = paged.paged.allocator.stats()
+    assert stats["num_pages"] == paged.paged.num_pages
+
+
+def test_engine_paged_oversized_request_rejected():
+    """A request whose worst-case footprint exceeds the ENTIRE pool must be
+    rejected at submit, not deadlock admission forever."""
+    from swarmdb_tpu.backend.engine import Engine, GenRequest, PagedKV
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+    init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+    ps, max_seq = 16, 96
+    num_pages = 3  # 2 usable pages = 32 tokens, far below maxp=6
+    spec = PagedKV(
+        decode_forward=lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c),
+        init_pool=lambda: llama.init_paged_cache(cfg, 2, max_seq, num_pages, ps),
+        page_size=ps,
+        num_pages=num_pages,
+        allocator=PageAllocator(num_pages, ps, max_seq, 2),
+    )
+    eng = Engine(fwd, init_cache, params, max_batch=2, max_seq=max_seq,
+                 eos_id=2, seed=0, prefill_buckets=[16, 32, 64], paged=spec)
+    with pytest.raises(ValueError):
+        eng.submit(GenRequest(prompt=list(range(1, 60)),
+                              sampling=SamplingParams(max_new_tokens=32)))
+    # a small request still fits
+    eng.submit(GenRequest(prompt=[1, 2, 3],
+                          sampling=SamplingParams(max_new_tokens=8)))
