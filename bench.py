@@ -118,6 +118,25 @@ def active_params(total: int, cfg) -> int:
 # Mode: echo (config 1 — pure routing, no jax import at all)
 
 
+def _echo_loop(db, seconds: float) -> float:
+    db.register_agent("ping")
+    db.register_agent("pong")
+    for _ in range(50):
+        db.send_message("ping", "pong", "warm")
+        db.receive_messages("pong", max_messages=10, timeout=0.0)
+    t0 = time.time()
+    roundtrips = 0
+    while time.time() - t0 < seconds:
+        db.send_message("ping", "pong", "ping!")
+        got = db.receive_messages("pong", max_messages=1, timeout=1.0)
+        if got:
+            db.send_message("pong", "ping", "pong!")
+            back = db.receive_messages("ping", max_messages=1, timeout=1.0)
+            if back:
+                roundtrips += 1
+    return 2 * roundtrips / (time.time() - t0)
+
+
 def bench_echo(seconds: float) -> dict:
     from swarmdb_tpu.broker.local import LocalBroker
     from swarmdb_tpu.core.runtime import SwarmDB
@@ -125,31 +144,33 @@ def bench_echo(seconds: float) -> dict:
     with tempfile.TemporaryDirectory() as tmp:
         db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
                      autosave_interval=1e9)
-        db.register_agent("ping")
-        db.register_agent("pong")
-        for _ in range(50):
-            db.send_message("ping", "pong", "warm")
-            db.receive_messages("pong", max_messages=10, timeout=0.0)
-        t0 = time.time()
-        roundtrips = 0
-        while time.time() - t0 < seconds:
-            db.send_message("ping", "pong", "ping!")
-            got = db.receive_messages("pong", max_messages=1, timeout=1.0)
-            if got:
-                db.send_message("pong", "ping", "pong!")
-                back = db.receive_messages("ping", max_messages=1, timeout=1.0)
-                if back:
-                    roundtrips += 1
-        elapsed = time.time() - t0
-        value = 2 * roundtrips / elapsed
+        value = _echo_loop(db, seconds)
         db.close()
-    return {
+    result = {
         "metric": "echo_messages_per_sec",
         "value": round(value, 2),
         "unit": "msgs/sec",
         "vs_baseline": round(value / TARGET_MSGS_PER_SEC, 4),
         "mode": "echo",
     }
+    # same loop over the durable C++ broker (fsync'd partitioned log) —
+    # the ADVICE r2 gap: the native engine had never been benchmarked
+    try:
+        from swarmdb_tpu.broker.native import NativeBroker, native_available
+
+        if native_available():
+            with tempfile.TemporaryDirectory() as tmp:
+                db = SwarmDB(
+                    broker=NativeBroker(log_dir=os.path.join(tmp, "log")),
+                    save_dir=os.path.join(tmp, "hist"),
+                    autosave_interval=1e9,
+                )
+                native_value = _echo_loop(db, min(seconds, 10.0))
+                db.close()
+            result["native_broker_msgs_per_sec"] = round(native_value, 2)
+    except Exception as exc:  # noqa: BLE001 — echo headline must survive
+        result["native_broker_error"] = repr(exc)[-300:]
+    return result
 
 
 # --------------------------------------------------------------------------
@@ -158,24 +179,37 @@ def bench_echo(seconds: float) -> dict:
 
 @contextlib.contextmanager
 def serving_stack(model: str, n_assistants: int, max_batch: int, max_seq: int,
-                  decode_chunk: int):
+                  decode_chunk: int, paged: bool = False):
     from swarmdb_tpu.backend.service import ServingService
     from swarmdb_tpu.broker.local import LocalBroker
     from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.utils.xla_cache import enable_compile_cache
 
+    # persistent XLA cache: every mode (and every scheduled driver run)
+    # after the first deserializes the big-model executables instead of
+    # recompiling (measured 82s -> 3s warmup on the v5e)
+    enable_compile_cache(os.environ.get(
+        "SWARMDB_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    ))
     with tempfile.TemporaryDirectory() as tmp:
         db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
                      autosave_interval=1e9, max_messages_per_file=10**9)
         service = ServingService.from_model_name(
             db, model, backend_id="tpu-0",
             max_batch=max_batch, max_seq=max_seq, decode_chunk=decode_chunk,
+            prefill_batch=_env("SWARMDB_BENCH_PREFILL_BATCH", 16),
+            paged=paged or None,
         )
         assistants = [f"assistant_{i}" for i in range(n_assistants)]
         for a in assistants:
             db.register_agent(a)
             db.assign_llm_backend(a, "tpu-0")
         db.set_llm_load_balancing(True)
-        service.start()
+        # pre-compile every decode/prefill variant BEFORE the measured
+        # window: round 3's 4.8 msg/s was in-window compile stalls as
+        # growing chat histories graduated prompts into new buckets
+        service.start(warmup=_env("SWARMDB_BENCH_PREWARM", 1, int) == 1)
         try:
             yield db, service, assistants
         finally:
@@ -222,11 +256,17 @@ def _device_extras(service, model: str) -> dict:
     return extras
 
 
-def _mfu(extras: dict, tokens_per_sec: float) -> float | None:
+def _mfu(extras: dict, tokens_per_sec: float,
+         prompt_tokens_per_sec: float = 0.0) -> float | None:
+    """Model FLOPs utilization over ALL processed tokens. Prompt tokens
+    cost the same per-token FLOPs as generated ones and dominate volume
+    under chat-history prompts (~15:1 in the serve config), so decode-only
+    accounting (rounds 1-3) understated the chip's real work."""
     peak = extras.get("chip_peak_flops")
-    if not peak or not tokens_per_sec:
+    total = tokens_per_sec + prompt_tokens_per_sec
+    if not peak or not total:
         return None
-    return round(tokens_per_sec * extras["flops_per_token"] / peak, 5)
+    return round(total * extras["flops_per_token"] / peak, 5)
 
 
 def _run_window(db, seconds: float, pump, drain_grace: float = 2.0) -> dict:
@@ -234,12 +274,13 @@ def _run_window(db, seconds: float, pump, drain_grace: float = 2.0) -> dict:
     steady-state window. `pump(stop_at)` keeps requests in flight."""
     completed = db.metrics.counters["completed_messages"]
     tokens = db.metrics.counters["tokens_generated"]
+    prompt_toks = db.metrics.counters["prompt_tokens"]
     warm_deadline = time.time() + _env("SWARMDB_BENCH_WARMUP_S", 240.0)
     warm_target = _env("SWARMDB_BENCH_WARM_COMPLETIONS", 8)
     while completed.value < warm_target and time.time() < warm_deadline:
         pump(time.time() + 1.0)
 
-    c0, k0 = completed.value, tokens.value
+    c0, k0, pt0 = completed.value, tokens.value, prompt_toks.value
     sent0 = pump.sent
     t0 = time.time()
     pump(t0 + seconds)
@@ -252,6 +293,7 @@ def _run_window(db, seconds: float, pump, drain_grace: float = 2.0) -> dict:
     return {
         "completed_per_sec": (completed.value - c0) / elapsed,
         "tokens_per_sec": (tokens.value - k0) / elapsed,
+        "prompt_tokens_per_sec": round((prompt_toks.value - pt0) / elapsed, 1),
         "p50_send_to_first_token_s": round(p50, 4) if p50 else None,
         "window_s": round(elapsed, 2),
         "window_completed": completed.value - c0,
@@ -285,11 +327,57 @@ def _make_pump(db, max_outstanding, make_message, completions_per_send=1):
 # Mode: serve (config 2)
 
 
+def _open_loop_window(db, send, rate: float, seconds: float) -> dict:
+    """Fixed-arrival-rate window: sends at ``rate``/s WITHOUT backpressure,
+    so p50/p99 send->first-token measures latency under non-saturating
+    load rather than queue depth (VERDICT r3 weak #5: the closed-loop
+    pump's TTFT is outstanding/throughput, a queue artifact)."""
+    from swarmdb_tpu.utils.metrics import LatencyHistogram
+
+    # swap in a fresh, window-sized histogram: the shared ring is a
+    # bounded deque, so slicing it by saved length mixes in (or loses)
+    # closed-loop samples once it wraps — the exact artifact this window
+    # exists to exclude. The service looks the key up per observation, so
+    # replacing the dict entry takes effect immediately.
+    hist = LatencyHistogram(capacity=1_000_000)
+    db.metrics.latencies["send_to_first_token_s"] = hist
+    sent = 0
+    t0 = time.time()
+    while True:
+        now = time.time()
+        if now - t0 >= seconds:
+            break
+        due = int((now - t0) * rate)
+        while sent < due:
+            send(10**6 + sent)  # distinct message ids from the pump's range
+            sent += 1
+        time.sleep(0.002)
+    deadline = time.time() + 10.0
+    while len(hist._ring) < sent * 0.95 and time.time() < deadline:
+        time.sleep(0.05)
+    with hist._lock:
+        fresh = sorted(hist._ring)
+    if not fresh:
+        return {"arrival_rate_per_s": round(rate, 2), "sent": sent}
+
+    def pct(q):
+        return round(fresh[min(len(fresh) - 1,
+                               int(round(q / 100 * (len(fresh) - 1))))], 4)
+
+    return {
+        "arrival_rate_per_s": round(rate, 2),
+        "sent": sent,
+        "measured": len(fresh),
+        "p50_ttft_s": pct(50),
+        "p99_ttft_s": pct(99),
+    }
+
+
 def bench_serve(seconds: float) -> dict:
     model = _env("SWARMDB_BENCH_MODEL", "llama-1b-bench")
     n_users = _env("SWARMDB_BENCH_AGENTS", 100)
     n_assistants = _env("SWARMDB_BENCH_ASSISTANTS", 4)
-    max_batch = _env("SWARMDB_BENCH_BATCH", 32)
+    max_batch = _env("SWARMDB_BENCH_BATCH", 128)
     max_seq = _env("SWARMDB_BENCH_SEQ", 256)
     new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16)
     decode_chunk = _env("SWARMDB_BENCH_CHUNK", 16)
@@ -309,6 +397,19 @@ def bench_serve(seconds: float) -> dict:
         pump = _make_pump(db, max_batch * 2, send)
         window = _run_window(db, seconds, pump)
         extras = _device_extras(service, model)
+        # open-loop latency at ~half the measured closed-loop capacity
+        rate = window["completed_per_sec"] * 0.5
+        if rate > 0.2 and _env("SWARMDB_BENCH_OPENLOOP", 1, int) == 1:
+            # drain the closed-loop pump's outstanding messages first:
+            # their queue-inflated first tokens would otherwise observe
+            # into the open-loop histogram and re-introduce the artifact
+            completed = db.metrics.counters["completed_messages"]
+            drain_deadline = time.time() + 30.0
+            while (completed.value < pump.sent
+                   and time.time() < drain_deadline):
+                time.sleep(0.05)
+            window["openloop"] = _open_loop_window(
+                db, send, rate, min(seconds, 15.0))
 
     value = window.pop("completed_per_sec")
     return {
@@ -321,7 +422,8 @@ def bench_serve(seconds: float) -> dict:
         "agents": n_users,
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
-        "mfu": _mfu(extras, window["tokens_per_sec"]),
+        "mfu": _mfu(extras, window["tokens_per_sec"],
+                    window.get("prompt_tokens_per_sec", 0.0)),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -334,7 +436,7 @@ def bench_serve(seconds: float) -> dict:
 def bench_group(seconds: float) -> dict:
     model = _env("SWARMDB_BENCH_MODEL", "llama-1b-bench")
     group_size = _env("SWARMDB_BENCH_GROUP_SIZE", 4)
-    max_batch = _env("SWARMDB_BENCH_BATCH", 32)
+    max_batch = _env("SWARMDB_BENCH_BATCH", 128)
     max_seq = _env("SWARMDB_BENCH_SEQ", 256)
     new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16)
     decode_chunk = _env("SWARMDB_BENCH_CHUNK", 16)
@@ -367,7 +469,8 @@ def bench_group(seconds: float) -> dict:
         "group_size": group_size,
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
-        "mfu": _mfu(extras, window["tokens_per_sec"]),
+        "mfu": _mfu(extras, window["tokens_per_sec"],
+                    window.get("prompt_tokens_per_sec", 0.0)),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -423,7 +526,8 @@ def bench_tooluse(seconds: float) -> dict:
         "function_results_emitted": results,
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
-        "mfu": _mfu(extras, window["tokens_per_sec"]),
+        "mfu": _mfu(extras, window["tokens_per_sec"],
+                    window.get("prompt_tokens_per_sec", 0.0)),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -439,7 +543,7 @@ def bench_swarm100(seconds: float) -> dict:
     model = _env("SWARMDB_BENCH_MODEL", "llama-1b-bench")
     n_users = _env("SWARMDB_BENCH_AGENTS", 100)
     n_assistants = _env("SWARMDB_BENCH_ASSISTANTS", 8)
-    max_batch = _env("SWARMDB_BENCH_BATCH", 32)
+    max_batch = _env("SWARMDB_BENCH_BATCH", 128)
     max_seq = _env("SWARMDB_BENCH_SEQ", 256)
     new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16)
     decode_chunk = _env("SWARMDB_BENCH_CHUNK", 16)
@@ -448,7 +552,9 @@ def bench_swarm100(seconds: float) -> dict:
              MessagePriority.CRITICAL]
 
     with serving_stack(model, n_assistants, max_batch, max_seq,
-                       decode_chunk) as (db, service, assistants):
+                       decode_chunk,
+                       paged=_env("SWARMDB_BENCH_PAGED", 1, int) == 1,
+                       ) as (db, service, assistants):
         users = [f"swarm_{i}" for i in range(n_users)]
         for u in users:
             db.register_agent(u)
@@ -464,6 +570,15 @@ def bench_swarm100(seconds: float) -> dict:
         pump = _make_pump(db, max_batch * 2, send)
         window = _run_window(db, seconds, pump)
         extras = _device_extras(service, model)
+        # priority-admission evidence: p50 TTFT per MessagePriority level
+        # (the engine admits CRITICAL first; LOW should wait longest)
+        prio_ttft = {}
+        for p in (0, 1, 2, 3):  # MessagePriority LOW..CRITICAL
+            h = db.metrics.latencies.get(f"send_to_first_token_prio{p}_s")
+            if h is not None and h.percentile(50) is not None:
+                prio_ttft[str(p)] = round(h.percentile(50), 4)
+        if prio_ttft:
+            extras["p50_ttft_by_priority"] = prio_ttft
 
     value = window.pop("completed_per_sec")
     return {
@@ -477,7 +592,8 @@ def bench_swarm100(seconds: float) -> dict:
         "assistants": n_assistants,
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
-        "mfu": _mfu(extras, window["tokens_per_sec"]),
+        "mfu": _mfu(extras, window["tokens_per_sec"],
+                    window.get("prompt_tokens_per_sec", 0.0)),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -580,7 +696,7 @@ def _arm_watchdog(mode: str, partial: dict) -> None:
 
 
 def main() -> None:
-    mode = _env("SWARMDB_BENCH_MODE", "serve")
+    mode = _env("SWARMDB_BENCH_MODE", "all")
     seconds = _env("SWARMDB_BENCH_SECONDS", 20.0)
     results: dict = {}
     _arm_watchdog(mode, results)

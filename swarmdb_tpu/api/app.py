@@ -279,7 +279,8 @@ def create_app(
             "capabilities": req.capabilities,
             **req.metadata,
         }
-        created = await _run_sync(db.register_agent, req.agent_id, meta)
+        created = await _run_sync(db.register_agent, req.agent_id, meta,
+                                  req.adopt_backlog)
         return _json(
             {"status": "registered" if created else "already_registered",
              "agent_id": req.agent_id}

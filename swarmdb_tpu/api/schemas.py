@@ -81,6 +81,9 @@ class AgentRegistrationRequest(BaseModel):
     description: Optional[str] = None
     capabilities: List[str] = Field(default_factory=list)
     metadata: Dict[str, Any] = Field(default_factory=dict)
+    # cross-process adoption: drain records produced for this agent before
+    # this registration (SwarmDB.register_agent adopt_backlog)
+    adopt_backlog: bool = False
 
 
 class AgentGroupRequest(BaseModel):

@@ -42,7 +42,34 @@ def build_db() -> SwarmDB:
     )
 
 
-def build_serving(db: SwarmDB):
+def _serve_knobs() -> dict:
+    """Engine shape knobs — must be IDENTICAL on every host of a pod (the
+    worker replays the coordinator's compiled calls shape-for-shape)."""
+    return {
+        "max_batch": int(os.environ.get("SERVE_MAX_BATCH", "8")),
+        "max_seq": int(os.environ.get("SERVE_MAX_SEQ", "1024")),
+        "decode_chunk": int(os.environ.get("SERVE_CHUNK", "8")),
+        "seed": int(os.environ.get("SERVE_SEED", "0")),
+    }
+
+
+def _build_pod_engine(model_name: str):
+    """Sharded engine over the GLOBAL mesh — same construction on every
+    host so device state starts identical (parallel/multihost.py)."""
+    from ..backend.tokenizer import default_tokenizer
+    from ..parallel.serving import build_serving_engine
+
+    k = _serve_knobs()
+    engine, sm = build_serving_engine(
+        model_name, max_batch=k["max_batch"], max_seq=k["max_seq"],
+        seed=k["seed"], decode_chunk=k["decode_chunk"],
+    )
+    tokenizer = default_tokenizer(sm.cfg.vocab_size,
+                                  os.environ.get("SERVE_TOKENIZER") or None)
+    return engine, tokenizer
+
+
+def build_serving(db: SwarmDB, distributed: bool = False):
     model_name = os.environ.get("SERVE_MODEL")
     if not model_name:
         return None
@@ -53,7 +80,12 @@ def build_serving(db: SwarmDB):
             f"SERVE_MODEL={model_name!r} requires the serving backend "
             f"(swarmdb_tpu.backend.service): {exc}"
         )
-    serving = ServingService.from_model_name(db, model_name)
+    if distributed:
+        engine, tokenizer = _build_pod_engine(model_name)
+        engine.enable_multihost()
+        serving = ServingService(db, engine, tokenizer)
+    else:
+        serving = ServingService.from_model_name(db, model_name)
     if db.token_counter is None:
         # explicit wiring (not a constructor side effect): the deployment's
         # single backend tokenizer fills Message.token_count — the counter
@@ -62,29 +94,76 @@ def build_serving(db: SwarmDB):
     return serving
 
 
+def run_worker() -> None:
+    """Non-coordinator pod process: join the SPMD decode program.
+
+    Builds the identical sharded engine over the global mesh and replays
+    the coordinator's published device calls until it broadcasts stop
+    (Engine.worker_loop). No broker, no HTTP — the single-controller /
+    SPMD split of SURVEY §7: host 0 owns the request plane, every host
+    executes the tensor plane."""
+    model_name = os.environ.get("SERVE_MODEL")
+    if not model_name:
+        raise SystemExit(
+            "worker process needs SERVE_MODEL to build the shared engine"
+        )
+    engine, _tok = _build_pod_engine(model_name)
+    logging.getLogger(__name__).info("worker joined decode program")
+    engine.worker_loop()
+
+
+def build_ssl_context():
+    """TLS termination (reference: gunicorn keyfile/certfile,
+    `/root/reference/gunicorn_config.py:96-126`): set API_SSL_CERT (+
+    API_SSL_KEY for a separate key file) to serve HTTPS; absent = HTTP."""
+    cert = os.environ.get("API_SSL_CERT")
+    if not cert:
+        return None
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, os.environ.get("API_SSL_KEY") or None)
+    return ctx
+
+
 def main() -> None:
-    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    from ..utils.logsink import configure_logging
+
+    configure_logging()  # console + optional rotating/compressed LOG_FILE
+    # honor JAX_PLATFORMS even on images whose sitecustomize registers a
+    # platform plugin at interpreter startup and latches selection before
+    # env vars are read (the supported override is the config update)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     from ..parallel.distributed import init_distributed, is_coordinator
 
-    if init_distributed():
+    distributed = init_distributed()
+    if distributed and not is_coordinator():
         # Multi-host pod: one HTTP ingress (coordinator) owns the broker
-        # and API; every process sees the global mesh via jax.devices().
-        # Non-coordinator worker participation in the SPMD decode program
-        # is driven by the engine's multi-host path; running a second,
-        # independent API here would silently serve duplicate traffic —
-        # refuse loudly instead (SURVEY §7 single-controller-vs-SPMD).
-        if not is_coordinator():
-            raise SystemExit(
-                "this process is not the coordinator (SWARMDB_PROCESS_ID != 0); "
-                "the HTTP API runs on host 0 only"
-            )
+        # and API; every process executes the same SPMD decode program
+        # over the global mesh. This process joins as a tensor-plane
+        # worker (round-2/3 builds refused here; VERDICT #5).
+        run_worker()
+        return
     db = build_db()
-    serving = build_serving(db)
+    serving = build_serving(db, distributed=distributed)
     cfg = ApiConfig.from_env()
     app = create_app(db, cfg, serving=serving)
     if serving is not None:
         serving.start()
-    web.run_app(app, host=cfg.host, port=cfg.port)
+    web.run_app(
+        app,
+        host=cfg.host,
+        port=cfg.port,
+        ssl_context=build_ssl_context(),
+        # bounded graceful drain for in-flight requests/SSE streams on
+        # SIGTERM (reference: gunicorn graceful_timeout,
+        # `/root/reference/gunicorn_config.py:40-47`)
+        shutdown_timeout=float(os.environ.get("API_SHUTDOWN_TIMEOUT", "30")),
+    )
 
 
 if __name__ == "__main__":

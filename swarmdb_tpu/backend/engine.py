@@ -116,6 +116,8 @@ class Engine:
         donate_cache: bool = True,
         decode_chunk: int = 8,
         paged: Optional[PagedKV] = None,
+        prefill_batch: Optional[int] = None,
+        chunked_fns: Optional[Tuple[Callable, Callable, Callable]] = None,
     ) -> None:
         self.forward_fn = forward_fn
         self.params = params
@@ -132,7 +134,13 @@ class Engine:
         self.cache = paged.init_pool() if paged else init_cache_fn(max_batch, max_seq)
         self._decode_forward = paged.decode_forward if paged else forward_fn
         self._prefill_cache_fn = init_cache_fn
+        self._seed = seed
         self.base_keys = make_slot_keys(seed, max_batch)
+        # host copy for admission-time row gathers: indexing the device
+        # array from the host is an eager dispatch per admission (and on
+        # the tunneled TPU of this image every eager round-trip is ~ms);
+        # numpy fancy-indexing is free and the result rides the jit call
+        self._base_keys_np = np.asarray(self.base_keys)
         self.slots = [_Slot() for _ in range(max_batch)]
         # device-resident fed-token vector: slot i's next input token lives
         # here between chunks so decode->decode and prefill->decode handoffs
@@ -171,15 +179,53 @@ class Engine:
         K = self.decode_chunk
 
         # ---- compiled chunk: K decode steps per host round-trip -----------
+        # Two variants: the full sampler, and a sort-free one used whenever
+        # no ACTIVE slot has top-k/top-p enabled (sampling.py use_filters —
+        # the [B, V] sort is the most expensive op in a large-batch decode
+        # step). _step_decode picks per chunk from host-side slot state.
+        # Two chunk-loop shapes:
+        # - chunked_fns (dense Llama/Mixtral): the big cache stays FROZEN
+        #   across the K steps; each step's K/V lands in a small [B, K, ...]
+        #   buffer (uniform dynamic_update_slice) and is folded into the
+        #   cache ONCE per chunk. Profiling on the v5e showed the per-step
+        #   full-cache rewrite of the old path cost ~2x the model matmuls.
+        # - fallback (paged / custom forwards): per-step cache threading.
+        self._chunked_fns = None if paged else chunked_fns
+
         def _decode(params, last_tokens, positions, cache, base_keys, temp,
-                    topk, topp):
+                    topk, topp, *, use_filters, assume_greedy=False):
             # last_tokens [B] fed tokens, positions [B] next write positions
+            if self._chunked_fns is not None:
+                chunk_fwd, init_chunk, merge_chunk = self._chunked_fns
+                chunk_kv = init_chunk(self.max_batch, K)
+
+                def body(carry, step):
+                    tok, pos, chunk_kv = carry
+                    logits, chunk_kv = chunk_fwd(
+                        params, tok[:, None], pos[:, None], cache, chunk_kv,
+                        step,
+                    )
+                    nxt = sample_tokens(logits[:, -1], base_keys, pos, temp,
+                                        topk, topp, use_filters=use_filters,
+                                        assume_greedy=assume_greedy)
+                    return (nxt, pos + 1, chunk_kv), nxt
+
+                (last, _, chunk_kv), sampled = jax.lax.scan(
+                    body, (last_tokens, positions, chunk_kv),
+                    jnp.arange(K, dtype=jnp.int32),
+                )
+                new_cache = merge_chunk(cache, chunk_kv, positions)
+                all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
+                return all_toks, last, new_cache
+
             def body(carry, _):
                 tok, pos, cache = carry
                 logits, cache = self._decode_forward(
                     params, tok[:, None], pos[:, None], cache
                 )
-                nxt = sample_tokens(logits[:, -1], base_keys, pos, temp, topk, topp)
+                nxt = sample_tokens(logits[:, -1], base_keys, pos, temp,
+                                    topk, topp, use_filters=use_filters,
+                                    assume_greedy=assume_greedy)
                 return (nxt, pos + 1, cache), nxt
 
             (last, _, cache), sampled = jax.lax.scan(
@@ -190,14 +236,33 @@ class Engine:
             all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
             return all_toks, last, cache
 
-        self._decode = jax.jit(_decode, donate_argnums=donate)
+        import functools
+
+        self._decode = jax.jit(
+            functools.partial(_decode, use_filters=True),
+            donate_argnums=donate)
+        self._decode_fast = jax.jit(
+            functools.partial(_decode, use_filters=False),
+            donate_argnums=donate)
+        self._decode_greedy = jax.jit(
+            functools.partial(_decode, use_filters=False, assume_greedy=True),
+            donate_argnums=donate)
+        # ordered by parallel.multihost VARIANT_* codes
+        self._decode_variants = (self._decode, self._decode_fast,
+                                 self._decode_greedy)
+        # multi-host control plane (parallel/multihost.py): set by
+        # enable_multihost(); when active, every device call is published
+        # so worker hosts replay it in lockstep
+        self._mh = None
 
         # ---- compiled prefill, BATCHED: one variant per bucket ------------
         # Prefill at small T is HBM-bound (a full parameter read), so
         # prefilling up to ``prefill_batch`` admitted prompts in ONE call
         # costs nearly the same as one. Rows beyond the real group are
         # padding (length 1) whose results the host discards.
-        self.prefill_batch = max(1, min(8, max_batch))
+        if prefill_batch is None:
+            prefill_batch = 8
+        self.prefill_batch = max(1, min(prefill_batch, max_batch))
 
         def _prefill(params, tokens, lengths, cacheB, base_keys, temp, topk,
                      topp):
@@ -219,7 +284,42 @@ class Engine:
 
         self._prefill = jax.jit(_prefill, donate_argnums=(3,))
 
-        # scatter prefill tokens into the device fed-token vector (async)
+        # ---- fused dense prefill: forward + sample + cache insert + fed-
+        # token scatter in ONE compiled dispatch per admission group.
+        # The round-3 bench collapse (BENCH_r03: 4.8 msg/s while the
+        # compiled chunk alone sustains 40x that) traced in part to the
+        # dense admission path running ~6 eager device ops per group — two
+        # of them full-cache `.at[].set` copies executed OUTSIDE jit, each
+        # an un-donated copy of the whole decode cache plus a host round
+        # trip on this image's tunneled TPU. Here the temp prefill cache is
+        # created inside the trace, the slot insert donates the main cache,
+        # and padding rows carry slot_id == max_batch so mode="drop"
+        # discards their writes (they never touch live lanes).
+        def _prefill_insert(params, tokens, lengths, slot_ids, cache,
+                            last_tokens, base_keys, temp, topk, topp):
+            Bp, T = tokens.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None], (Bp, T)
+            )
+            cacheB = self._prefill_cache_fn(Bp, T)
+            logits, cacheB = self.forward_fn(params, tokens, positions, cacheB)
+            last = logits[jnp.arange(Bp), lengths - 1]  # [Bp, V]
+            next_tok = sample_tokens(
+                last, base_keys, lengths - 1, temp, topk, topp
+            )
+            cache = jax.tree.map(
+                lambda full, fresh: full.at[:, slot_ids, :T].set(
+                    fresh, mode="drop"),
+                cache, cacheB,
+            )
+            last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
+            return cache, last_tokens
+
+        self._prefill_fused = jax.jit(_prefill_insert, donate_argnums=(4, 5))
+
+        # scatter prefill tokens into the device fed-token vector (async;
+        # paged admission path — the dense path folds this into the fused
+        # prefill above)
         self._set_last_tokens = jax.jit(
             lambda lt, idx, tok: lt.at[idx].set(tok), donate_argnums=(0,)
         )
@@ -243,16 +343,104 @@ class Engine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._mh is not None:
+            # release worker hosts blocked in worker_loop's receive
+            try:
+                self._mh.publish_stop()
+            except Exception:
+                logger.exception("multihost stop broadcast failed")
 
     def alive(self) -> bool:
         """True while the decode loop thread is running."""
         return self._thread is not None and self._thread.is_alive()
 
+    # ---------------------------------------------------------- multi-host
+
+    def place_state(self, mesh) -> None:
+        """Re-materialize the engine's replicated device state (fed-token
+        vector, PRNG keys) ON the mesh, computed device-side.
+
+        Required before multi-process serving: state built by plain
+        ``jnp.zeros`` lives on the process-local default device, and a jit
+        over a global mesh cannot mix process-local arrays with global
+        ones. Computing the state under ``out_shardings`` avoids any host
+        transfer and yields bit-identical values on every host. Idempotent
+        and also valid (harmless) for single-process multi-chip meshes."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        B = self.max_batch
+        self._last_tokens = jax.jit(
+            lambda: jnp.zeros((B,), jnp.int32), out_shardings=rep)()
+        self.base_keys = jax.jit(
+            lambda: make_slot_keys(self._seed, B), out_shardings=rep)()
+        self._base_keys_np = np.asarray(
+            jax.device_get(self.base_keys))
+
+    def enable_multihost(self) -> None:
+        """Publish every device call to worker hosts (coordinator side).
+
+        Requires ``jax.distributed.initialize`` to have run and the
+        engine's params/cache to live on a global mesh; see
+        ``parallel/multihost.py`` and ``Engine.worker_loop``. The paged
+        cache path has host-side allocator state that is not mirrored yet
+        and is refused."""
+        if self.paged:
+            raise NotImplementedError(
+                "multi-host serving currently supports the dense cache "
+                "path only (the page allocator is coordinator-local)"
+            )
+        from ..parallel.multihost import ControlPlane
+
+        self._mh = ControlPlane(self.max_batch, self.prefill_batch)
+
+    def worker_loop(self) -> None:
+        """Run on every NON-coordinator host: replay the coordinator's
+        device calls in lockstep until it publishes stop.
+
+        Device state (params, cache, fed-token vector) must be constructed
+        identically on every host before entering — deterministic sharded
+        init guarantees this (parallel/serving.build_sharded_model). The
+        loop issues the exact jit call the coordinator issued, with the
+        broadcast numpy arguments, so the SPMD programs rendezvous on
+        their collectives; sampled tokens exist on this host's shards but
+        only the coordinator reads them."""
+        from ..parallel import multihost as mh
+
+        if self._mh is None:
+            self._mh = mh.ControlPlane(self.max_batch, self.prefill_batch)
+        while True:
+            op, args = self._mh.receive()
+            if op == mh.OP_STOP:
+                return
+            if op == mh.OP_DECODE:
+                variant, positions, temp, topk, topp = args
+                fn = self._decode_variants[variant]
+                all_toks, self._last_tokens, self.cache = fn(
+                    self.params, self._last_tokens, positions, self.cache,
+                    self.base_keys, temp, topk, topp,
+                )
+            elif op == mh.OP_PREFILL:
+                tokens, lengths, scatter, keys, temp, topk, topp = args
+                self.cache, self._last_tokens = self._prefill_fused(
+                    self.params, tokens, lengths, scatter, self.cache,
+                    self._last_tokens, keys, temp, topk, topp,
+                )
+
     def restart(self) -> None:
         """Recover from a fatal engine death (SURVEY §5.3 failure
         detection): fail whatever was in flight (callers see
         ``engine_restart`` and the runtime's FAILED/resend machinery takes
-        over), rebuild device state, and bring the loop back up."""
+        over), rebuild device state, and bring the loop back up.
+
+        Refused in pod mode: worker hosts cannot be told to rebuild their
+        shards, so a local restart would silently desynchronize the SPMD
+        program — the pod recovers by restarting its processes."""
+        if self._mh is not None:
+            raise RuntimeError(
+                "multi-host engine cannot restart in place; restart the "
+                "pod processes (worker state cannot be rebuilt remotely)"
+            )
         if self._thread is not None and not self._thread.is_alive():
             self._thread = None
         with self._cv:
@@ -268,6 +456,89 @@ class Engine:
             self.paged.allocator.reset()
             return self.paged.init_pool()
         return self._prefill_cache_fn(self.max_batch, self.max_seq)
+
+    def warmup(self) -> float:
+        """Pre-compile every jitted variant the serving loop can hit — the
+        decode chunk plus one prefill per bucket — and return seconds spent.
+
+        BENCH_r03's 4.8 msg/s collapse was largely compile stalls landing
+        inside the measured window: as conversations accumulate history,
+        prompts graduate to bigger buckets, and each new bucket's first
+        admission paid a 10-30 s XLA compile while every in-flight request
+        waited. Call this before serving traffic (no slots may be active:
+        warmup reuses the live cache/fed-token buffers through donation,
+        which is only safe while every lane is dead).
+
+        Warmup inputs are padding: dense prefill rows scatter to slot id
+        ``max_batch`` (mode="drop" discards them); the decode chunk writes
+        garbage K/V at positions 0..K-1 of dead lanes, which the
+        write-before-read invariant makes unreachable to future occupants.
+        With a persistent compilation cache (utils/xla_cache.py) the XLA
+        work amortizes across processes, so warmup costs seconds, not
+        minutes, after the first run.
+        """
+        assert not self._any_active(), "warmup requires an idle engine"
+        t0 = time.time()
+        positions = np.zeros((self.max_batch,), np.int32)
+        for variant, decode in enumerate(self._decode_variants):
+            if self._mh is not None:
+                self._mh.publish_decode(variant, positions, self._temp,
+                                        self._topk, self._topp)
+            all_toks, self._last_tokens, self.cache = decode(
+                self.params, self._last_tokens, positions, self.cache,
+                self.base_keys, self._temp, self._topk, self._topp,
+            )
+            jax.block_until_ready(all_toks)
+
+        Bp = self.prefill_batch
+        lengths = np.ones(Bp, np.int32)
+        zero_i = np.zeros(Bp, np.int32)
+        zero_f = np.zeros(Bp, np.float32)
+        ones_f = np.ones(Bp, np.float32)
+        keys = self._base_keys_np[np.zeros(Bp, np.int64)]
+        for bucket in self.prefill_buckets:
+            tokens = np.full((Bp, bucket), self.pad_id, np.int32)
+            if self.paged:
+                cacheB = self._prefill_cache_fn(Bp, bucket)
+                next_toks, cacheB = self._prefill(
+                    self.params, tokens, lengths, cacheB, keys,
+                    zero_f, zero_i, ones_f,
+                )
+                from ..ops.paged_kv import paged_insert_prefill_donating
+
+                ps = self.paged.page_size
+                chunks = -(-bucket // ps)
+                pad_to = chunks * ps
+                ck, cv = cacheB
+                if pad_to != bucket:
+                    pad = [(0, 0), (0, 0), (0, pad_to - bucket), (0, 0), (0, 0)]
+                    ck = jnp.pad(ck, pad)
+                    cv = jnp.pad(cv, pad)
+                # target page 0 = the trash page (absorbs garbage writes)
+                new_k, new_v = paged_insert_prefill_donating(
+                    self.cache["k"], self.cache["v"], ck, cv,
+                    np.zeros((1, chunks), np.int32),
+                )
+                self.cache = {"k": new_k, "v": new_v,
+                              "page_table": self.cache["page_table"]}
+                self._last_tokens = self._set_last_tokens(
+                    self._last_tokens, np.zeros(1, np.int64), next_toks[:1]
+                )
+            else:
+                drop = np.full(Bp, self.max_batch, np.int32)
+                if self._mh is not None:
+                    self._mh.publish_prefill(tokens, lengths, drop, keys,
+                                             zero_f, zero_i, ones_f)
+                self.cache, self._last_tokens = self._prefill_fused(
+                    self.params, tokens, lengths, drop, self.cache,
+                    self._last_tokens, keys, zero_f, zero_i, ones_f,
+                )
+        jax.block_until_ready(self._last_tokens)
+        dt = time.time() - t0
+        self.metrics.latencies["warmup_s"].observe(dt)
+        logger.info("engine warmup compiled %d prefill buckets + decode "
+                    "chunk in %.1fs", len(self.prefill_buckets), dt)
+        return dt
 
     # ------------------------------------------------------------ submission
 
@@ -329,6 +600,25 @@ class Engine:
             except Exception:
                 logger.exception("engine step failed; failing active requests")
                 self._fail_all("engine_error")
+                if self._mh is not None:
+                    # Pod mode: workers may have executed an op this
+                    # coordinator failed mid-way, and a local state rebuild
+                    # cannot be mirrored to them (their cache would silently
+                    # diverge and corrupt every later TP/EP reduction).
+                    # Fail the pod loudly; recovery is a process restart.
+                    logger.error("multi-host engine failure is fatal; "
+                                 "stopping the pod decode program")
+                    with self._cv:
+                        self._stop = True
+                    try:
+                        self._mh.publish_stop()
+                    except Exception:
+                        logger.exception("pod stop broadcast failed")
+                    # workers have exited their loop: a second stop
+                    # broadcast from Engine.stop() would be a collective
+                    # with no peers and hang shutdown
+                    self._mh = None
+                    break
                 # the decode step donates the cache buffer (and the fed-token
                 # vector is donated through _set_last_token): if it raised
                 # mid-step they may reference deleted buffers — rebuild both
@@ -416,6 +706,19 @@ class Engine:
                     # (generate_sync / SSE streams would hang to the timeout)
                     logger.exception("prefill failed for %s",
                                      [r.request_id for _, r in batch])
+                    if self._mh is not None:
+                        # pod mode: the op may already be published (workers
+                        # applied a prefill this coordinator didn't) —
+                        # swallowing here would silently desynchronize the
+                        # SPMD state; escalate to _run's pod-fatal handler
+                        for _sid, req in batch:
+                            if req.on_done is not None:
+                                try:
+                                    req.on_done(req.request_id, [],
+                                                "engine_error")
+                                except Exception:
+                                    pass
+                        raise
                     for slot_id, req in batch:
                         if self.paged:
                             # release the slot's pages or the next occupant's
@@ -452,11 +755,15 @@ class Engine:
         # row -> slot gather index, padded to Bp (padding rows borrow slot 0's
         # params/keys; their outputs are discarded)
         gather = np.zeros(Bp, np.int64)
+        # row -> slot scatter index for the fused insert; padding rows point
+        # one past the last slot so mode="drop" discards their writes
+        scatter = np.full(Bp, self.max_batch, np.int32)
         for row, (slot_id, req) in enumerate(batch):
             prompt = req.prompt  # submit() enforces len < max_seq
             padded[row, : len(prompt)] = prompt
             lengths[row] = len(prompt)
             gather[row] = slot_id
+            scatter[row] = slot_id
             # slot sampling params must be set BEFORE prefill samples the
             # first token, or the request inherits the previous occupant's
             s = req.sampling
@@ -464,57 +771,73 @@ class Engine:
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
 
+        if not self.paged:
+            # ONE dispatch: forward + sample + slot insert + token scatter.
+            # Stale entries a previous occupant left at positions >= bucket
+            # are never read: decode writes position p in the same step
+            # that first attends to it (write-before-read invariant).
+            if self._mh is not None:
+                self._mh.publish_prefill(
+                    padded, lengths, scatter, self._base_keys_np[gather],
+                    self._temp[gather], self._topk[gather],
+                    self._topp[gather])
+            self.cache, self._last_tokens = self._prefill_fused(
+                self.params,
+                padded,                  # raw np: transfer rides the dispatch
+                lengths,
+                scatter,
+                self.cache,
+                self._last_tokens,
+                self._base_keys_np[gather],
+                self._temp[gather],
+                self._topk[gather],
+                self._topp[gather],
+            )
+            self._activate(batch, t0)
+            return
+
         cacheB = self._prefill_cache_fn(Bp, bucket)
         next_toks, cacheB = self._prefill(
             self.params,
             padded,                      # raw np: transfer rides the dispatch
             lengths,
             cacheB,
-            self.base_keys[gather],
+            self._base_keys_np[gather],
             self._temp[gather],
             self._topk[gather],
             self._topp[gather],
         )
-        # Insert the prefix caches into the admitted slots' lanes, first
-        # `bucket` positions only. Stale entries a previous occupant left at
-        # positions >= bucket are never read: decode writes position p in
-        # the same step that first attends to it, and proceeds sequentially
-        # from the prompt length (write-before-read invariant).
         slot_ids = gather[:n]
-        if self.paged:
-            from ..ops.paged_kv import paged_insert_prefill_donating
+        from ..ops.paged_kv import paged_insert_prefill_donating
 
-            ps = self.paged.page_size
-            chunks = -(-bucket // ps)
-            # pad the bucket to a page multiple so chunks tile exactly; the
-            # pad region is prompt padding (never read — length-masked)
-            pad_to = chunks * ps
-            # slot rows allocated fewer pages than the bucket (short prompt
-            # in a big bucket) route the all-padding chunks to trash page 0
-            target = np.zeros((n, chunks), np.int32)
-            for row, sid in enumerate(slot_ids):
-                pages = self.paged.allocator.pages_for(int(sid))
-                m = min(len(pages), chunks)
-                target[row, :m] = pages[:m]
-            ck, cv = cacheB
-            if pad_to != bucket:
-                pad = [(0, 0), (0, 0), (0, pad_to - bucket), (0, 0), (0, 0)]
-                ck = jnp.pad(ck, pad)
-                cv = jnp.pad(cv, pad)
-            new_k, new_v = paged_insert_prefill_donating(
-                self.cache["k"], self.cache["v"], ck, cv, target
-            )
-            self.cache = {"k": new_k, "v": new_v,
-                          "page_table": self.cache["page_table"]}
-        else:
-            self.cache = jax.tree.map(
-                lambda full, fresh: full.at[:, slot_ids, :bucket].set(fresh[:, :n]),
-                self.cache, cacheB,
-            )
+        ps = self.paged.page_size
+        chunks = -(-bucket // ps)
+        # pad the bucket to a page multiple so chunks tile exactly; the
+        # pad region is prompt padding (never read — length-masked)
+        pad_to = chunks * ps
+        # slot rows allocated fewer pages than the bucket (short prompt
+        # in a big bucket) route the all-padding chunks to trash page 0
+        target = np.zeros((n, chunks), np.int32)
+        for row, sid in enumerate(slot_ids):
+            pages = self.paged.allocator.pages_for(int(sid))
+            m = min(len(pages), chunks)
+            target[row, :m] = pages[:m]
+        ck, cv = cacheB
+        if pad_to != bucket:
+            pad = [(0, 0), (0, 0), (0, pad_to - bucket), (0, 0), (0, 0)]
+            ck = jnp.pad(ck, pad)
+            cv = jnp.pad(cv, pad)
+        new_k, new_v = paged_insert_prefill_donating(
+            self.cache["k"], self.cache["v"], ck, cv, target
+        )
+        self.cache = {"k": new_k, "v": new_v,
+                      "page_table": self.cache["page_table"]}
         self._last_tokens = self._set_last_tokens(
             self._last_tokens, slot_ids, next_toks[:n]
         )
+        self._activate(batch, t0)
 
+    def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:
         for slot_id, req in batch:
             slot = self.slots[slot_id]
             slot.active = True
@@ -524,6 +847,10 @@ class Engine:
             slot.pending_first = True
             slot.first_token_at = None
             self.total_requests += 1
+            # prefill work accounting (bench MFU: prompt tokens cost the
+            # same per-token FLOPs as decode tokens but 10-20x the volume
+            # under chat-history prompts)
+            self.metrics.counters["prompt_tokens"].inc(len(req.prompt))
             self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
         self.metrics.latencies["prefill_s"].observe(time.time() - t0)
 
@@ -539,11 +866,22 @@ class Engine:
         """
         positions = np.zeros((self.max_batch,), np.int32)
         pos0 = [0] * self.max_batch
+        needs_filters = False
+        needs_sampling = False
         for i, s in enumerate(self.slots):
             if s.active:
                 positions[i] = s.position
                 pos0[i] = s.position
-        all_toks, self._last_tokens, self.cache = self._decode(
+                if self._topk[i] > 0 or self._topp[i] < 1.0:
+                    needs_filters = True
+                if self._temp[i] > 0:
+                    needs_sampling = True
+        variant = (0 if needs_filters else 1 if needs_sampling else 2)
+        decode = self._decode_variants[variant]
+        if self._mh is not None:
+            self._mh.publish_decode(variant, positions, self._temp,
+                                    self._topk, self._topp)
+        all_toks, self._last_tokens, self.cache = decode(
             self.params, self._last_tokens, positions,
             self.cache, self.base_keys,
             self._temp, self._topk, self._topp,

@@ -41,17 +41,36 @@ def sample_tokens(
     temperature: jnp.ndarray,   # [B] fp32; 0 => greedy
     top_k: jnp.ndarray,         # [B] int32; 0 => off
     top_p: jnp.ndarray,         # [B] fp32; 1.0 => off
+    *,
+    use_filters: bool = True,
+    assume_greedy: bool = False,
 ) -> jnp.ndarray:
     """Sample one token per row; greedy rows (temperature==0) take argmax.
 
     Filtering: temperature-scale -> top-k mask -> top-p (nucleus) mask ->
     categorical, all with static shapes.
+
+    ``use_filters`` / ``assume_greedy`` are TRACE-TIME switches the engine
+    flips per chunk from host-visible slot state (it knows every active
+    slot's sampling params). The default traces the full pipeline; at
+    large batch both the [B, V] sort behind top-k/p AND the [B, V] Gumbel
+    draw behind categorical are comparable to the model matmuls
+    themselves, so the common all-greedy population compiles down to one
+    argmax, and filter-free-but-sampled populations skip the sort.
     """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
+    if assume_greedy:
+        # host guarantees every live row has temperature == 0
+        return greedy.astype(jnp.int32)
 
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_t[:, None]
+
+    if not use_filters:
+        step_keys = jax.vmap(jax.random.fold_in)(base_keys, positions)
+        sampled = jax.vmap(jax.random.categorical)(step_keys, scaled)
+        return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
     sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
     # top-k: keep entries >= k-th largest (k<=0 disables)

@@ -23,6 +23,8 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import queue
 import threading
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional
@@ -100,6 +102,14 @@ class ServingService:
         self.poll_interval = poll_interval
         self._consumer_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Reply emission (tokenizer decode + send_message + persistence
+        # hooks) runs on its own worker, NOT the engine thread: at 32-128
+        # retirements per decode chunk, inline emission serializes ~100s of
+        # broker sends into the decode loop and the device sits idle the
+        # whole time (round-4 profile: the engine loop, not the compiled
+        # chunk, was the round-3 bottleneck).
+        self._reply_queue: "queue.Queue" = queue.Queue()
+        self._reply_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -117,6 +127,7 @@ class ServingService:
         paged: Optional[bool] = None,
         page_size: int = 16,
         kv_pool_tokens: Optional[int] = None,
+        prefill_batch: Optional[int] = None,
     ) -> "ServingService":
         """Build model + engine for a registry config. Weights are randomly
         initialized unless a checkpoint is loaded afterwards
@@ -128,10 +139,11 @@ class ServingService:
         coverage, i.e. no savings but no admission stalls — benches pass a
         budget to realize the savings).
         """
-        import os
-
         from ..models import llama, mixtral
         from ..models.configs import get_config
+        from ..utils.xla_cache import enable_compile_cache
+
+        enable_compile_cache()  # no-op unless SWARMDB_COMPILE_CACHE is set
 
         cfg = get_config(model_name)
         seq = max_seq or min(cfg.max_seq_len, 1024)
@@ -142,12 +154,26 @@ class ServingService:
             init_cache = lambda b, s: mixtral.init_kv_cache(cfg, b, s)
             paged_fwd = lambda p, t, pos, c: mixtral.forward_paged(p, cfg, t, pos, c)
             init_pool_model = mixtral.init_paged_cache
+            mod = mixtral
         else:
             params = llama.init_params(cfg, key)
             fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
             init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
             paged_fwd = lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c)
             init_pool_model = llama.init_paged_cache
+            mod = llama
+        # two-segment chunked decode (dense cache only; the paged pool has
+        # its own write path) — see Engine._decode / ops.layers.
+        # SWARMDB_CHUNKED=0 falls back to per-step cache threading (escape
+        # hatch if a backend's compiler mishandles the chunked graph).
+        chunked_fns = None
+        if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
+            chunked_fns = (
+                lambda p, t, pos, c, hkv, s: mod.forward_chunked(
+                    p, cfg, t, pos, c, hkv, s),
+                lambda b, k: mod.init_chunk_kv(cfg, b, k),
+                mod.merge_chunk,
+            )
 
         if paged is None:
             paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
@@ -175,11 +201,29 @@ class ServingService:
             max_batch=max_batch, max_seq=seq,
             eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
             metrics=db.metrics, decode_chunk=decode_chunk, paged=paged_spec,
+            prefill_batch=prefill_batch, chunked_fns=chunked_fns,
         )
         return cls(db, engine, tokenizer, backend_id=backend_id)
 
-    def start(self) -> None:
+    def start(self, warmup: Optional[bool] = None) -> None:
+        """Bring up the engine, reply emitter, and broker consumer.
+
+        ``warmup`` pre-compiles every decode/prefill variant before traffic
+        (Engine.warmup); default = SWARMDB_PREWARM env. It runs before the
+        consumer thread starts so no request can race the idle-engine
+        requirement.
+        """
+        if warmup is None:
+            warmup = os.environ.get("SWARMDB_PREWARM", "0") == "1"
+        if warmup:
+            self.engine.warmup()
         self.engine.start()
+        if self._reply_thread is None:
+            self._reply_thread = threading.Thread(
+                target=self._reply_loop, daemon=True,
+                name=f"tpu-replies-{self.backend_id}",
+            )
+            self._reply_thread.start()
         if self._consumer_thread is None:
             self._consumer_thread = threading.Thread(
                 target=self._consume_loop, daemon=True,
@@ -193,6 +237,10 @@ class ServingService:
             self._consumer_thread.join(timeout=10)
             self._consumer_thread = None
         self.engine.stop()
+        if self._reply_thread is not None:
+            self._reply_queue.put(None)  # sentinel AFTER engine drained
+            self._reply_thread.join(timeout=10)
+            self._reply_thread = None
 
     # --------------------------------------------------- broker consumption
 
@@ -273,49 +321,21 @@ class ServingService:
                        else msg.priority)
 
         def _done(rid: str, tokens: List[int], reason: str) -> None:
+            # engine thread: just hand off — emission runs on _reply_loop
             msg.stage_stamp("done")
-            text = self.tokenizer.decode(tokens)
-            reply_type = (
-                MessageType.FUNCTION_RESULT
-                if msg.type == MessageType.FUNCTION_CALL
-                else MessageType.CHAT
-            )
-            try:
-                reply_id = self.db.send_message(
-                    msg.receiver_id or self.backend_id,
-                    msg.sender_id,
-                    text,
-                    message_type=reply_type,
-                    priority=msg.priority,
-                    metadata={
-                        "reply_to": msg.id,
-                        "backend_id": self.backend_id,
-                        "finish_reason": reason,
-                        "completion_tokens": len(tokens),
-                    },
-                )
-                msg.metadata["reply_id"] = reply_id
-                self.db.mark_message_as_processed(msg.id)
-                # north-star gauge: completed chat messages/sec
-                self.db.metrics.rates["completed_messages"].mark()
-                self.db.metrics.counters["completed_messages"].inc()
-                lat = None
-                stages = msg.metadata.get("stages", {})
-                if "enqueued" in stages:
-                    lat = time.time() - stages["enqueued"]
-                    self.db.metrics.latencies["send_to_done_s"].observe(lat)
-            except Exception:
-                logger.exception("failed to emit reply for %s", msg.id)
-            if on_done is not None:
-                on_done(rid, tokens, reason)
+            self._reply_queue.put((msg, rid, tokens, reason, on_done))
 
         def _tok(rid: str, token: int) -> None:
             if "first_token" not in msg.metadata.get("stages", {}):
                 msg.stage_stamp("first_token")
                 stages = msg.metadata["stages"]
                 if "enqueued" in stages:
-                    self.db.metrics.latencies["send_to_first_token_s"].observe(
-                        stages["first_token"] - stages["enqueued"])
+                    ttft = stages["first_token"] - stages["enqueued"]
+                    self.db.metrics.latencies["send_to_first_token_s"].observe(ttft)
+                    # per-priority evidence that CRITICAL beats LOW under
+                    # load (the engine's priority admission, bench swarm100)
+                    self.db.metrics.latencies[
+                        f"send_to_first_token_prio{priority}_s"].observe(ttft)
             if on_token is not None:
                 on_token(rid, token)
 
@@ -325,6 +345,53 @@ class ServingService:
             metadata={"message_id": msg.id},
         )
         return self.engine.submit(req)
+
+    def _reply_loop(self) -> None:
+        """Drain completed generations into reply messages (worker thread)."""
+        while True:
+            item = self._reply_queue.get()
+            if item is None:
+                return
+            msg, rid, tokens, reason, on_done = item
+            try:
+                self._emit_reply(msg, tokens, reason)
+            except Exception:
+                logger.exception("failed to emit reply for %s", msg.id)
+            if on_done is not None:
+                try:
+                    on_done(rid, tokens, reason)
+                except Exception:
+                    logger.exception("on_done callback failed for %s", msg.id)
+
+    def _emit_reply(self, msg: Message, tokens: List[int], reason: str) -> None:
+        text = self.tokenizer.decode(tokens)
+        reply_type = (
+            MessageType.FUNCTION_RESULT
+            if msg.type == MessageType.FUNCTION_CALL
+            else MessageType.CHAT
+        )
+        reply_id = self.db.send_message(
+            msg.receiver_id or self.backend_id,
+            msg.sender_id,
+            text,
+            message_type=reply_type,
+            priority=msg.priority,
+            metadata={
+                "reply_to": msg.id,
+                "backend_id": self.backend_id,
+                "finish_reason": reason,
+                "completion_tokens": len(tokens),
+            },
+        )
+        msg.metadata["reply_id"] = reply_id
+        self.db.mark_message_as_processed(msg.id)
+        # north-star gauge: completed chat messages/sec
+        self.db.metrics.rates["completed_messages"].mark()
+        self.db.metrics.counters["completed_messages"].inc()
+        stages = msg.metadata.get("stages", {})
+        if "enqueued" in stages:
+            self.db.metrics.latencies["send_to_done_s"].observe(
+                time.time() - stages["enqueued"])
 
     async def stream_reply(self, msg: Message) -> AsyncIterator[str]:
         """Async token-text stream for SSE (api/app.py). Bridges engine-

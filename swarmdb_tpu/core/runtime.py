@@ -142,9 +142,12 @@ class SwarmDB:
         # Delivery-report poller: with acks=all semantics the broker's
         # group-commit fsync completes AFTER produce returns, so callbacks
         # queued at send time need a later poll to fire (rdkafka solves this
-        # with its background poll thread — same shape here). Wakes only
-        # while reports are pending; exits on close().
+        # with its background poll thread — same shape here). Event-driven
+        # (ADVICE r2: the old version woke every 5 ms forever): sends set
+        # ``_poller_wake``; the loop spins at 5 ms only WHILE reports are
+        # outstanding, then parks on the event. Exits on close().
         self._poller_stop = threading.Event()
+        self._poller_wake = threading.Event()
         self._poller = threading.Thread(
             target=self._delivery_poll_loop, name="swarmdb-delivery-poll",
             daemon=True,
@@ -152,15 +155,21 @@ class SwarmDB:
         self._poller.start()
 
     def _delivery_poll_loop(self) -> None:
-        while not self._poller_stop.wait(0.005):
-            if self.producer.pending_count:
-                try:
-                    # positive timeout: blocks on the broker's durability
-                    # watermark (native: group-commit condvar; snapshot-mode
-                    # local: forces the snapshot) so reports actually fire
-                    self.producer.poll(0.02)
-                except Exception:
-                    logger.exception("delivery poll failed")
+        while not self._poller_stop.is_set():
+            if not self.producer.pending_count:
+                # park until the next send (1 s backstop for races between
+                # the pending_count read and the event clear)
+                self._poller_wake.wait(timeout=1.0)
+                self._poller_wake.clear()
+                continue
+            try:
+                # positive timeout: blocks on the broker's durability
+                # watermark (native: group-commit condvar; snapshot-mode
+                # local: forces the snapshot) so reports actually fire
+                self.producer.poll(0.02)
+            except Exception:
+                logger.exception("delivery poll failed")
+            self._poller_stop.wait(0.005)
 
     # ------------------------------------------------------------------ setup
 
@@ -198,10 +207,26 @@ class SwarmDB:
 
     # --------------------------------------------------------------- registry
 
-    def register_agent(self, agent_id: str, metadata: Optional[Dict[str, Any]] = None) -> bool:
+    def register_agent(self, agent_id: str,
+                       metadata: Optional[Dict[str, Any]] = None,
+                       adopt_backlog: bool = False) -> bool:
         """Register an agent and attach a partition-affine consumer
         (reference ` main.py:314-349` — but assigned to the agent's own
-        partition instead of the whole topic, fixing D8)."""
+        partition instead of the whole topic, fixing D8).
+
+        CROSS-PROCESS ADOPTION (``adopt_backlog``): within one process,
+        send_message registers unknown receivers before producing, so no
+        record addressed to this agent can predate its consumer and the
+        default "start at partition end" loses nothing. But a SECOND
+        process registering an agent whose records were produced elsewhere
+        (shared durable broker, no committed offsets for this agent's
+        group yet) would skip that pre-registration backlog. Pass
+        ``adopt_backlog=True`` there: the consumer starts at the partition
+        BEGINNING and the partition-affine filter drains the agent's
+        history (O(partition) once, the price of adoption). Committed
+        offsets, when present, win over either policy. (ADVICE r2 weak #5:
+        previously neither fixed nor documented.)
+        """
         with self._lock:
             if agent_id in self.registered_agents:
                 if metadata:
@@ -220,7 +245,7 @@ class SwarmDB:
             consumer = Consumer(
                 self.broker,
                 group_id=f"{self.config.group_id}_{agent_id}",
-                auto_offset_reset="latest",
+                auto_offset_reset="earliest" if adopt_backlog else "latest",
             )
             consumer.assign([(self.topic_name, self._get_partition(agent_id))])
             self.consumers[agent_id] = consumer
@@ -238,6 +263,12 @@ class SwarmDB:
             if consumer is not None:
                 consumer.close()
             self.agent_metadata.pop(agent_id, None)
+            # evict the agent's rate gauge (ADVICE r2: the one per-agent
+            # metric map unbounded under agent churn). _stats_by_agent is
+            # retained deliberately: the reference's get_stats derives
+            # per-agent counts from retained messages, which survive
+            # deregistration.
+            self.metrics.rates.pop(f"agent_recv:{agent_id}", None)
             # inbox retained, as in the reference (messages remain queryable)
             logger.info("deregistered agent %s", agent_id)
             return True
@@ -365,6 +396,7 @@ class SwarmDB:
                         on_delivery=self._delivery_callback,
                     )
             self.producer.poll(0)
+            self._poller_wake.set()  # un-park the delivery-report poller
         except Exception as exc:
             # failure path (reference :507-517): FAILED + copy to error topic
             with self._lock:
@@ -950,6 +982,7 @@ class SwarmDB:
             return
         self._closed = True
         self._poller_stop.set()
+        self._poller_wake.set()  # release a parked poller immediately
         self._poller.join(timeout=1.0)
         # flush BEFORE the final snapshot: pending durability-gated delivery
         # reports must land so the saved history doesn't freeze messages at

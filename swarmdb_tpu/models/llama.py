@@ -28,6 +28,8 @@ from jax.sharding import PartitionSpec as P
 from ..ops.layers import (
     apply_rope,
     gqa_attention,
+    gqa_attention_chunked,
+    merge_chunk_kv,
     rms_norm,
     rope_cos_sin,
     swiglu,
@@ -190,9 +192,89 @@ def forward(
     head = params.get("lm_head")
     if head is None:  # tied embeddings
         head = params["embed"].T
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        head.astype(jnp.float32))
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
     return logits, (new_k, new_v)
+
+
+def init_chunk_kv(
+    cfg: ModelConfig, batch: int, chunk: int, dtype: jnp.dtype = jnp.bfloat16
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-chunk K/V accumulator for the two-segment decode (zeros; shape
+    [L, B, Kc, Hkv, D])."""
+    shape = (cfg.n_layers, batch, chunk, cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def forward_chunked(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, 1] int32 — one decode step
+    positions: jnp.ndarray,    # [B, 1] int32 absolute positions
+    cache: KVCache,            # FROZEN during the chunk
+    chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],  # [L, B, Kc, Hkv, D] each
+    step: jnp.ndarray,         # scalar int32 — index within the chunk
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Decode step against a frozen cache + in-chunk K/V buffer.
+
+    The engine's chunked decode loop (Engine._decode) calls this K times
+    per chunk, then folds chunk_kv into the big cache with
+    ``merge_chunk_kv`` — one full-cache write per CHUNK, not per step
+    (ops/layers.gqa_attention_chunked has the profile numbers). This
+    step's K/V is written at chunk index ``step`` via dynamic_update_slice
+    (uniform index across rows, no scatter).
+    """
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral")
+    x = params["embed"][tokens]  # [B, 1, D]
+    cache_k, cache_v = cache
+    chunk_k, chunk_v = chunk_kv
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(x, scanned):
+        lp, ck, cv, hk, hv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
+            B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        hk = jax.lax.dynamic_update_slice(hk, k.astype(hk.dtype),
+                                          (0, step, 0, 0))
+        hv = jax.lax.dynamic_update_slice(hv, v.astype(hv.dtype),
+                                          (0, step, 0, 0))
+        attn = gqa_attention_chunked(q, ck, cv, hk, hv, positions, step,
+                                     window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (hk, hv)
+
+    x, (new_hk, new_hv) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache_k, cache_v, chunk_k, chunk_v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:  # tied embeddings
+        head = params["embed"].T
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, (new_hk, new_hv)
+
+
+def merge_chunk(
+    cache: KVCache,
+    chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],
+    start_positions: jnp.ndarray,  # [B]
+) -> KVCache:
+    """Fold a finished chunk's K/V into the slot cache (ops/layers)."""
+    ck, cv = cache
+    hk, hv = chunk_kv
+    return merge_chunk_kv(ck, cv, hk, hv, start_positions)
 
 
 def forward_paged(
@@ -246,8 +328,8 @@ def forward_paged(
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        head.astype(jnp.float32))
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
     return logits, {"k": new_k, "v": new_v, "page_table": table}
 
 
@@ -307,8 +389,8 @@ def forward_seq_parallel(
         head = params.get("lm_head")
         if head is None:
             head = params["embed"].T
-        logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                            head.astype(jnp.float32))
+        logits = jnp.einsum("btd,dv->btv", x, head,
+                            preferred_element_type=jnp.float32)
         return logits, ks, vs
 
     sharded = shard_map(

@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.layers import (
     apply_rope,
     gqa_attention,
+    gqa_attention_chunked,
     rms_norm,
     rope_cos_sin,
     write_kv_cache,
@@ -219,9 +220,68 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
     return logits, (new_k, new_v)
+
+
+# chunk-KV helpers are attention-side and identical across families —
+# shared with the dense stack (one definition, review finding r4)
+from .llama import init_chunk_kv, merge_chunk  # noqa: E402, F401
+
+
+def forward_chunked(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, 1]
+    positions: jnp.ndarray,    # [B, 1]
+    cache: KVCache,            # FROZEN during the chunk
+    chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],
+    step: jnp.ndarray,         # scalar int32
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Two-segment chunked decode step (see ``llama.forward_chunked``);
+    MoE FFN unchanged."""
+    if not cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is dense; use models.llama")
+    x = params["embed"][tokens]
+    cache_k, cache_v = cache
+    chunk_k, chunk_v = chunk_kv
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(x, scanned):
+        lp, ck, cv, hk, hv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
+            B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
+            B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        hk = jax.lax.dynamic_update_slice(hk, k.astype(hk.dtype),
+                                          (0, step, 0, 0))
+        hv = jax.lax.dynamic_update_slice(hv, v.astype(hv.dtype),
+                                          (0, step, 0, 0))
+        attn = gqa_attention_chunked(q, ck, cv, hk, hv, positions, step,
+                                     window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        moe_out, _load = moe_block(
+            h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.experts_per_token,
+        )
+        x = x + moe_out
+        return x, (hk, hv)
+
+    x, (new_hk, new_hv) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache_k, cache_v, chunk_k, chunk_v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, (new_hk, new_hv)
 
 
 def init_paged_cache(
@@ -287,6 +347,6 @@ def forward_paged(
         layer_step, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
     return logits, {"k": new_k, "v": new_v, "page_table": table}

@@ -40,30 +40,44 @@ except Exception:  # pragma: no cover
     _SMEM = _VMEM = None
 
 
-def _decode_attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref):
-    # q_ref [1, G, D]; k_ref/v_ref [1, S, 1, D]; len_ref [1] (SMEM)
-    q = q_ref[0].astype(jnp.float32)                   # [G, D]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [S, D]
-    v = v_ref[0, :, 0, :].astype(jnp.float32)          # [S, D]
+def _decode_attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *,
+                        n_kv_heads: int):
+    # q_ref [1, Hq, D]; k_ref/v_ref [1, S, Hkv, D]; len_ref [B] (SMEM,
+    # whole array — TPU requires rank-1 blocks be full or 128-multiples,
+    # so the kernel indexes its row by grid position instead of slicing).
+    # One grid cell = one slot, ALL heads: per-kv-head blocks would need a
+    # [1, G, D] tile with G < 8, below the TPU sublane minimum.
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = n_kv_heads
+    G = Hq // Hkv
+    q = q_ref[0].reshape(Hkv, G, D).astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)                   # [S, Hkv, D]
+    v = v_ref[0].astype(jnp.float32)
     S = k.shape[0]
-    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scale = 1.0 / (D**0.5)
 
-    scores = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale                                          # [G, S]
+    length = len_ref[pl.program_id(0)]
+    valid = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) < length
 
-    valid = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) < len_ref[0]
-    scores = jnp.where(valid, scores, -1e30)
-
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    p = jnp.exp(scores - m)
-    denom = jnp.sum(p, axis=-1, keepdims=True)
-    out = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) / denom                                          # [G, D]
-    o_ref[0] = out.astype(o_ref.dtype)
+    # static unroll over kv heads: Mosaic's dot_general needs batch dims in
+    # matching positions, so a batched [Hkv, ...] einsum won't lower; Hkv
+    # is small (8 for the Llama-3 family) and the unrolled dots pipeline
+    outs = []
+    for h in range(Hkv):
+        scores = jax.lax.dot_general(
+            q[h], k[:, h, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                      # [G, S]
+        scores = jnp.where(valid, scores, -1e30)
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        denom = jnp.sum(p, axis=-1, keepdims=True)
+        out = jax.lax.dot_general(
+            p, v[:, h, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / denom                                      # [G, D]
+        outs.append(out)
+    o_ref[0] = jnp.concatenate(outs, axis=0).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -78,19 +92,18 @@ def decode_gqa_attention(
     CPU for tests (pallas interpreter)."""
     B, Hq, D = q.shape
     S, Hkv = cache_k.shape[1], cache_k.shape[2]
-    G = Hq // Hkv
 
-    grid = (B, Hkv)
+    grid = (B,)
     return pl.pallas_call(
-        _decode_attn_kernel,
+        functools.partial(_decode_attn_kernel, n_kv_heads=Hkv),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda b, h: (b,), memory_space=_SMEM),
-            pl.BlockSpec((1, G, D), lambda b, h: (b, h, 0)),
-            pl.BlockSpec((1, S, 1, D), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, S, 1, D), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((B,), lambda b: (0,), memory_space=_SMEM),
+            pl.BlockSpec((1, Hq, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, S, Hkv, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, Hkv, D), lambda b: (b, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, G, D), lambda b, h: (b, h, 0)),
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=interpret,
     )(lengths, q, cache_k, cache_v)

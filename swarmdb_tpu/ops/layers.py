@@ -148,15 +148,131 @@ def write_kv_cache(
     v: jnp.ndarray,
     positions: jnp.ndarray,  # [B, T]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter new K/V into per-slot cache rows at absolute positions.
+    """Write new K/V into per-slot cache rows at absolute positions.
 
-    Positions may differ per batch row (continuous batching: each slot is at
-    its own decode offset). Compiles to a scatter; shapes stay static.
+    Positions may differ per batch row (continuous batching: each slot is
+    at its own decode offset). Three lowerings, picked by static shape:
+
+    - T == S (prefill filling its whole temp cache): the write IS the
+      cache — return the new values directly, zero data movement.
+    - T == 1 (decode): a positional mask + select. TPU lowers per-row
+      scatter to a serialized index loop (measured: it dominated the
+      round-3 decode step); the mask form is a pure vectorized
+      element-wise op over the cache the step already streams through.
+    - general T: the scatter fallback (no serving path hits this today).
     """
-    b_idx = jnp.arange(cache_k.shape[0])[:, None]  # [B, 1]
+    B, S = cache_k.shape[0], cache_k.shape[1]
+    T = k.shape[1]
+    if T == S:
+        return k.astype(cache_k.dtype), v.astype(cache_v.dtype)
+    if T == 1:
+        hit = jnp.arange(S)[None, :] == positions  # [B, S]
+        sel = hit[:, :, None, None]
+        cache_k = jnp.where(sel, k.astype(cache_k.dtype), cache_k)
+        cache_v = jnp.where(sel, v.astype(cache_v.dtype), cache_v)
+        return cache_k, cache_v
+    b_idx = jnp.arange(B)[:, None]  # [B, 1]
     cache_k = cache_k.at[b_idx, positions].set(k.astype(cache_k.dtype))
     cache_v = cache_v.at[b_idx, positions].set(v.astype(cache_v.dtype))
     return cache_k, cache_v
+
+
+def gqa_attention_chunked(
+    q: jnp.ndarray,          # [B, 1, Hq, D] decode query
+    cache_k: jnp.ndarray,    # [B, S, Hkv, D] FROZEN prefix cache
+    cache_v: jnp.ndarray,
+    chunk_k: jnp.ndarray,    # [B, Kc, Hkv, D] this chunk's K so far
+    chunk_v: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, 1] absolute position of the query
+    step: jnp.ndarray,       # scalar int32: index of this step in the chunk
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Two-segment decode attention: frozen slot cache + in-chunk buffer.
+
+    The engine's chunked decode (Engine._decode) keeps the big [B, S, ...]
+    cache FROZEN for the K steps of a chunk and accumulates the chunk's own
+    K/V in a tiny [B, Kc, ...] buffer written with dynamic_update_slice
+    (uniform index — no per-row scatter). Attention therefore reads the
+    big cache without ever rewriting it; the round-3 path rewrote the full
+    cache every step, which profiling showed was the single largest cost
+    of a decode chunk (~2x the model matmuls at batch 128).
+
+    Masking: the frozen segment is valid strictly below the chunk's start
+    position (entries at >= start are a previous occupant's garbage); the
+    chunk segment is valid up to and including ``step``. One softmax spans
+    both segments. Returns [B, 1, Hq, D].
+    """
+    B, S = cache_k.shape[0], cache_k.shape[1]
+    Kc = chunk_k.shape[1]
+    Hq, Hkv = q.shape[2], cache_k.shape[2]
+    group = Hq // Hkv
+    D = q.shape[-1]
+
+    qg = q.reshape(B, 1, Hkv, group, D)
+    s_f = jnp.einsum("btkgd,bskd->bkgts", qg, cache_k,
+                     preferred_element_type=jnp.float32)
+    s_c = jnp.einsum("btkgd,bskd->bkgts", qg, chunk_k,
+                     preferred_element_type=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    start = q_positions - step                           # [B, 1] chunk start
+    kv_pos = jnp.arange(S)[None, None, :]                # [1, 1, S]
+    valid_f = kv_pos < start[:, :, None]                 # [B, 1, S]
+    if window is not None:
+        valid_f &= kv_pos > (q_positions[:, :, None] - window)
+    j = jnp.arange(Kc)[None, None, :]                    # [1, 1, Kc]
+    valid_c = j <= step                                  # [1, 1, Kc]
+    abs_c = start[:, :, None] + j                        # [B, 1, Kc]
+    if window is not None:
+        valid_c = valid_c & (abs_c > (q_positions[:, :, None] - window))
+
+    s_f = jnp.where(valid_f[:, None, None], s_f * scale, jnp.float32(-1e30))
+    s_c = jnp.where(valid_c[:, None, None], s_c * scale, jnp.float32(-1e30))
+    s = jnp.concatenate([s_f, s_c], axis=-1)             # [B, Hkv, g, 1, S+Kc]
+    p = jax.nn.softmax(s, axis=-1)
+    p_f = p[..., :S].astype(cache_v.dtype)
+    p_c = p[..., S:].astype(chunk_v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", p_f, cache_v,
+                     preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("bkgts,bskd->btkgd", p_c, chunk_v,
+                           preferred_element_type=jnp.float32)
+    return out.reshape(q.shape).astype(q.dtype)
+
+
+def merge_chunk_kv(
+    cache_k: jnp.ndarray,   # [L, B, S, Hkv, D]
+    cache_v: jnp.ndarray,
+    chunk_k: jnp.ndarray,   # [L, B, Kc, Hkv, D]
+    chunk_v: jnp.ndarray,
+    start_positions: jnp.ndarray,  # [B] absolute position of chunk step 0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold a finished chunk's K/V back into the big slot cache — ONCE per
+    chunk instead of once per step.
+
+    Expressed as a one-hot einsum + select: ``sel[b, s, j] = 1`` iff cache
+    position s is chunk entry j for row b. A take_along_axis gather here
+    is numerically identical but XLA-TPU takes minutes to compile the 5D
+    batched gather (measured >5 min at serving shapes vs ~1 s for this
+    form); the einsum is a tiny MXU contraction and the one-hot rows are
+    exact (exactly one 1 per written position), so no precision is lost.
+    """
+    S = cache_k.shape[2]
+    Kc = chunk_k.shape[2]
+    kv_pos = jnp.arange(S)[None, :]                      # [1, S]
+    start = start_positions[:, None]                     # [B, 1]
+    j = jnp.arange(Kc)[None, None, :]                    # [1, 1, Kc]
+    sel = ((kv_pos - start)[:, :, None] == j)            # [B, S, Kc]
+    hit = (kv_pos >= start) & (kv_pos < start + Kc)      # [B, S]
+    sel_b = sel.astype(cache_k.dtype)
+    hit_b = hit[None, :, :, None, None]
+
+    def upd(full, chunk):
+        g = jnp.einsum("bsj,lbjhd->lbshd", sel_b, chunk,
+                       preferred_element_type=full.dtype)
+        return jnp.where(hit_b, g, full)
+
+    return upd(cache_k, chunk_k), upd(cache_v, chunk_v)
 
 
 def gqa_attention(
@@ -190,13 +306,14 @@ def gqa_attention(
         )
         return out[:, None]
 
-    qf = q.astype(jnp.float32)
-    kf = cache_k.astype(jnp.float32)
-    vf = cache_v.astype(jnp.float32)
-
+    # bf16 operands with fp32 accumulation: the MXU-native contraction. An
+    # explicit .astype(f32) on the cache (the round-3 code) materializes
+    # the WHOLE cache in fp32 every layer every step and pushes the matmul
+    # off the bf16 fast path — measured ~2x slower decode chunks.
     # [B, T, Hkv, group, D] x [B, S, Hkv, D] -> [B, Hkv, group, T, S]
-    qg = qf.reshape(B, q.shape[1], Hkv, group, -1)
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, kf)
+    qg = q.reshape(B, q.shape[1], Hkv, group, -1)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, cache_k,
+                        preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
 
     kv_pos = jnp.arange(S)[None, None, :]                # [1, 1, S]
@@ -206,6 +323,7 @@ def gqa_attention(
     mask = causal[:, None, None, :, :]                   # [B, 1, 1, T, S]
     scores = jnp.where(mask, scores, jnp.float32(-1e30))
 
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, vf)
+    probs = jax.nn.softmax(scores, axis=-1)              # fp32
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(cache_v.dtype),
+                     cache_v, preferred_element_type=jnp.float32)
     return out.reshape(q.shape).astype(q.dtype)

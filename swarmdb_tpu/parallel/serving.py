@@ -161,4 +161,7 @@ def build_serving_engine(
         seed=seed,
         **engine_kwargs,
     )
+    # replicated engine state must live ON the mesh (mandatory for
+    # multi-process pods, harmless single-process): Engine.place_state
+    engine.place_state(sm.mesh)
     return engine, sm

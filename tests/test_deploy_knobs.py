@@ -1,0 +1,85 @@
+"""Deployment-parity knobs (VERDICT r3 next-step #6): rotating compressed
+log sink, TLS context wiring, graceful-shutdown config."""
+
+import gzip
+import logging
+import os
+import ssl
+import subprocess
+import sys
+
+import pytest
+
+from swarmdb_tpu.utils.logsink import configure_logging
+
+
+def _cleanup_handler(handler):
+    logging.getLogger().removeHandler(handler)
+    handler.close()
+
+
+def test_rotating_compressed_sink(tmp_path):
+    log_file = str(tmp_path / "logs" / "swarmdb.log")
+    handler = configure_logging(
+        log_file, rotate_bytes=2000, backup_count=3, compress=True,
+        level="INFO",
+    )
+    try:
+        log = logging.getLogger("swarmdb_tpu.test_sink")
+        for i in range(400):
+            log.info("rotation filler line %04d %s", i, "x" * 40)
+        files = sorted(os.listdir(tmp_path / "logs"))
+        # live file + gz archives, retention-bounded at backup_count
+        assert "swarmdb.log" in files
+        archives = [f for f in files if f.endswith(".gz")]
+        assert 1 <= len(archives) <= 3
+        with gzip.open(tmp_path / "logs" / archives[0], "rt") as fh:
+            assert "rotation filler line" in fh.read()
+    finally:
+        _cleanup_handler(handler)
+
+
+def test_retention_bound(tmp_path):
+    log_file = str(tmp_path / "r.log")
+    handler = configure_logging(
+        log_file, rotate_bytes=500, backup_count=2, compress=True,
+        level="INFO",
+    )
+    try:
+        log = logging.getLogger("swarmdb_tpu.test_sink2")
+        for i in range(600):
+            log.info("retention %04d %s", i, "y" * 60)
+        archives = [f for f in os.listdir(tmp_path) if f.endswith(".gz")]
+        assert len(archives) <= 2  # oldest deleted, never unbounded
+    finally:
+        _cleanup_handler(handler)
+
+
+def test_no_log_file_is_console_only(monkeypatch):
+    monkeypatch.delenv("LOG_FILE", raising=False)
+    assert configure_logging() is None
+
+
+def test_ssl_context_from_env(tmp_path, monkeypatch):
+    # self-signed cert via the stdlib-adjacent openssl binary if present,
+    # else skip (no-egress image ships openssl)
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+         str(key), "-out", str(cert), "-days", "1", "-nodes", "-subj",
+         "/CN=localhost"],
+        capture_output=True,
+    )
+    if r.returncode != 0:
+        pytest.skip("openssl unavailable")
+    from swarmdb_tpu.api.server import build_ssl_context
+
+    monkeypatch.setenv("API_SSL_CERT", str(cert))
+    monkeypatch.setenv("API_SSL_KEY", str(key))
+    ctx = build_ssl_context()
+    assert isinstance(ctx, ssl.SSLContext)
+
+    monkeypatch.delenv("API_SSL_CERT")
+    monkeypatch.delenv("API_SSL_KEY")
+    assert build_ssl_context() is None

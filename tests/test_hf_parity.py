@@ -146,3 +146,97 @@ def test_orbax_restore_sharded(tmp_path):
         np.asarray(wq, np.float32),
         np.asarray(params["layers"]["wq"], np.float32),
     )
+
+
+def test_end_to_end_hf_weights_and_tokenizer(tmp_path, tmp_swarm):
+    """VERDICT r3 #7: the full integration seam — a real HF tokenizer
+    (built in-process, zero egress) + imported HF weights + ServingService
+    + broker reply emission. Greedy engine output must equal HF
+    ``generate`` on the identical prompt ids."""
+    import threading
+
+    from tokenizers import Tokenizer as RawTokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from swarmdb_tpu.backend.engine import Engine
+    from swarmdb_tpu.backend.service import ServingService, build_prompt
+    from swarmdb_tpu.backend.tokenizer import HFTokenizer
+
+    # -- a real (tiny) HF fast tokenizer saved to disk and reloaded -------
+    words = ["hello", "plan", "the", "what", "is", "agent", "swarm", "ok"]
+    vocab = {"<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3}
+    for w in words:
+        vocab[w] = len(vocab)
+    raw = RawTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    raw.pre_tokenizer = Whitespace()
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=raw, pad_token="<pad>", bos_token="<s>",
+        eos_token="</s>", unk_token="<unk>",
+    )
+    tok_dir = str(tmp_path / "tok")
+    fast.save_pretrained(tok_dir)
+    tokenizer = HFTokenizer(tok_dir)
+
+    # -- tiny HF llama, weights imported into our stack -------------------
+    cfg = ModelConfig(name="t", **{**TINY, "vocab_size": len(vocab) + 4})
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.dim,
+        intermediate_size=cfg.ffn_dim, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads, num_key_value_heads=cfg.n_kv_heads,
+        rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_seq_len, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(7)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    params = import_hf_llama(hf, cfg, dtype=jnp.float32)
+
+    engine = Engine(
+        lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+        lambda b, s: llama.init_kv_cache(cfg, b, s, dtype=jnp.float32),
+        params, max_batch=2, max_seq=cfg.max_seq_len,
+        eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id,
+        prefill_buckets=[16, 32],
+    )
+    db = tmp_swarm
+    service = ServingService(db, engine, tokenizer, backend_id="tpu-it")
+    db.register_agent("alice")
+    db.register_agent("helper")
+    db.assign_llm_backend("helper", "tpu-it")
+    service.start()
+    try:
+        got = {}
+        done = threading.Event()
+
+        def on_done(rid, toks, reason):
+            got["tokens"] = toks
+            done.set()
+
+        mid = db.send_message(
+            "alice", "helper", "what is the plan",
+            metadata={"generation": {"max_new_tokens": 5,
+                                     "temperature": 0.0}},
+        )
+        msg = db.get_message(mid)
+        prompt_ids = build_prompt(db, msg, tokenizer)
+        service.serve_message(msg, on_done=on_done)
+        assert done.wait(120), "generation did not complete"
+
+        with torch.no_grad():
+            ref = hf.generate(
+                torch.tensor([prompt_ids], dtype=torch.long),
+                max_new_tokens=5, do_sample=False,
+            )[0, len(prompt_ids):].tolist()
+        # HF stops at eos too; compare up to our finish
+        assert got["tokens"] == ref[: len(got["tokens"])]
+        assert len(got["tokens"]) > 0
+
+        # the reply must have been emitted back through the runtime with
+        # the real tokenizer's decoding
+        reply_id = msg.metadata.get("reply_id")
+        assert reply_id is not None
+        reply = db.get_message(reply_id)
+        assert reply.content == tokenizer.decode(got["tokens"])
+    finally:
+        service.stop()

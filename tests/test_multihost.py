@@ -1,0 +1,110 @@
+"""Multi-host SPMD serving: 2-process jax.distributed CPU test.
+
+Proves VERDICT r3 next-step #5: a non-coordinator process JOINS the decode
+program (Engine.worker_loop replaying the coordinator's published calls)
+instead of refusing to start. The two processes form a global 2-device
+mesh (model=2 tensor parallelism — every matmul all-reduces across the
+process boundary, so any lockstep desync deadlocks and fails the test
+timeout), generate greedily on the coordinator, and must produce exactly
+the tokens a single-process run over an identically-shaped 2-device local
+mesh produces.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER_SCRIPT = textwrap.dedent("""
+    import json, sys
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                               process_id=pid)
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+
+    engine, sm = build_serving_engine(
+        "tiny-debug", max_batch=4, max_seq=64, decode_chunk=4,
+        prefill_buckets=[16, 32],
+    )
+    if pid == 0:
+        engine.enable_multihost()
+        engine.start()
+        toks1, r1 = engine.generate_sync(
+            [1, 5, 9], SamplingParams(max_new_tokens=6), timeout=120)
+        toks2, r2 = engine.generate_sync(
+            [1, 5, 9], SamplingParams(max_new_tokens=6), timeout=120)
+        engine.stop()
+        print("RESULT " + json.dumps({"t1": toks1, "t2": toks2,
+                                      "r": r1}), flush=True)
+    else:
+        engine.worker_loop()
+        print("WORKER_DONE", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_worker_joins_decode():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each process contributes ONE cpu device
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SCRIPT, str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost run deadlocked (worker not in lockstep?)")
+        outs.append((p.returncode, out, err))
+
+    rc0, out0, err0 = outs[0]
+    rc1, out1, err1 = outs[1]
+    assert rc0 == 0, f"coordinator failed:\n{err0[-2000:]}"
+    assert rc1 == 0, f"worker failed:\n{err1[-2000:]}"
+    assert "WORKER_DONE" in out1  # stop broadcast released the worker
+    line = next(l for l in out0.splitlines() if l.startswith("RESULT "))
+    res = json.loads(line[len("RESULT "):])
+    assert res["t1"] == res["t2"], "multihost decode must be deterministic"
+    assert len(res["t1"]) > 0 and res["r"] in ("length", "eos")
+
+    # parity: a single-process run over an identically shaped 2-device
+    # local mesh (same GSPMD program => same reduction order) must produce
+    # exactly the same greedy tokens
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+
+    engine, _sm = build_serving_engine(
+        "tiny-debug", mesh=make_mesh(n_devices=2),
+        max_batch=4, max_seq=64, decode_chunk=4, prefill_buckets=[16, 32],
+    )
+    engine.start()
+    try:
+        ref, _ = engine.generate_sync([1, 5, 9],
+                                      SamplingParams(max_new_tokens=6))
+    finally:
+        engine.stop()
+    assert res["t1"] == ref

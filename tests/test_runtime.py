@@ -387,3 +387,43 @@ def test_late_registration_does_not_scan_history(tmp_path):
     got = db.receive_messages("newcomer", timeout=1.0)
     assert [m.id for m in got] == [mid]
     db.close()
+
+
+def test_adopt_backlog_cross_process(tmp_path):
+    """ADVICE r2 weak #5: a second runtime over the SAME broker can adopt
+    an agent's pre-registration backlog with adopt_backlog=True; the
+    default still starts at the partition end (no replay churn). Separate
+    brokers per scenario: a default-registered consumer auto-commits its
+    end position for the shared per-agent group, which (correctly,
+    Kafka-faithfully) outranks any later offset-reset policy."""
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.core.runtime import SwarmDB
+
+    def seeded_broker(tag):
+        broker = LocalBroker()
+        db1 = SwarmDB(broker=broker, save_dir=str(tmp_path / f"w{tag}"),
+                      autosave_interval=1e9)
+        db1.send_message("writer", "adoptee", "before-adoption-1")
+        db1.send_message("writer", "adoptee", "before-adoption-2")
+        db1.producer.flush()
+        return broker, db1
+
+    # default registration: starts at the partition end, sees nothing
+    broker, db1 = seeded_broker("a")
+    db2 = SwarmDB(broker=broker, save_dir=str(tmp_path / "a2"),
+                  autosave_interval=1e9)
+    db2.register_agent("adoptee")
+    assert db2.receive_messages("adoptee", max_messages=10, timeout=0.2) == []
+    db2.close()
+    db1.close()
+
+    # adopt_backlog=True: drains the pre-registration history
+    broker, db1 = seeded_broker("b")
+    db3 = SwarmDB(broker=broker, save_dir=str(tmp_path / "b2"),
+                  autosave_interval=1e9)
+    db3.register_agent("adoptee", adopt_backlog=True)
+    got = db3.receive_messages("adoptee", max_messages=10, timeout=0.5)
+    assert [m.content for m in got] == ["before-adoption-1",
+                                       "before-adoption-2"]
+    db3.close()
+    db1.close()
