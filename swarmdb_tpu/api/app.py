@@ -62,6 +62,12 @@ class ApiConfig:
     # accepts ANY non-empty credentials (`api.py:373-374`) which makes every
     # authorization check moot; unset keeps that demo parity but logs loudly.
     admin_password: Optional[str] = None
+    # Worker recycling (gunicorn max_requests+jitter counterpart,
+    # `gunicorn_config.py:28-34`): after ~this many requests the process
+    # drains and exits gracefully; the supervisor (compose
+    # restart-unless-stopped, k8s) brings a fresh one up. 0 = never.
+    max_requests: int = 0
+    max_requests_jitter: int = 0
 
     @classmethod
     def from_env(cls) -> "ApiConfig":
@@ -75,6 +81,8 @@ class ApiConfig:
             host=os.environ.get("API_HOST", "0.0.0.0"),
             port=int(os.environ.get("API_PORT", "8000")),
             admin_password=os.environ.get("ADMIN_PASSWORD") or None,
+            max_requests=int(os.environ.get("API_MAX_REQUESTS", "0")),
+            max_requests_jitter=int(os.environ.get("API_MAX_REQUESTS_JITTER", "0")),
         )
 
     def allowed_origin(self, request_origin: Optional[str]) -> Optional[str]:
@@ -168,12 +176,24 @@ def create_app(
     db: SwarmDB,
     config: Optional[ApiConfig] = None,
     serving: Optional[Any] = None,
+    on_max_requests: Optional[Any] = None,
 ) -> web.Application:
     """Build the application. ``serving`` is an optional
     :class:`~swarmdb_tpu.backend.service.ServingService` that turns
-    LLM-addressed messages into streamed replies."""
+    LLM-addressed messages into streamed replies. ``on_max_requests``
+    fires ONCE when ``cfg.max_requests`` (+ random jitter) requests have
+    been served — the worker-recycling hook (the server entry point exits
+    gracefully; its supervisor restarts a fresh process)."""
     cfg = config or ApiConfig()
     limiter = RateLimiter(cfg.rate_limit_per_minute)
+    recycle_at: Optional[int] = None
+    if cfg.max_requests > 0:
+        import random
+
+        # jitter staggers a fleet's recycles (gunicorn_config.py:33-34)
+        recycle_at = cfg.max_requests + random.randint(
+            0, max(0, cfg.max_requests_jitter))
+    served_requests = {"n": 0, "fired": False}
     if cfg.admin_password is None:
         logger.warning(
             "ADMIN_PASSWORD not set: any client can obtain an admin token "
@@ -240,6 +260,18 @@ def create_app(
                                  request.method, request.path)
                 resp = web.json_response({"detail": "internal error"}, status=500)
         _add_cors(resp, request.headers.get("Origin"))
+        if recycle_at is not None and request.path != "/health":
+            served_requests["n"] += 1
+            if (served_requests["n"] >= recycle_at
+                    and not served_requests["fired"]):
+                served_requests["fired"] = True
+                logger.info("max_requests reached (%d); recycling worker",
+                            served_requests["n"])
+                if on_max_requests is not None:
+                    try:
+                        on_max_requests()
+                    except Exception:
+                        logger.exception("max_requests hook failed")
         return resp
 
     def _add_cors(resp: web.StreamResponse, origin: Optional[str] = None) -> None:
@@ -516,6 +548,50 @@ def create_app(
         n = await _run_sync(db.auto_scale_partitions)
         return _json({"status": "scaled", "num_partitions": n})
 
+    async def metrics(request: web.Request) -> web.Response:
+        """GET /metrics: Prometheus text exposition of the runtime's
+        counters/rates/latency percentiles. Unauthenticated by scraper
+        convention; exposes aggregate numbers only, never message
+        content or per-agent identity (the per-agent detail stays behind
+        the admin-scoped /stats)."""
+        snap = await _run_sync(db.metrics.snapshot)
+        lines = []
+
+        def _name(k: str) -> str:
+            return "swarmdb_" + "".join(
+                c if c.isalnum() or c == "_" else "_" for c in k)
+
+        for k, v in sorted(snap["counters"].items()):
+            if k.startswith("agent_recv:"):
+                continue
+            n = _name(k)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {v}")
+        for k, v in sorted(snap["rates"].items()):
+            if k.startswith("agent_recv:"):
+                continue
+            n = _name(k) + "_per_second"
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {v}")
+        for k, s in sorted(snap["latencies"].items()):
+            n = _name(k)
+            lines.append(f"# TYPE {n} summary")
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                if s.get(key) is not None:
+                    lines.append(f'{n}{{quantile="{q}"}} {s[key]}')
+            lines.append(f"{n}_count {int(s.get('count') or 0)}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    async def dashboard(request: web.Request) -> web.Response:
+        """GET /dashboard: self-contained observability page (the
+        kafka-ui counterpart — reference dockerfile-compose.yaml:51-62).
+        The page holds no data; it fetches /health + /stats with the
+        operator's pasted bearer token."""
+        from .dashboard import DASHBOARD_HTML
+
+        return web.Response(text=DASHBOARD_HTML, content_type="text/html")
+
     async def agent_load(request: web.Request) -> web.Response:
         """GET /agents/{agent_id}/load — inbox size, unread count, trailing
         msgs/sec. The reference computes this (` main.py:1049-1094`) but
@@ -664,6 +740,8 @@ def create_app(
         web.post("/admin/resend_failed", admin_resend),
         web.post("/admin/scale_partitions", admin_scale),
         # TPU-build additions (no reference routes)
+        web.get("/metrics", metrics),
+        web.get("/dashboard", dashboard),
         web.get("/agents/{agent_id}/load", agent_load),
         web.post("/admin/profile/start", profile_start),
         web.post("/admin/profile/stop", profile_stop),

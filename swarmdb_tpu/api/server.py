@@ -151,7 +151,24 @@ def main() -> None:
     db = build_db()
     serving = build_serving(db, distributed=distributed)
     cfg = ApiConfig.from_env()
-    app = create_app(db, cfg, serving=serving)
+    def _recycle() -> None:
+        # worker recycling: SIGTERM ourselves; aiohttp drains in-flight
+        # requests within shutdown_timeout and the supervisor (compose
+        # restart-unless-stopped / k8s) starts a fresh process
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    on_max = _recycle
+    if distributed and cfg.max_requests > 0:
+        # a recycling coordinator would strand every worker host mid
+        # worker_loop and wedge the pod; recycle a pod by rolling ALL its
+        # processes from the orchestrator instead
+        logging.getLogger(__name__).warning(
+            "API_MAX_REQUESTS ignored on a multi-host pod coordinator"
+        )
+        on_max = None
+    app = create_app(db, cfg, serving=serving, on_max_requests=on_max)
     if serving is not None:
         serving.start()
     web.run_app(

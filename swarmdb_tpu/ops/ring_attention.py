@@ -26,20 +26,26 @@ import jax.numpy as jnp
 
 
 def _block_attend(
-    q: jnp.ndarray,        # [B, Tq, Hq, D] fp32
-    k: jnp.ndarray,        # [B, Tk, Hkv, D] fp32
-    v: jnp.ndarray,        # [B, Tk, Hkv, D] fp32
+    q: jnp.ndarray,        # [B, Tq, Hq, D] model dtype (bf16 on TPU)
+    k: jnp.ndarray,        # [B, Tk, Hkv, D]
+    v: jnp.ndarray,        # [B, Tk, Hkv, D]
     q_pos: jnp.ndarray,    # [B, Tq]
     kv_pos: jnp.ndarray,   # [B, Tk]
     window=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One block of masked attention: returns (scores-exp sum `l`,
-    running max `m`, weighted values `o`) for online-softmax merging."""
+    running max `m`, weighted values `o`) for online-softmax merging.
+
+    Matmuls take the operands in their native dtype with fp32
+    accumulation (the MXU fast path); softmax state is fp32 throughout.
+    """
     B, Tq, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
     qg = q.reshape(B, Tq, Hkv, group, D)
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k) / jnp.sqrt(jnp.float32(D))
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D))
     causal = kv_pos[:, None, :] <= q_pos[:, :, None]  # [B,Tq,Tk]
     if window is not None:
         causal &= kv_pos[:, None, :] > (q_pos[:, :, None] - window)
@@ -52,7 +58,8 @@ def _block_attend(
     p = jnp.exp(scores - m_safe[..., None])
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)                           # [B,Hkv,G,Tq]
-    o = jnp.einsum("bkgts,bskd->bkgtd", p, v)         # [B,Hkv,G,Tq,D]
+    o = jnp.einsum("bkgts,bskd->bkgtd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o, l, jnp.where(jnp.isfinite(m), m, -jnp.inf)
 
 
@@ -72,7 +79,6 @@ def ring_attention(
     Hkv = k.shape[2]
     group = Hq // Hkv
 
-    qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def merge(acc, block):
@@ -90,8 +96,7 @@ def ring_attention(
 
     def attend(k_cur, v_cur, pos_cur, acc):
         return merge(acc, _block_attend(
-            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
-            q_pos, pos_cur, window=window,
+            q, k_cur, v_cur, q_pos, pos_cur, window=window,
         ))
 
     def step(carry, _):

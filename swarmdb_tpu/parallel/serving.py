@@ -46,6 +46,9 @@ class ShardedModel:
     forward_fn: Callable  # (params, tokens, positions, cache) -> (logits, cache)
     init_cache_fn: Callable  # (batch, max_seq) -> cache pytree
     param_shardings: Any
+    # two-segment chunked decode triple (chunk_forward, init_chunk, merge)
+    # with shardings pinned — Engine(chunked_fns=...); see ops/layers.py
+    chunked_fns: Any = None
 
     @property
     def data_size(self) -> int:
@@ -123,6 +126,30 @@ def build_sharded_model(
             return jax.jit(shape_fn, out_shardings=out_sh)()
         return shape_fn()
 
+    # -- chunked decode (Engine's two-segment path), shardings pinned -----
+    # the chunk buffer [L, B, Kc, Hkv, D] shards exactly like the cache
+    def _constrain_kv(tree):
+        return jax.tree.map(
+            lambda c: jax.lax.with_sharding_constraint(c, cache_sharding),
+            tree,
+        )
+
+    def chunked_forward_fn(p, tokens, positions, cache, chunk_kv, step):
+        from ..ops.layers import pallas_disabled
+
+        cache = _constrain_kv(cache)
+        chunk_kv = _constrain_kv(chunk_kv)
+        with pallas_disabled():
+            logits, chunk_kv = fam.forward_chunked(
+                p, cfg, tokens, positions, cache, chunk_kv, step)
+        return logits, _constrain_kv(chunk_kv)
+
+    def init_chunk_fn(batch: int, chunk: int):
+        return _constrain_kv(fam.init_chunk_kv(cfg, batch, chunk))
+
+    def merge_fn(cache, chunk_kv, start_positions):
+        return _constrain_kv(fam.merge_chunk(cache, chunk_kv, start_positions))
+
     return ShardedModel(
         cfg=cfg,
         mesh=mesh,
@@ -130,6 +157,7 @@ def build_sharded_model(
         forward_fn=forward_fn,
         init_cache_fn=init_cache_fn,
         param_shardings=shardings,
+        chunked_fns=(chunked_forward_fn, init_chunk_fn, merge_fn),
     )
 
 
@@ -149,9 +177,14 @@ def build_serving_engine(
     """
     from ..backend.engine import Engine
 
+    import os
+
     sm = build_sharded_model(model_name_or_cfg, mesh, seed=seed)
     if max_batch is None:
         max_batch = 8 * sm.data_size
+    # same escape hatch the single-chip path honors (backend/service.py)
+    if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
+        engine_kwargs.setdefault("chunked_fns", sm.chunked_fns)
     engine = Engine(
         sm.forward_fn,
         sm.init_cache_fn,

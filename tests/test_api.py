@@ -17,12 +17,12 @@ from swarmdb_tpu.core.runtime import SwarmDB
 CFG = ApiConfig(jwt_secret_key="test-secret", rate_limit_per_minute=10_000)
 
 
-def api_drive(coro_fn, tmp_path, config=CFG, serving=None):
+def api_drive(coro_fn, tmp_path, config=CFG, serving=None, **app_kwargs):
     """Spin up app+client, run coro_fn(client, db), tear down."""
 
     async def runner():
         db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "hist"))
-        app = create_app(db, config, serving=serving)
+        app = create_app(db, config, serving=serving, **app_kwargs)
         client = TestClient(TestServer(app))
         await client.start_server()
         try:
@@ -549,3 +549,60 @@ def test_engine_watchdog_restarts_dead_loop(tmp_path):
             serving.stop()
 
     api_drive(drive, tmp_path)
+
+
+def test_dashboard_page(tmp_path):
+    """GET /dashboard serves the self-contained observability page (no
+    auth on the page; data fetched client-side with a pasted token)."""
+    async def drive(client, db):
+        r = await client.get("/dashboard")
+        assert r.status == 200
+        assert "text/html" in r.headers["Content-Type"]
+        body = await r.text()
+        assert "SwarmDB-TPU dashboard" in body
+        assert "/stats" in body and "/health" in body  # polls live routes
+
+    api_drive(drive, tmp_path)
+
+
+def test_metrics_scrape_endpoint(tmp_path):
+    """GET /metrics: unauthenticated Prometheus text exposition of
+    aggregate counters/rates/latencies; per-agent keys excluded."""
+    async def drive(client, db):
+        headers = await get_token(client, "scraper")
+        db.register_agent("sink")
+        for i in range(3):
+            await client.post("/messages",
+                              json={"receiver_id": "sink", "content": f"m{i}"},
+                              headers=headers)
+        r = await client.get("/metrics")  # no auth header
+        assert r.status == 200
+        body = await r.text()
+        assert "# TYPE swarmdb_messages_sent counter" in body
+        assert "swarmdb_messages_sent 3" in body
+        assert "agent_recv" not in body  # per-agent detail not exposed
+
+    api_drive(drive, tmp_path)
+
+
+def test_worker_recycling_hook(tmp_path):
+    """cfg.max_requests fires the recycle hook exactly once after the
+    threshold (gunicorn max_requests counterpart)."""
+    import dataclasses
+
+    fired = []
+    cfg = dataclasses.replace(CFG, max_requests=5, max_requests_jitter=0)
+
+    async def drive(client, db):
+        for i in range(8):
+            r = await client.get("/health")  # exempt from the count
+            assert r.status == 200
+        assert fired == []
+        headers = await get_token(client, "recycler")
+        for i in range(7):
+            r = await client.get("/messages", headers=headers)
+            assert r.status == 200
+        assert fired == [1]  # fired once, not per request past the limit
+
+    api_drive(drive, tmp_path, config=cfg,
+              on_max_requests=lambda: fired.append(1))
