@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Round-4: end-to-end Engine timing with per-phase instrumentation.
 
-Monkeypatches Engine._admit / _prefill_batch / _step_decode with wall
+Monkeypatches Engine._admit / _prefill_batch / _process_block with wall
 timers to find where the 6.6 s/chunk of BENCH_r03 goes.
 
 Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_engine.py
@@ -40,16 +40,20 @@ chunked_fns = (
     llama.merge_chunk,
 )
 
+# pipeline_depth=1: with dispatch-ahead (the serving default) the
+# per-phase timers stop decomposing wall time — _process_block would
+# measure overlap-hidden waits, not decode cost
 engine = Engine(fwd, init_cache, params, max_batch=B, max_seq=S,
-                decode_chunk=K, eos_id=-1, chunked_fns=chunked_fns)
+                decode_chunk=K, eos_id=-1, chunked_fns=chunked_fns,
+                pipeline_depth=1)
 
 times = {"admit": 0.0, "prefill": 0.0, "decode": 0.0,
          "admit_n": 0, "prefill_n": 0, "decode_n": 0}
 
-for name in ("_admit", "_prefill_batch", "_step_decode"):
+for name in ("_admit", "_prefill_batch", "_process_block"):
     orig = getattr(engine, name)
     key = {"_admit": "admit", "_prefill_batch": "prefill",
-           "_step_decode": "decode"}[name]
+           "_process_block": "decode"}[name]
 
     def wrap(orig=orig, key=key):
         def inner(*a, **kw):

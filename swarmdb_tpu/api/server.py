@@ -159,16 +159,18 @@ def main() -> None:
 
         os.kill(os.getpid(), signal.SIGTERM)
 
-    on_max = _recycle
     if distributed and cfg.max_requests > 0:
         # a recycling coordinator would strand every worker host mid
         # worker_loop and wedge the pod; recycle a pod by rolling ALL its
-        # processes from the orchestrator instead
+        # processes from the orchestrator instead. Zero the knob itself so
+        # the middleware neither counts nor logs "recycling" misleadingly.
+        import dataclasses
+
         logging.getLogger(__name__).warning(
             "API_MAX_REQUESTS ignored on a multi-host pod coordinator"
         )
-        on_max = None
-    app = create_app(db, cfg, serving=serving, on_max_requests=on_max)
+        cfg = dataclasses.replace(cfg, max_requests=0)
+    app = create_app(db, cfg, serving=serving, on_max_requests=_recycle)
     if serving is not None:
         serving.start()
     web.run_app(

@@ -74,6 +74,10 @@ class _Slot:
     generated: List[int] = field(default_factory=list)
     pending_first: bool = False  # prefill token not yet surfaced to host
     first_token_at: Optional[float] = None
+    # device-side next write position: advances by K at each DISPATCH
+    # (pipelined chunks are issued before the previous block is read);
+    # ``position`` stays the host-confirmed value, advanced at processing
+    dispatched_position: int = 0
 
 
 @dataclass
@@ -118,6 +122,7 @@ class Engine:
         paged: Optional[PagedKV] = None,
         prefill_batch: Optional[int] = None,
         chunked_fns: Optional[Tuple[Callable, Callable, Callable]] = None,
+        pipeline_depth: int = 2,
     ) -> None:
         self.forward_fn = forward_fn
         self.params = params
@@ -128,6 +133,16 @@ class Engine:
         self.metrics = metrics or MetricsRegistry()
 
         self.decode_chunk = max(1, int(decode_chunk))
+        # How many decode chunks may be in flight before the host reads
+        # the oldest block. Depth 2 issues chunk N+1 BEFORE device_get of
+        # chunk N, hiding the host<->device round-trip (~69 ms on this
+        # image's tunneled TPU — a quarter of a B=128 chunk) behind the
+        # next chunk's compute. Token math is unchanged: dispatch order
+        # and device state evolution are identical; only when the host
+        # READS each block moves. Slots that retire mid-flight compute
+        # one extra chunk of garbage their snapshot tells the host to
+        # discard. Depth 1 = the round-3 lockstep behavior.
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.paged = paged
         # main decode cache: paged pool or dense slot buffer; prefill always
         # uses dense bucket-sized temp caches from init_cache_fn
@@ -182,7 +197,7 @@ class Engine:
         # Two variants: the full sampler, and a sort-free one used whenever
         # no ACTIVE slot has top-k/top-p enabled (sampling.py use_filters —
         # the [B, V] sort is the most expensive op in a large-batch decode
-        # step). _step_decode picks per chunk from host-side slot state.
+        # step). _dispatch_decode picks per chunk from host-side slot state.
         # Two chunk-loop shapes:
         # - chunked_fns (dense Llama/Mixtral): the big cache stays FROZEN
         #   across the K steps; each step's K/V lands in a small [B, K, ...]
@@ -587,17 +602,31 @@ class Engine:
     # ------------------------------------------------------------- the loop
 
     def _run(self) -> None:
+        in_flight: List[Tuple[Any, List[Tuple[int, GenRequest, int]]]] = []
         while True:
             with self._cv:
-                while not self._stop and not self._queue and not self._any_active():
+                while (not self._stop and not self._queue
+                       and not self._any_active() and not in_flight):
                     self._cv.wait(timeout=0.5)
                 if self._stop:
+                    # drain dispatched chunks so their requests complete
+                    # instead of hanging to their callers' timeouts
+                    for entry in in_flight:
+                        try:
+                            self._process_block(*entry)
+                        except Exception:
+                            logger.exception("drain on stop failed")
+                    in_flight.clear()
                     break
             try:
                 self._admit()
                 if self._any_active():
-                    self._step_decode()
+                    in_flight.append(self._dispatch_decode())
+                while in_flight and (len(in_flight) >= self.pipeline_depth
+                                     or not self._any_active()):
+                    self._process_block(*in_flight.pop(0))
             except Exception:
+                in_flight.clear()
                 logger.exception("engine step failed; failing active requests")
                 self._fail_all("engine_error")
                 if self._mh is not None:
@@ -843,6 +872,7 @@ class Engine:
             slot.active = True
             slot.request = req
             slot.position = len(req.prompt)  # next write position
+            slot.dispatched_position = slot.position
             slot.generated = []
             slot.pending_first = True
             slot.first_token_at = None
@@ -856,22 +886,25 @@ class Engine:
 
     # --------------------------------------------------------------- decode
 
-    def _step_decode(self) -> None:
-        """Run one K-step decode chunk and process its token block.
+    def _dispatch_decode(self):
+        """Issue one K-step decode chunk (NO host sync) and return
+        (device token block, snapshot) for later processing.
 
-        ONE host sync per chunk: the [K+1, B] token block. Token (s+1, i)
-        was sampled at write position ``pos0_i + s`` — emission stops at a
-        slot's EOS / max_new_tokens / max_seq and the remainder of its lane
-        is discarded garbage.
+        The snapshot pins (slot, request, start position) at dispatch
+        time: with pipelining, a slot can retire and be re-admitted while
+        this chunk is still in flight — its lane then holds the OLD
+        occupant's garbage, which processing must discard (the request
+        identity check does exactly that).
         """
         positions = np.zeros((self.max_batch,), np.int32)
-        pos0 = [0] * self.max_batch
+        snapshot: List[Tuple[int, GenRequest, int]] = []
         needs_filters = False
         needs_sampling = False
         for i, s in enumerate(self.slots):
             if s.active:
-                positions[i] = s.position
-                pos0[i] = s.position
+                positions[i] = s.dispatched_position
+                snapshot.append((i, s.request, s.dispatched_position))
+                s.dispatched_position += self.decode_chunk
                 if self._topk[i] > 0 or self._topp[i] < 1.0:
                     needs_filters = True
                 if self._temp[i] > 0:
@@ -886,12 +919,23 @@ class Engine:
             self.cache, self.base_keys,
             self._temp, self._topk, self._topp,
         )
-        block = np.asarray(jax.device_get(all_toks))  # [K+1, B] — the one sync
+        return all_toks, snapshot
+
+    def _process_block(self, all_toks, snapshot) -> None:
+        """Fetch one dispatched chunk's [K+1, B] token block (the one
+        host sync) and emit its tokens.
+
+        Token (s+1, i) was sampled at write position ``pos0_i + s`` —
+        emission stops at a slot's EOS / max_new_tokens / max_seq and the
+        remainder of its lane is discarded garbage.
+        """
+        block = np.asarray(jax.device_get(all_toks))
         now = time.time()
         K = self.decode_chunk
-        for i, s in enumerate(self.slots):
-            if not s.active:
-                continue
+        for i, req, pos0 in snapshot:
+            s = self.slots[i]
+            if not s.active or s.request is not req:
+                continue  # retired mid-flight (possibly re-admitted)
             if s.pending_first:
                 # row 0 is the fed token == this slot's prefill sample,
                 # which the host deliberately never fetched at admission
@@ -900,13 +944,13 @@ class Engine:
             for step in range(K):
                 if not s.active:
                     break
-                if pos0[i] + step >= self.max_seq:
+                if pos0 + step >= self.max_seq:
                     # the cache lane is full; later writes were dropped
                     self._retire(i, "max_seq")
                     break
                 self._emit_token(i, int(block[step + 1, i]), now)
             if s.active:
-                s.position = pos0[i] + K
+                s.position = pos0 + K
 
     def _emit_token(self, slot_id: int, token: int,
                     now: Optional[float] = None) -> None:

@@ -202,6 +202,7 @@ class ServingService:
             eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
             metrics=db.metrics, decode_chunk=decode_chunk, paged=paged_spec,
             prefill_batch=prefill_batch, chunked_fns=chunked_fns,
+            pipeline_depth=int(os.environ.get("SWARMDB_PIPELINE", "2")),
         )
         return cls(db, engine, tokenizer, backend_id=backend_id)
 

@@ -225,3 +225,44 @@ def test_chunked_engine_sampling_variants():
         assert mixed == greedy_only
     finally:
         eng.stop()
+
+
+def test_pipeline_depths_token_identical():
+    """Dispatch-ahead pipelining (depth 2) must not change any sampled
+    token vs lockstep (depth 1): dispatch order and device state are
+    identical, only host read timing moves. Exercises slot reuse across
+    in-flight chunks (more requests than slots, short replies)."""
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(6))
+    outs = {}
+    for depth in (1, 2):
+        eng = Engine(
+            lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+            lambda b, s: llama.init_kv_cache(cfg, b, s),
+            params, max_batch=2, max_seq=96, eos_id=-1, seed=0,
+            prefill_buckets=[16], decode_chunk=4, pipeline_depth=depth,
+        )
+        eng.start()
+        try:
+            results = {}
+            done = threading.Event()
+            n = 6  # 3x the slot count -> forced mid-flight reuse
+
+            def mk(i):
+                def on_done(rid, toks, reason):
+                    results[i] = toks
+                    if len(results) == n:
+                        done.set()
+                return on_done
+
+            for i in range(n):
+                eng.submit(GenRequest(
+                    prompt=[1 + i, 5, 9],
+                    sampling=SamplingParams(max_new_tokens=7),
+                    on_done=mk(i),
+                ))
+            assert done.wait(120)
+            outs[depth] = [results[i] for i in range(n)]
+        finally:
+            eng.stop()
+    assert outs[1] == outs[2]
