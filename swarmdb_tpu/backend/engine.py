@@ -608,16 +608,20 @@ class Engine:
                 while (not self._stop and not self._queue
                        and not self._any_active() and not in_flight):
                     self._cv.wait(timeout=0.5)
-                if self._stop:
-                    # drain dispatched chunks so their requests complete
-                    # instead of hanging to their callers' timeouts
-                    for entry in in_flight:
-                        try:
-                            self._process_block(*entry)
-                        except Exception:
-                            logger.exception("drain on stop failed")
-                    in_flight.clear()
-                    break
+                stopping = self._stop
+            if stopping:
+                # drain dispatched chunks so their requests complete
+                # instead of hanging to their callers' timeouts — OUTSIDE
+                # the lock: processing blocks on the device and runs user
+                # callbacks, either of which under _cv could deadlock a
+                # thread re-entering submit()/stop()
+                for entry in in_flight:
+                    try:
+                        self._process_block(*entry)
+                    except Exception:
+                        logger.exception("drain on stop failed")
+                in_flight.clear()
+                break
             try:
                 self._admit()
                 if self._any_active():

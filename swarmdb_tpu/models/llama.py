@@ -333,6 +333,168 @@ def forward_paged(
     return logits, {"k": new_k, "v": new_v, "page_table": table}
 
 
+# ----------------------------------------------------- pipeline parallelism
+
+
+def param_specs_pp(cfg: ModelConfig, pipe_axis: str = "pipe") -> Params:
+    """PartitionSpecs for pipeline parallelism: the stacked [L, ...] layer
+    arrays shard their LAYER axis over ``pipe_axis`` (each stage holds
+    L/pipe layers); embedding/norms/head are replicated. This is the
+    storage layout ``forward_pipelined`` consumes — the stacked-layer
+    design makes PP a leading-axis sharding, not a model rewrite."""
+    p = pipe_axis
+    specs: Params = {
+        "embed": P(None, None),
+        "layers": jax.tree.map(lambda _: P(p), {
+            "attn_norm": 0, "wq": 0, "wk": 0, "wv": 0, "wo": 0,
+            "mlp_norm": 0, "w_gate": 0, "w_up": 0, "w_down": 0,
+        }),
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def forward_pipelined(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [B, T]
+    positions: jnp.ndarray,   # [B, T]
+    mesh,                     # jax.sharding.Mesh with a 'pipe' axis
+    *,
+    microbatches: Optional[int] = None,
+    pipe_axis: str = "pipe",
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Pipeline-parallel prefill: GPipe-style microbatch rotation.
+
+    Layers shard over ``pipe_axis`` (SURVEY §2.4 PP row); the batch splits
+    into M microbatches that flow through the stage ring via
+    ``lax.ppermute`` — at steady state every stage computes a different
+    microbatch, with the classic (P-1)/(M+P-1) bubble at the edges.
+    Stage 0 embeds, the last stage applies the head; invalid edge steps
+    compute masked garbage that is never stored. All collectives are the
+    forward neighbor ppermute plus one psum to replicate the logits.
+
+    Returns fp32 logits [B, T, V] and prompt K/V [L, B, T, Hkv, hd]
+    (layer axis pipe-sharded on device). Requires n_layers % pipe == 0
+    and B % microbatches == 0. This is the PREFILL path; decode keeps
+    TP/DP (single-token PP would serialize on inter-stage latency).
+    """
+    from ..utils.compat import shard_map
+
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; PP is dense-only for now")
+    n_stages = mesh.shape[pipe_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pipe={n_stages}")
+    B, T = tokens.shape
+    M = microbatches or min(B, max(2, n_stages))
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    Bm = B // M
+
+    def stage_fwd(params, tokens, positions):
+        stage = jax.lax.axis_index(pipe_axis)
+        n_p = jax.lax.psum(1, pipe_axis)
+        lp = params["layers"]  # local [L/P, ...] slices
+        L_local = lp["attn_norm"].shape[0]
+        mb_tok = tokens.reshape(M, Bm, T)
+        mb_pos = positions.reshape(M, Bm, T)
+
+        def run_layers(x, pos):
+            cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta)
+
+            def layer_step(x, layer):
+                h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+                b, t = h.shape[0], h.shape[1]
+                q = jnp.einsum("btd,dh->bth", h, layer["wq"]).reshape(
+                    b, t, cfg.n_heads, cfg.head_dim)
+                k = jnp.einsum("btd,dh->bth", h, layer["wk"]).reshape(
+                    b, t, cfg.n_kv_heads, cfg.head_dim)
+                v = jnp.einsum("btd,dh->bth", h, layer["wv"]).reshape(
+                    b, t, cfg.n_kv_heads, cfg.head_dim)
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+                attn = gqa_attention(q, k, v, pos, window=cfg.sliding_window)
+                x = x + jnp.einsum("bth,hd->btd", attn.reshape(b, t, -1),
+                                   layer["wo"])
+                h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+                x = x + swiglu(h2, layer["w_gate"], layer["w_up"],
+                               layer["w_down"])
+                return x, (k, v)
+
+            return jax.lax.scan(layer_step, x, lp)
+
+        state = jnp.zeros((Bm, T, cfg.dim), params["embed"].dtype)
+        ks_all = jnp.zeros((L_local, M, Bm, T, cfg.n_kv_heads, cfg.head_dim),
+                           params["embed"].dtype)
+        vs_all = jnp.zeros_like(ks_all)
+        # accumulate the LAST stage's post-norm activations, not logits: a
+        # [M, Bm, T, dim] carry + one dim-sized psum beats a fp32
+        # [M, Bm, T, V] carry + V-sized psum by V/dim (16-64x), and the
+        # head matmul then runs once after the scan instead of per step
+        act_acc = jnp.zeros((M, Bm, T, cfg.dim), params["embed"].dtype)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step(carry, t_idx):
+            state, ks_all, vs_all, act_acc = carry
+            m_in = t_idx - stage                      # microbatch here now
+            m_cl = jnp.clip(m_in, 0, M - 1)
+            valid = (m_in >= 0) & (m_in < M)
+            tok_m = jax.lax.dynamic_index_in_dim(mb_tok, m_cl, 0, False)
+            pos_m = jax.lax.dynamic_index_in_dim(mb_pos, m_cl, 0, False)
+            inject = params["embed"][tok_m]           # stage-0 entry point
+            x = jnp.where(stage == 0, inject, state)
+            x, (ks, vs) = run_layers(x, pos_m)
+
+            sel = valid
+            old_k = jax.lax.dynamic_index_in_dim(ks_all, m_cl, 1, False)
+            old_v = jax.lax.dynamic_index_in_dim(vs_all, m_cl, 1, False)
+            ks_all = jax.lax.dynamic_update_index_in_dim(
+                ks_all, jnp.where(sel, ks, old_k), m_cl, 1)
+            vs_all = jax.lax.dynamic_update_index_in_dim(
+                vs_all, jnp.where(sel, vs, old_v), m_cl, 1)
+
+            xn = rms_norm(x, params["final_norm"], cfg.norm_eps)
+            old_a = jax.lax.dynamic_index_in_dim(act_acc, m_cl, 0, False)
+            keep = sel & (stage == n_p - 1)
+            act_acc = jax.lax.dynamic_update_index_in_dim(
+                act_acc, jnp.where(keep, xn, old_a), m_cl, 0)
+
+            state = jax.lax.ppermute(x, pipe_axis, perm)
+            return (state, ks_all, vs_all, act_acc), None
+
+        (state, ks_all, vs_all, act_acc), _ = jax.lax.scan(
+            step, (state, ks_all, vs_all, act_acc),
+            jnp.arange(M + n_stages - 1, dtype=jnp.int32),
+        )
+        # activations live only on the last stage (zeros elsewhere): one
+        # psum replicates them, then every stage applies the (replicated)
+        # head identically; K/V stay pipe-sharded on their layer axis
+        act = jax.lax.psum(act_acc, pipe_axis)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = jnp.einsum("mbtd,dv->mbtv", act, head,
+                            preferred_element_type=jnp.float32)
+        ks_out = ks_all.reshape(L_local, B, T, cfg.n_kv_heads, cfg.head_dim)
+        vs_out = vs_all.reshape(L_local, B, T, cfg.n_kv_heads, cfg.head_dim)
+        return logits.reshape(B, T, cfg.vocab_size), ks_out, vs_out
+
+    from jax.sharding import PartitionSpec as P_
+
+    sharded = shard_map(
+        stage_fwd,
+        mesh=mesh,
+        in_specs=(param_specs_pp(cfg, pipe_axis), P_(), P_()),
+        out_specs=(P_(), P_(pipe_axis), P_(pipe_axis)),
+    )
+    logits, ks, vs = sharded(params, tokens, positions)
+    return logits, (ks, vs)
+
+
 # ------------------------------------------- sequence-parallel long prefill
 
 

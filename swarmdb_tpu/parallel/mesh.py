@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-MESH_AXES = ("data", "model", "expert")
+MESH_AXES = ("data", "model", "expert", "pipe")
 
 
 def _divisor_leq(n: int, cap: int) -> int:
@@ -46,22 +46,30 @@ def plan_mesh_shape(
     max_expert: int = 8,
     want_model: Optional[int] = None,
     want_expert: Optional[int] = None,
+    want_pipe: Optional[int] = None,
 ) -> Dict[str, int]:
-    """Factor ``n_devices`` into {data, model, expert} axis sizes.
+    """Factor ``n_devices`` into {data, model, expert, pipe} axis sizes.
 
     Model (TP) degree is bounded by the smallest sharded weight dimension
     (n_kv_heads for the KV cache — 8 for every north-star model), expert
-    degree by n_experts (8 for Mixtral). Remaining factor goes to data
+    degree by n_experts (8 for Mixtral). Pipeline degree defaults to 1
+    (PP is opt-in: it must divide n_layers and pays bubble overhead, so
+    the planner never chooses it silently). Remaining factor goes to data
     (DP), which has no divisibility ceiling — it is the partition axis.
     """
-    model = want_model if want_model else _divisor_leq(n_devices, max_model)
-    rest = n_devices // model
-    if n_devices % model:
-        raise ValueError(f"model axis {model} does not divide {n_devices}")
+    pipe = want_pipe if want_pipe else 1
+    if n_devices % pipe:
+        raise ValueError(f"pipe axis {pipe} does not divide {n_devices}")
+    rest = n_devices // pipe
+    model = want_model if want_model else _divisor_leq(rest, max_model)
+    if rest % model:
+        raise ValueError(f"model axis {model} does not divide {rest}")
+    rest //= model
     expert = want_expert if want_expert else _divisor_leq(rest, max_expert)
     if rest % expert:
         raise ValueError(f"expert axis {expert} does not divide {rest}")
-    return {"data": rest // expert, "model": model, "expert": expert}
+    return {"data": rest // expert, "model": model, "expert": expert,
+            "pipe": pipe}
 
 
 def make_mesh(
@@ -70,12 +78,15 @@ def make_mesh(
     data: Optional[int] = None,
     model: Optional[int] = None,
     expert: Optional[int] = None,
+    pipe: Optional[int] = None,
     devices: Optional[Sequence[Any]] = None,
 ) -> Mesh:
-    """Build a named 3-axis mesh over the available devices.
+    """Build a named 4-axis ('data','model','expert','pipe') mesh over the
+    available devices.
 
     With explicit axis sizes they are used verbatim (their product must
-    equal the device count); otherwise `plan_mesh_shape` factorizes.
+    equal the device count); otherwise `plan_mesh_shape` factorizes (pipe
+    defaults to 1 — PP is opt-in).
     On multi-host deployments call `jax.distributed.initialize()` first;
     `jax.devices()` then spans all hosts and ICI/DCN placement is handled
     by `mesh_utils.create_device_mesh`.
@@ -86,9 +97,11 @@ def make_mesh(
             devices = devices[:n_devices]
     n = len(devices)
     if data and model and expert:
-        shape = {"data": data, "model": model, "expert": expert}
+        shape = {"data": data, "model": model, "expert": expert,
+                 "pipe": pipe or 1}
     else:
-        shape = plan_mesh_shape(n, want_model=model, want_expert=expert)
+        shape = plan_mesh_shape(n, want_model=model, want_expert=expert,
+                                want_pipe=pipe)
         if data is not None and shape["data"] != data:
             raise ValueError(f"requested data={data}, planned {shape}")
     sizes = tuple(shape[a] for a in MESH_AXES)

@@ -25,16 +25,16 @@ from swarmdb_tpu.parallel import (
 
 def test_plan_mesh_shape_factorizes():
     assert plan_mesh_shape(8, want_model=2, want_expert=2) == {
-        "data": 2, "model": 2, "expert": 2}
+        "data": 2, "model": 2, "expert": 2, "pipe": 1}
     shape = plan_mesh_shape(8, want_model=2, want_expert=1)
-    assert shape == {"data": 4, "model": 2, "expert": 1}
+    assert shape == {"data": 4, "model": 2, "expert": 1, "pipe": 1}
     with pytest.raises(ValueError):
         plan_mesh_shape(8, want_model=3)
 
 
 def test_make_mesh_axes():
     mesh = make_mesh(8, data=2, model=2, expert=2)
-    assert dict(mesh.shape) == {"data": 2, "model": 2, "expert": 2}
+    assert dict(mesh.shape) == {"data": 2, "model": 2, "expert": 2, "pipe": 1}
     assert mesh.devices.size == 8
 
 
