@@ -269,9 +269,13 @@ def _mfu(extras: dict, tokens_per_sec: float,
     return round(total * extras["flops_per_token"] / peak, 5)
 
 
-def _run_window(db, seconds: float, pump, drain_grace: float = 2.0) -> dict:
+def _run_window(db, seconds: float, pump, drain_grace: float = 2.0,
+                trace_dir=None) -> dict:
     """Warmup until the pipeline produces completions, then measure a
-    steady-state window. `pump(stop_at)` keeps requests in flight."""
+    steady-state window. `pump(stop_at)` keeps requests in flight.
+    ``trace_dir`` captures a jax.profiler trace of ONLY the measured
+    window (SURVEY §5.1) — started after the warm phase so compiles and
+    cold steps don't bury the steady-state signal."""
     completed = db.metrics.counters["completed_messages"]
     tokens = db.metrics.counters["tokens_generated"]
     prompt_toks = db.metrics.counters["prompt_tokens"]
@@ -280,6 +284,20 @@ def _run_window(db, seconds: float, pump, drain_grace: float = 2.0) -> dict:
     while completed.value < warm_target and time.time() < warm_deadline:
         pump(time.time() + 1.0)
 
+    if trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+    try:
+        return _measure_window(db, seconds, pump, drain_grace,
+                               completed, tokens, prompt_toks)
+    finally:
+        if trace_dir:
+            jax.profiler.stop_trace()
+
+
+def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
+                    prompt_toks) -> dict:
     c0, k0, pt0 = completed.value, tokens.value, prompt_toks.value
     sent0 = pump.sent
     t0 = time.time()
@@ -353,10 +371,9 @@ def _open_loop_window(db, send, rate: float, seconds: float) -> dict:
             sent += 1
         time.sleep(0.002)
     deadline = time.time() + 10.0
-    while len(hist._ring) < sent * 0.95 and time.time() < deadline:
+    while hist.count() < sent * 0.95 and time.time() < deadline:
         time.sleep(0.05)
-    with hist._lock:
-        fresh = sorted(hist._ring)
+    fresh = hist.values()
     if not fresh:
         return {"arrival_rate_per_s": round(rate, 2), "sent": sent}
 
@@ -395,8 +412,11 @@ def bench_serve(seconds: float) -> dict:
                             metadata=dict(gen_meta))
 
         pump = _make_pump(db, max_batch * 2, send)
-        window = _run_window(db, seconds, pump)
+        trace_dir = os.environ.get("SWARMDB_BENCH_TRACE_DIR")
+        window = _run_window(db, seconds, pump, trace_dir=trace_dir)
         extras = _device_extras(service, model)
+        if trace_dir:
+            extras["trace_dir"] = trace_dir
         # open-loop latency at ~half the measured closed-loop capacity
         rate = window["completed_per_sec"] * 0.5
         if rate > 0.2 and _env("SWARMDB_BENCH_OPENLOOP", 1, int) == 1:

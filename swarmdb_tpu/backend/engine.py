@@ -33,6 +33,7 @@ positions, cache)`` callable (Llama or Mixtral) plus cache constructors.
 
 from __future__ import annotations
 
+import functools
 import heapq
 import itertools
 import logging
@@ -250,8 +251,6 @@ class Engine:
             # never seen); rows 1..K = this chunk's samples
             all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
             return all_toks, last, cache
-
-        import functools
 
         self._decode = jax.jit(
             functools.partial(_decode, use_filters=True),
@@ -519,23 +518,10 @@ class Engine:
                     self.params, tokens, lengths, cacheB, keys,
                     zero_f, zero_i, ones_f,
                 )
-                from ..ops.paged_kv import paged_insert_prefill_donating
-
-                ps = self.paged.page_size
-                chunks = -(-bucket // ps)
-                pad_to = chunks * ps
-                ck, cv = cacheB
-                if pad_to != bucket:
-                    pad = [(0, 0), (0, 0), (0, pad_to - bucket), (0, 0), (0, 0)]
-                    ck = jnp.pad(ck, pad)
-                    cv = jnp.pad(cv, pad)
                 # target page 0 = the trash page (absorbs garbage writes)
-                new_k, new_v = paged_insert_prefill_donating(
-                    self.cache["k"], self.cache["v"], ck, cv,
-                    np.zeros((1, chunks), np.int32),
-                )
-                self.cache = {"k": new_k, "v": new_v,
-                              "page_table": self.cache["page_table"]}
+                chunks = -(-bucket // self.paged.page_size)
+                self._paged_insert(cacheB, np.zeros((1, chunks), np.int32),
+                                   bucket)
                 self._last_tokens = self._set_last_tokens(
                     self._last_tokens, np.zeros(1, np.int64), next_toks[:1]
                 )
@@ -841,20 +827,29 @@ class Engine:
             self._topp[gather],
         )
         slot_ids = gather[:n]
-        from ..ops.paged_kv import paged_insert_prefill_donating
-
-        ps = self.paged.page_size
-        chunks = -(-bucket // ps)
-        # pad the bucket to a page multiple so chunks tile exactly; the
-        # pad region is prompt padding (never read — length-masked)
-        pad_to = chunks * ps
         # slot rows allocated fewer pages than the bucket (short prompt
         # in a big bucket) route the all-padding chunks to trash page 0
+        chunks = -(-bucket // self.paged.page_size)
         target = np.zeros((n, chunks), np.int32)
         for row, sid in enumerate(slot_ids):
             pages = self.paged.allocator.pages_for(int(sid))
             m = min(len(pages), chunks)
             target[row, :m] = pages[:m]
+        self._paged_insert(cacheB, target, bucket)
+        self._last_tokens = self._set_last_tokens(
+            self._last_tokens, slot_ids, next_toks[:n]
+        )
+        self._activate(batch, t0)
+
+    def _paged_insert(self, cacheB, target: np.ndarray, bucket: int) -> None:
+        """Scatter a dense bucket-shaped prefill cache into the page pool
+        rows named by ``target`` (shared by admission and warmup)."""
+        from ..ops.paged_kv import paged_insert_prefill_donating
+
+        ps = self.paged.page_size
+        # pad the bucket to a page multiple so chunks tile exactly; the
+        # pad region is prompt padding (never read — length-masked)
+        pad_to = -(-bucket // ps) * ps
         ck, cv = cacheB
         if pad_to != bucket:
             pad = [(0, 0), (0, 0), (0, pad_to - bucket), (0, 0), (0, 0)]
@@ -865,10 +860,6 @@ class Engine:
         )
         self.cache = {"k": new_k, "v": new_v,
                       "page_table": self.cache["page_table"]}
-        self._last_tokens = self._set_last_tokens(
-            self._last_tokens, slot_ids, next_toks[:n]
-        )
-        self._activate(batch, t0)
 
     def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:
         for slot_id, req in batch:

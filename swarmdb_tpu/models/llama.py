@@ -26,10 +26,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.layers import (
-    apply_rope,
     gqa_attention,
     gqa_attention_chunked,
     merge_chunk_kv,
+    qkv_proj,
     rms_norm,
     rope_cos_sin,
     swiglu,
@@ -170,14 +170,8 @@ def forward(
         lp, ck, cv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         B, T = h.shape[0], h.shape[1]
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-            B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
         ck, cv = write_kv_cache(ck, cv, k, v, positions)
         attn = gqa_attention(q, ck, cv, positions, window=cfg.sliding_window)
         attn_out = jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
@@ -235,14 +229,8 @@ def forward_chunked(
         lp, ck, cv, hk, hv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         B, T = h.shape[0], h.shape[1]
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-            B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
         hk = jax.lax.dynamic_update_slice(hk, k.astype(hk.dtype),
                                           (0, step, 0, 0))
         hv = jax.lax.dynamic_update_slice(hv, v.astype(hv.dtype),
@@ -305,14 +293,8 @@ def forward_paged(
         lp, kp, vp = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         B, T = h.shape[0], h.shape[1]
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-            B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
         kp, vp = paged_write_decode(kp, vp, k, v, positions, table)
         attn = paged_attention_dispatch(
             q, kp, vp, table, positions, window=cfg.sliding_window)
@@ -409,14 +391,8 @@ def forward_pipelined(
             def layer_step(x, layer):
                 h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
                 b, t = h.shape[0], h.shape[1]
-                q = jnp.einsum("btd,dh->bth", h, layer["wq"]).reshape(
-                    b, t, cfg.n_heads, cfg.head_dim)
-                k = jnp.einsum("btd,dh->bth", h, layer["wk"]).reshape(
-                    b, t, cfg.n_kv_heads, cfg.head_dim)
-                v = jnp.einsum("btd,dh->bth", h, layer["wv"]).reshape(
-                    b, t, cfg.n_kv_heads, cfg.head_dim)
-                q = apply_rope(q, cos, sin)
-                k = apply_rope(k, cos, sin)
+                q, k, v = qkv_proj(h, layer, cfg.n_heads, cfg.n_kv_heads,
+                                   cfg.head_dim, cos, sin)
                 attn = gqa_attention(q, k, v, pos, window=cfg.sliding_window)
                 x = x + jnp.einsum("bth,hd->btd", attn.reshape(b, t, -1),
                                    layer["wo"])
@@ -531,14 +507,8 @@ def forward_seq_parallel(
         def layer_step(x, lp):
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
             B, T = h.shape[0], h.shape[1]
-            q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-                B, T, cfg.n_heads, cfg.head_dim)
-            k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-                B, T, cfg.n_kv_heads, cfg.head_dim)
-            v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-                B, T, cfg.n_kv_heads, cfg.head_dim)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+            q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                               cfg.head_dim, cos, sin)
             attn = ring_attention(q, k, v, positions, positions, seq_axis,
                                   window=cfg.sliding_window)
             x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])

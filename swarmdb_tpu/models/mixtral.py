@@ -25,9 +25,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.layers import (
-    apply_rope,
     gqa_attention,
     gqa_attention_chunked,
+    qkv_proj,
     rms_norm,
     rope_cos_sin,
     write_kv_cache,
@@ -192,14 +192,8 @@ def forward(
         lp, ck, cv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         B, T = h.shape[0], h.shape[1]
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-            B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
         ck, cv = write_kv_cache(ck, cv, k, v, positions)
         attn = gqa_attention(q, ck, cv, positions, window=cfg.sliding_window)
         x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
@@ -252,14 +246,8 @@ def forward_chunked(
         lp, ck, cv, hk, hv = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         B, T = h.shape[0], h.shape[1]
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-            B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
         hk = jax.lax.dynamic_update_slice(hk, k.astype(hk.dtype),
                                           (0, step, 0, 0))
         hv = jax.lax.dynamic_update_slice(hv, v.astype(hv.dtype),
@@ -323,14 +311,8 @@ def forward_paged(
         lp, kp, vp = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         B, T = h.shape[0], h.shape[1]
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(
-            B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(
-            B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
         kp, vp = paged_write_decode(kp, vp, k, v, positions, table)
         attn = paged_attention_dispatch(
             q, kp, vp, table, positions, window=cfg.sliding_window)

@@ -133,6 +133,25 @@ def apply_rope(
     return out.astype(x.dtype)
 
 
+def qkv_proj(
+    h: jnp.ndarray,       # [B, T, D] normed hidden states
+    lp: dict,             # layer params with "wq"/"wk"/"wv"
+    n_heads: int,
+    n_kv_heads: int,
+    head_dim: int,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Q/K/V projections + head split + RoPE — the block every forward
+    variant (dense, chunked, paged, seq-parallel, pipelined; Llama and
+    Mixtral alike) starts its attention with."""
+    B, T = h.shape[0], h.shape[1]
+    q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, n_heads, head_dim)
+    k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, n_kv_heads, head_dim)
+    v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, n_kv_heads, head_dim)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
 def swiglu(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
            w_down: jnp.ndarray) -> jnp.ndarray:
     """SwiGLU MLP: silu(x @ gate) * (x @ up) @ down."""

@@ -76,6 +76,15 @@ class LatencyHistogram:
         with self._lock:
             self._ring.append(seconds)
 
+    def values(self) -> list:
+        """Sorted copy of the current sample window (bench reporting)."""
+        with self._lock:
+            return sorted(self._ring)
+
+    def count(self) -> int:
+        """O(1) sample count (len() of a deque is constant-time)."""
+        return len(self._ring)
+
     def percentile(self, q: float) -> Optional[float]:
         with self._lock:
             if not self._ring:

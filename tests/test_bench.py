@@ -82,3 +82,26 @@ def test_failing_llm_mode_still_prints_line_with_echo_fallback():
     assert line["metric"] == "serve_error"
     assert "error" in line
     assert line.get("echo_fallback_msgs_per_sec", 0) > 0
+
+
+def test_serve_mode_end_to_end_cpu(monkeypatch):
+    """The full serve-mode harness (prewarm -> closed window -> open-loop
+    latency window) over the tiny model on CPU: contract fields present,
+    openloop TTFT measured from fresh samples."""
+    monkeypatch.setenv("SWARMDB_BENCH_MODEL", "tiny-debug")
+    monkeypatch.setenv("SWARMDB_BENCH_BATCH", "8")
+    monkeypatch.setenv("SWARMDB_BENCH_SEQ", "128")
+    monkeypatch.setenv("SWARMDB_BENCH_WARM_COMPLETIONS", "2")
+    monkeypatch.setenv("SWARMDB_BENCH_AGENTS", "8")
+    result = bench.bench_serve(seconds=3.0)
+    assert result["metric"] == "completed_messages_per_sec"
+    assert result["value"] > 0
+    assert result["prompt_tokens_per_sec"] > 0
+    assert result["kv_cache"] == "dense"
+    ol = result.get("openloop")
+    assert ol is not None and ol["p50_ttft_s"] > 0
+    # open-loop latency must not be queue-depth-dominated: with this tiny
+    # 3 s window the closed loop is barely saturated, so assert the same
+    # order of magnitude rather than strict ordering (which is marginal
+    # and flaky here; the real bench windows are 20 s+)
+    assert ol["p50_ttft_s"] <= result["p50_send_to_first_token_s"] * 2 + 0.1

@@ -266,3 +266,36 @@ def test_pipeline_depths_token_identical():
         finally:
             eng.stop()
     assert outs[1] == outs[2]
+
+
+def test_warmup_covers_all_variants():
+    """After Engine.warmup(), serving traffic must hit ZERO new compiles —
+    round 3's bench collapse was prompts graduating into uncompiled
+    buckets mid-window. Asserted via the jit caches' entry counts."""
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    eng = Engine(
+        lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+        lambda b, s: llama.init_kv_cache(cfg, b, s),
+        params, max_batch=4, max_seq=96, eos_id=-1, seed=0,
+        prefill_buckets=[16, 32, 64], decode_chunk=4,
+    )
+    eng.warmup()
+    pre_prefill = eng._prefill_fused._cache_size()
+    pre_decode = sum(d._cache_size() for d in eng._decode_variants)
+    # one variant per bucket (incl. the auto-appended max_seq-1 bucket)
+    assert pre_prefill == len(eng.prefill_buckets)
+    eng.start()
+    try:
+        # traffic across every bucket (length 10 -> 16, 30 -> 32, 60 -> 64)
+        # and both greedy + sampled populations
+        for n, temp in ((10, 0.0), (30, 0.7), (60, 0.0), (90, 0.0)):
+            toks, reason = eng.generate_sync(
+                list(range(1, n + 1)),
+                SamplingParams(max_new_tokens=3, temperature=temp),
+            )
+            assert reason in ("length", "eos")
+    finally:
+        eng.stop()
+    assert eng._prefill_fused._cache_size() == pre_prefill
+    assert sum(d._cache_size() for d in eng._decode_variants) == pre_decode
