@@ -200,13 +200,15 @@ class Engine:
         # the [B, V] sort is the most expensive op in a large-batch decode
         # step). _dispatch_decode picks per chunk from host-side slot state.
         # Two chunk-loop shapes:
-        # - chunked_fns (dense Llama/Mixtral): the big cache stays FROZEN
-        #   across the K steps; each step's K/V lands in a small [B, K, ...]
-        #   buffer (uniform dynamic_update_slice) and is folded into the
-        #   cache ONCE per chunk. Profiling on the v5e showed the per-step
-        #   full-cache rewrite of the old path cost ~2x the model matmuls.
-        # - fallback (paged / custom forwards): per-step cache threading.
-        self._chunked_fns = None if paged else chunked_fns
+        # - chunked_fns (dense AND paged; the caller supplies the matching
+        #   triple): the main cache stays FROZEN across the K steps; each
+        #   step's K/V lands in a small [B, K, ...] buffer (uniform
+        #   dynamic_update_slice) and is folded into the cache ONCE per
+        #   chunk — a full-cache rewrite (dense) or bulk page scatter
+        #   (paged) per chunk instead of per step. Profiling on the v5e
+        #   showed the per-step rewrite cost ~2x the model matmuls.
+        # - fallback (chunked_fns=None): per-step cache threading.
+        self._chunked_fns = chunked_fns
 
         def _decode(params, last_tokens, positions, cache, base_keys, temp,
                     topk, topp, *, use_filters, assume_greedy=False):

@@ -162,21 +162,30 @@ class ServingService:
             paged_fwd = lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c)
             init_pool_model = llama.init_paged_cache
             mod = llama
-        # two-segment chunked decode (dense cache only; the paged pool has
-        # its own write path) — see Engine._decode / ops.layers.
-        # SWARMDB_CHUNKED=0 falls back to per-step cache threading (escape
-        # hatch if a backend's compiler mishandles the chunked graph).
-        chunked_fns = None
-        if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
-            chunked_fns = (
-                lambda p, t, pos, c, hkv, s: mod.forward_chunked(
-                    p, cfg, t, pos, c, hkv, s),
-                lambda b, k: mod.init_chunk_kv(cfg, b, k),
-                mod.merge_chunk,
-            )
-
+        # two-segment chunked decode — the cache (dense slot buffer OR
+        # paged pool) stays frozen per chunk; see Engine._decode /
+        # ops.layers. SWARMDB_CHUNKED=0 falls back to per-step cache
+        # threading (escape hatch if a backend's compiler mishandles the
+        # chunked graph).
         if paged is None:
             paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
+        chunked_fns = None
+        if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
+            if paged:
+                chunked_fns = (
+                    lambda p, t, pos, c, hkv, s: mod.forward_paged_chunked(
+                        p, cfg, t, pos, c, hkv, s),
+                    lambda b, k: mod.init_chunk_kv(cfg, b, k),
+                    mod.merge_paged_chunk,
+                )
+            else:
+                chunked_fns = (
+                    lambda p, t, pos, c, hkv, s: mod.forward_chunked(
+                        p, cfg, t, pos, c, hkv, s),
+                    lambda b, k: mod.init_chunk_kv(cfg, b, k),
+                    mod.merge_chunk,
+                )
+
         paged_spec = None
         if paged:
             from ..ops.paged_kv import PageAllocator, pages_per_slot

@@ -315,6 +315,73 @@ def forward_paged(
     return logits, {"k": new_k, "v": new_v, "page_table": table}
 
 
+def forward_paged_chunked(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, 1]
+    positions: jnp.ndarray,    # [B, 1]
+    cache,                     # {"k","v","page_table"} — FROZEN this chunk
+    chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],  # [L, B, Kc, Hkv, D] each
+    step: jnp.ndarray,         # scalar int32
+):
+    """Two-segment chunked decode over the PAGED pool: the pool stays
+    frozen for the chunk's K steps (one bulk page write per chunk via
+    ``merge_paged_chunk``), this step's K/V lands in the chunk buffer,
+    and attention spans live pages + chunk buffer under one softmax
+    (ops/layers.paged_attention_dispatch_chunked)."""
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral")
+    from ..ops.layers import paged_attention_dispatch_chunked
+
+    x = params["embed"][tokens]
+    table = cache["page_table"]
+    chunk_k, chunk_v = chunk_kv
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(x, scanned):
+        lp, kp, vp, hk, hv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
+        hk = jax.lax.dynamic_update_slice(hk, k.astype(hk.dtype),
+                                          (0, step, 0, 0))
+        hv = jax.lax.dynamic_update_slice(hv, v.astype(hv.dtype),
+                                          (0, step, 0, 0))
+        attn = paged_attention_dispatch_chunked(
+            q, kp, vp, table, hk, hv, positions, step,
+            window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (hk, hv)
+
+    x, (new_hk, new_hv) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], cache["k"], cache["v"], chunk_k, chunk_v),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, (new_hk, new_hv)
+
+
+def merge_paged_chunk(cache, chunk_kv, start_positions: jnp.ndarray):
+    """Fold a finished chunk's K/V into the page pool — one bulk write
+    (ops/paged_kv.paged_write_chunk)."""
+    from ..ops.paged_kv import paged_write_chunk
+
+    hk, hv = chunk_kv
+    new_k, new_v = paged_write_chunk(
+        cache["k"], cache["v"], hk, hv, start_positions,
+        cache["page_table"],
+    )
+    return {"k": new_k, "v": new_v, "page_table": cache["page_table"]}
+
+
 # ----------------------------------------------------- pipeline parallelism
 
 

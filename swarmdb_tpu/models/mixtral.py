@@ -221,7 +221,63 @@ def forward(
 
 # chunk-KV helpers are attention-side and identical across families —
 # shared with the dense stack (one definition, review finding r4)
-from .llama import init_chunk_kv, merge_chunk  # noqa: E402, F401
+from .llama import (  # noqa: E402, F401
+    init_chunk_kv,
+    merge_chunk,
+    merge_paged_chunk,
+)
+
+
+def forward_paged_chunked(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, 1]
+    positions: jnp.ndarray,    # [B, 1]
+    cache,                     # {"k","v","page_table"} — FROZEN this chunk
+    chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],
+    step: jnp.ndarray,
+):
+    """Two-segment chunked decode over the paged pool (see
+    ``llama.forward_paged_chunked``); MoE FFN unchanged."""
+    if not cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is dense; use models.llama")
+    from ..ops.layers import paged_attention_dispatch_chunked
+
+    x = params["embed"][tokens]
+    table = cache["page_table"]
+    chunk_k, chunk_v = chunk_kv
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer_step(x, scanned):
+        lp, kp, vp, hk, hv = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, T = h.shape[0], h.shape[1]
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
+        hk = jax.lax.dynamic_update_slice(hk, k.astype(hk.dtype),
+                                          (0, step, 0, 0))
+        hv = jax.lax.dynamic_update_slice(hv, v.astype(hv.dtype),
+                                          (0, step, 0, 0))
+        attn = paged_attention_dispatch_chunked(
+            q, kp, vp, table, hk, hv, positions, step,
+            window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        moe_out, _load = moe_block(
+            h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.experts_per_token,
+        )
+        x = x + moe_out
+        return x, (hk, hv)
+
+    x, (new_hk, new_hv) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], cache["k"], cache["v"], chunk_k, chunk_v),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, (new_hk, new_hv)
 
 
 def forward_chunked(

@@ -176,6 +176,146 @@ def _paged_attn_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
+def _paged_chunk_attn_kernel(table_ref, start_ref, step_ref, q_ref, k_ref,
+                             v_ref, ck_ref, cv_ref, o_ref, acc_ref, m_ref,
+                             l_ref, *, page_size: int, window):
+    """Ragged paged attention + in-chunk segment under ONE online softmax.
+
+    Grid (B, Hkv, maxp+1): iterations j < maxp stream the slot's live
+    pages (the FROZEN prefix, valid strictly below the chunk start);
+    iteration j == maxp processes the [Kc] chunk buffer (entries 0..step)
+    and finalizes. The page loop's DMA skipping (dead iterations re-point
+    at the last live page) is unchanged from `_paged_attn_kernel`.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    maxp = pl.num_programs(2) - 1
+    start = start_ref[b]              # frozen prefix length = chunk start
+    step = step_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _merge(s, v):
+        # s [G, Tk] masked scores; v [Tk, D]
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    q = q_ref[0, 0].astype(jnp.float32)                # [G, D]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    @pl.when((j < maxp) & (j * page_size < start))
+    def _pages():
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [ps, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                      # [G, ps]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < start
+        if window is not None:
+            valid &= pos > (start + step - window)
+        _merge(jnp.where(valid, s, -1e30), v)
+
+    @pl.when(j == maxp)
+    def _chunk():
+        ck = ck_ref[0, :, 0, :].astype(jnp.float32)    # [Kc, D]
+        cv = cv_ref[0, :, 0, :].astype(jnp.float32)
+        Kc = ck.shape[0]
+        s = jax.lax.dot_general(
+            q, ck, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                      # [G, Kc]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, Kc), 1)
+        valid = idx <= step
+        if window is not None:
+            valid &= (start + idx) > (start + step - window)
+        _merge(jnp.where(valid, s, -1e30), cv)
+
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def paged_decode_gqa_attention_chunked(
+    q: jnp.ndarray,           # [B, Hq, D] one decode query per slot
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] FROZEN single-layer pool
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp] int32
+    chunk_k: jnp.ndarray,     # [B, Kc, Hkv, D] chunk buffer
+    chunk_v: jnp.ndarray,
+    starts: jnp.ndarray,      # [B] int32 frozen prefix length (chunk start)
+    step: jnp.ndarray,        # scalar int32 current step within the chunk
+    window=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Two-segment ragged paged decode attention; returns [B, Hq, D]."""
+    B, Hq, D = q.shape
+    _, ps, Hkv, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    G = Hq // Hkv
+    qg = q.reshape(B, Hkv, G, D)
+    table = page_table.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    step_arr = jnp.reshape(step, (1,)).astype(jnp.int32)
+
+    def q_map(b, h, j, table_ref, start_ref, step_ref):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, j, table_ref, start_ref, step_ref):
+        # dead/trailing iterations re-point at the last live page so their
+        # DMA is skipped; empty prefix -> table[b, 0]
+        last_live = jnp.maximum((start_ref[b] - 1) // ps, 0)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, h, 0)
+
+    def chunk_map(b, h, j, table_ref, start_ref, step_ref):
+        return (b, 0, h, 0)
+
+    def o_map(b, h, j, table_ref, start_ref, step_ref):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Hkv, maxp + 1),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), q_map),
+            pl.BlockSpec((1, ps, 1, D), kv_map),
+            pl.BlockSpec((1, ps, 1, D), kv_map),
+            pl.BlockSpec((1, chunk_k.shape[1], 1, D), chunk_map),
+            pl.BlockSpec((1, chunk_k.shape[1], 1, D), chunk_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),    # acc
+            pltpu.VMEM((G, 128), jnp.float32),  # running max (broadcast)
+            pltpu.VMEM((G, 128), jnp.float32),  # running denom (broadcast)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_chunk_attn_kernel, page_size=ps,
+                          window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=interpret,
+    )(table, starts, step_arr, qg, k_pages, v_pages, chunk_k, chunk_v)
+    return out.reshape(B, Hq, D)
+
+
 @functools.partial(
     jax.jit, static_argnames=("window", "interpret")
 )

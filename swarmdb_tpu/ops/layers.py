@@ -93,6 +93,46 @@ def paged_attention_dispatch(
     return gqa_attention(q, kg, vg, q_positions, window=window)
 
 
+def paged_attention_dispatch_chunked(
+    q: jnp.ndarray,           # [B, 1, Hq, D] decode query
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] single-layer pool (FROZEN)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp]
+    chunk_k: jnp.ndarray,     # [B, Kc, Hkv, D] this chunk's K so far
+    chunk_v: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, 1]
+    step: jnp.ndarray,        # scalar int32
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Two-segment decode attention for the PAGED cache: frozen page pool
+    + in-chunk buffer under one softmax (the paged counterpart of
+    ``gqa_attention_chunked``; the pool is only written once per chunk via
+    ``ops.paged_kv.paged_write_chunk``).
+
+    Ragged Pallas kernel on TPU (reads only live pages + the chunk
+    buffer); XLA page-gather fallback elsewhere — the fallback reuses
+    ``gqa_attention_chunked`` directly on the gathered dense view, whose
+    frozen-segment mask (kv_pos < chunk start) already expresses "pool
+    holds strictly the prefix".
+    """
+    if _paged_pallas_enabled():
+        from .attention_pallas import paged_decode_gqa_attention_chunked
+
+        starts = (q_positions[:, 0] - step).astype(jnp.int32)
+        out = paged_decode_gqa_attention_chunked(
+            q[:, 0], k_pages, v_pages, page_table, chunk_k, chunk_v,
+            starts, step.astype(jnp.int32),
+            window=window, interpret=jax.default_backend() != "tpu",
+        )
+        return out[:, None]
+    from .paged_kv import paged_gather_kv
+
+    kg, vg = paged_gather_kv(k_pages, v_pages, page_table)
+    return gqa_attention_chunked(q, kg, vg, chunk_k, chunk_v, q_positions,
+                                 step, window=window)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     """RMSNorm with fp32 statistics, output in x.dtype."""
     x32 = x.astype(jnp.float32)

@@ -150,6 +150,40 @@ paged_insert_prefill_donating = jax.jit(
 )
 
 
+def paged_write_chunk(
+    k_pages: jnp.ndarray,    # [L, P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    chunk_k: jnp.ndarray,    # [L, B, Kc, Hkv, D] a finished decode chunk
+    chunk_v: jnp.ndarray,
+    start_positions: jnp.ndarray,  # [B] absolute position of chunk step 0
+    page_table: jnp.ndarray,       # [B, maxp]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold a finished decode chunk's K/V into the page pool — ONE bulk
+    scatter per chunk instead of one per step (the paged counterpart of
+    ops/layers.merge_chunk_kv).
+
+    Same trash-page invariants as :func:`paged_write_decode`: positions
+    past the table's coverage and rows with zeroed (retired/inactive)
+    table entries land in trash page 0 and are never read.
+    """
+    L = k_pages.shape[0]
+    ps = k_pages.shape[2]
+    B, maxp = page_table.shape
+    Kc = chunk_k.shape[2]
+    pos = start_positions[:, None] + jnp.arange(Kc, dtype=jnp.int32)[None, :]
+    col = jnp.minimum(pos // ps, maxp - 1)
+    page = jnp.take_along_axis(page_table, col, axis=1)   # [B, Kc]
+    page = jnp.where(pos < maxp * ps, page, 0)            # overshoot -> trash
+    off = pos % ps
+    pf, of = page.reshape(-1), off.reshape(-1)            # [B*Kc]
+    tail = chunk_k.shape[3:]
+    kc = chunk_k.reshape((L, B * Kc) + tail)
+    vc = chunk_v.reshape((L, B * Kc) + tail)
+    k_pages = k_pages.at[:, pf, of].set(kc.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, pf, of].set(vc.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
 @jax.jit
 def set_page_table_rows(
     page_table: jnp.ndarray, rows: jnp.ndarray, values: jnp.ndarray

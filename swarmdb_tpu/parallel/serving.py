@@ -182,8 +182,12 @@ def build_serving_engine(
     sm = build_sharded_model(model_name_or_cfg, mesh, seed=seed)
     if max_batch is None:
         max_batch = 8 * sm.data_size
-    # same escape hatch the single-chip path honors (backend/service.py)
-    if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
+    # same escape hatch the single-chip path honors (backend/service.py).
+    # Never inject the DENSE sharded triple alongside a paged cache: the
+    # chunked forward must match the cache layout (a caller wiring paged
+    # here supplies its own triple or gets the per-step paged fallback).
+    if (os.environ.get("SWARMDB_CHUNKED", "1") != "0"
+            and engine_kwargs.get("paged") is None):
         engine_kwargs.setdefault("chunked_fns", sm.chunked_fns)
     engine = Engine(
         sm.forward_fn,

@@ -306,3 +306,169 @@ def test_engine_paged_oversized_request_rejected():
     # a small request still fits
     eng.submit(GenRequest(prompt=[1, 2, 3],
                           sampling=SamplingParams(max_new_tokens=8)))
+
+
+# ---------------------------------------------------------------------------
+# chunked paged decode: two-segment attention + bulk page writes
+
+
+def test_paged_write_chunk_matches_per_step():
+    """One bulk chunk write must land tokens exactly where K sequential
+    paged_write_decode calls would (incl. trash routing for overshoot)."""
+    from swarmdb_tpu.ops.paged_kv import paged_write_chunk
+
+    rng = np.random.default_rng(0)
+    L, P, ps, H, D = 2, 6, 4, 2, 8
+    B, Kc = 3, 4
+    maxp = 3
+    table = jnp.asarray([[1, 2, 3], [4, 5, 0], [0, 0, 0]], jnp.int32)
+    starts = jnp.asarray([2, 9, 0], jnp.int32)  # row1 overshoots (cap 12)
+    chunk_k = jnp.asarray(rng.normal(size=(L, B, Kc, H, D)), jnp.float32)
+    chunk_v = jnp.asarray(rng.normal(size=(L, B, Kc, H, D)), jnp.float32)
+
+    pool_k = jnp.zeros((L, P, ps, H, D), jnp.float32)
+    pool_v = jnp.zeros((L, P, ps, H, D), jnp.float32)
+    bk, bv = paged_write_chunk(pool_k, pool_v, chunk_k, chunk_v, starts,
+                               table)
+
+    sk, sv = pool_k, pool_v
+    for step in range(Kc):
+        pos = (starts + step)[:, None]
+        for layer in range(L):
+            lk, lv = paged_write_decode(
+                sk[layer], sv[layer],
+                chunk_k[layer, :, step][:, None],
+                chunk_v[layer, :, step][:, None],
+                pos, table,
+            )
+            sk = sk.at[layer].set(lk)
+            sv = sv.at[layer].set(lv)
+    # live pages must match exactly; trash page 0 is garbage on both sides
+    np.testing.assert_allclose(np.asarray(bk[:, 1:]), np.asarray(sk[:, 1:]))
+    np.testing.assert_allclose(np.asarray(bv[:, 1:]), np.asarray(sv[:, 1:]))
+
+
+@pytest.mark.parametrize("window", [None, 7])
+def test_paged_chunked_kernel_matches_fallback(window):
+    """The two-segment ragged kernel (interpret mode) must agree with the
+    XLA gather fallback (gqa_attention_chunked over gathered pages)."""
+    import os
+
+    from swarmdb_tpu.ops.layers import paged_attention_dispatch_chunked
+
+    rng = np.random.default_rng(1)
+    ps, maxp, P = 4, 4, 10
+    B, Hq, Hkv, D = 3, 4, 2, 8
+    Kc = 4
+    table = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 9, 0]],
+                        jnp.int32)
+    starts = np.asarray([9, 5, 0], np.int32)   # row 2: empty prefix
+    step = jnp.asarray(2, jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, 1, Hq, D)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+    ck = jnp.asarray(rng.normal(size=(B, Kc, Hkv, D)), jnp.float32)
+    cv = jnp.asarray(rng.normal(size=(B, Kc, Hkv, D)), jnp.float32)
+    q_pos = jnp.asarray(starts[:, None] + int(step), jnp.int32)
+
+    prev = os.environ.get("SWARMDB_PALLAS")
+    try:
+        os.environ["SWARMDB_PALLAS"] = "0"   # force XLA fallback
+        ref = paged_attention_dispatch_chunked(
+            q, kp, vp, table, ck, cv, q_pos, step, window=window)
+        os.environ["SWARMDB_PALLAS"] = "1"   # force kernel (interpret)
+        out = paged_attention_dispatch_chunked(
+            q, kp, vp, table, ck, cv, q_pos, step, window=window)
+    finally:
+        if prev is None:
+            os.environ.pop("SWARMDB_PALLAS", None)
+        else:
+            os.environ["SWARMDB_PALLAS"] = prev
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def chunked_paged_engine():
+    """Engine over the paged pool WITH the two-segment chunked decode
+    (the ServingService default for paged mode)."""
+    from swarmdb_tpu.backend.engine import Engine, PagedKV
+    from swarmdb_tpu.ops.paged_kv import PageAllocator
+
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+    init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+    max_batch, max_seq, ps = 4, 96, 16
+    maxp = pages_per_slot(max_seq, ps)
+    num_pages = 1 + 2 * maxp
+    paged_spec = PagedKV(
+        decode_forward=lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c),
+        init_pool=lambda: llama.init_paged_cache(
+            cfg, max_batch, max_seq, num_pages, ps),
+        page_size=ps,
+        num_pages=num_pages,
+        allocator=PageAllocator(num_pages, ps, max_seq, max_batch),
+    )
+    chunked = (
+        lambda p, t, pos, c, hkv, s: llama.forward_paged_chunked(
+            p, cfg, t, pos, c, hkv, s),
+        lambda b, k: llama.init_chunk_kv(cfg, b, k),
+        llama.merge_paged_chunk,
+    )
+    eng = Engine(fwd, init_cache, params, max_batch=max_batch,
+                 max_seq=max_seq, eos_id=2, seed=0,
+                 prefill_buckets=[16, 32, 64], paged=paged_spec,
+                 chunked_fns=chunked, decode_chunk=4)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_paged_chunked_matches_dense(engines, chunked_paged_engine):
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    dense, _ = engines
+    prompts = [[1, 5, 9], [4, 4, 4, 4, 4, 4, 4, 4, 4], [7], [2, 3]]
+    for prompt in prompts:
+        td, rd = dense.generate_sync(prompt, SamplingParams(max_new_tokens=10))
+        tc, rc = chunked_paged_engine.generate_sync(
+            prompt, SamplingParams(max_new_tokens=10))
+        assert td == tc, (prompt, td, tc)
+        assert rd == rc
+
+
+def test_mixtral_paged_chunked_matches_paged():
+    """MoE paged chunked decode (the SWARMDB_PAGED=1 ServingService
+    default) must match the per-step paged forward step-for-step."""
+    from swarmdb_tpu.models import mixtral
+
+    cfg = TINY_MOE
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(2),
+                                 dtype=jnp.float32)
+    B, S, ps = 2, 32, 4
+    maxp = pages_per_slot(S, ps)
+    num_pages = 1 + B * maxp
+    pool = mixtral.init_paged_cache(cfg, B, S, num_pages, ps,
+                                    dtype=jnp.float32)
+    table = np.arange(1, 1 + B * maxp, dtype=np.int32).reshape(B, maxp)
+    pool["page_table"] = jnp.asarray(table)
+    pool2 = {k: v for k, v in pool.items()}
+
+    Kc = 4
+    starts = jnp.asarray([0, 0], jnp.int32)
+    chunk = (jnp.zeros((cfg.n_layers, B, Kc, cfg.n_kv_heads, cfg.head_dim),
+                       jnp.float32),) * 2
+    tok = jnp.asarray([[3], [9]], jnp.int32)
+    for step in range(Kc):
+        pos = jnp.full((B, 1), step, jnp.int32)
+        l_ref, pool = mixtral.forward_paged(params, cfg, tok, pos, pool)
+        l_chk, chunk = mixtral.forward_paged_chunked(
+            params, cfg, tok, pos, pool2, chunk, jnp.asarray(step, jnp.int32))
+        np.testing.assert_allclose(np.asarray(l_ref), np.asarray(l_chk),
+                                   rtol=1e-4, atol=1e-4)
+        tok = jnp.argmax(l_ref[:, -1], axis=-1).astype(jnp.int32)[:, None]
+    pool2 = mixtral.merge_paged_chunk(pool2, chunk, starts)
+    np.testing.assert_allclose(np.asarray(pool["k"][:, 1:]),
+                               np.asarray(pool2["k"][:, 1:]),
+                               rtol=1e-5, atol=1e-5)
