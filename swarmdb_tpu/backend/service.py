@@ -171,20 +171,14 @@ class ServingService:
             paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
         chunked_fns = None
         if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
-            if paged:
-                chunked_fns = (
-                    lambda p, t, pos, c, hkv, s: mod.forward_paged_chunked(
-                        p, cfg, t, pos, c, hkv, s),
-                    lambda b, k: mod.init_chunk_kv(cfg, b, k),
-                    mod.merge_paged_chunk,
-                )
-            else:
-                chunked_fns = (
-                    lambda p, t, pos, c, hkv, s: mod.forward_chunked(
-                        p, cfg, t, pos, c, hkv, s),
-                    lambda b, k: mod.init_chunk_kv(cfg, b, k),
-                    mod.merge_chunk,
-                )
+            chunk_fwd = mod.forward_paged_chunked if paged else mod.forward_chunked
+            merge = mod.merge_paged_chunk if paged else mod.merge_chunk
+            chunked_fns = (
+                lambda p, t, pos, c, hkv, s: chunk_fwd(p, cfg, t, pos, c,
+                                                       hkv, s),
+                lambda b, k: mod.init_chunk_kv(cfg, b, k),
+                merge,
+            )
 
         paged_spec = None
         if paged:

@@ -112,11 +112,16 @@ def decode_gqa_attention(
 # ---------------------------------------------------------------------------
 # Ragged PAGED decode attention (ops/paged_kv.py pool layout).
 #
-# Grid (B, Hkv, maxp) with the page axis innermost; the page TABLE and the
+# Grid (B, maxp) with the page axis innermost; the page TABLE and the
 # per-slot lengths ride as scalar-prefetch operands so each grid step's
 # BlockSpec index_map can pick the right physical page — the standard TPU
-# paged-attention pattern (PrefetchScalarGridSpec). Two properties give the
-# bandwidth win over the XLA gather path:
+# paged-attention pattern (PrefetchScalarGridSpec). Each iteration loads ONE
+# page across ALL kv heads ([1, ps, Hkv, D] — the Hkv axis may not be
+# sliced: Mosaic requires the last two block dims be (8, 128)-divisible or
+# whole, and a (…, 1, D) per-head block violates the sublane rule) and a
+# static unroll over the Hkv heads runs the online softmax per head, exactly
+# like the dense kernel above. Two properties give the bandwidth win over
+# the XLA gather path:
 #   1. dead iterations (j beyond the slot's live pages) remap to the SAME
 #      page as the last live step, and Pallas skips the DMA for a block
 #      whose indices didn't change — so HBM traffic is ~live pages, not
@@ -125,13 +130,53 @@ def decode_gqa_attention(
 #      (online softmax), so nothing but the output tile is written back.
 
 
+def _online_update(h, s, v, acc_ref, m_ref, l_ref):
+    """Fold one masked score tile ``s`` [G, Tk] + value tile ``v`` [Tk, D]
+    into head ``h``'s running online-softmax state (flash-attention
+    rescaling)."""
+    m_prev = m_ref[h][:, :1]                           # [G, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                    # rescale old state
+    p = jnp.exp(s - m_new)                             # [G, Tk]
+    l_new = l_ref[h][:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+    acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[h] = jnp.broadcast_to(m_new, m_ref[h].shape)
+    l_ref[h] = jnp.broadcast_to(l_new, l_ref[h].shape)
+
+
+def _attend_tile(q_ref, k_tile_ref, v_tile_ref, valid, n_kv_heads,
+                 acc_ref, m_ref, l_ref):
+    """One [Tk]-token KV tile against every head's query: per-kv-head MXU
+    dots (a batched einsum won't lower in Mosaic) folded into the online
+    softmax scratch. ``valid`` is the [1, Tk] position mask."""
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    G = Hq // n_kv_heads
+    q = q_ref[0].reshape(n_kv_heads, G, D).astype(jnp.float32)
+    k = k_tile_ref[0].astype(jnp.float32)              # [Tk, Hkv, D]
+    v = v_tile_ref[0].astype(jnp.float32)
+    scale = 1.0 / (D ** 0.5)
+    for h in range(n_kv_heads):
+        s = jax.lax.dot_general(
+            q[h], k[:, h, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                      # [G, Tk]
+        _online_update(h, jnp.where(valid, s, -1e30), v[:, h, :],
+                       acc_ref, m_ref, l_ref)
+
+
 def _paged_attn_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                        acc_ref, m_ref, l_ref, *, page_size: int,
-                       window):
+                       n_kv_heads: int, window):
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    maxp = pl.num_programs(2)
+    j = pl.program_id(1)
+    maxp = pl.num_programs(1)
     length = len_ref[b]
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = n_kv_heads
 
     @pl.when(j == 0)
     def _init():
@@ -141,57 +186,38 @@ def _paged_attn_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * page_size < length)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)            # [G, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [ps, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)      # [ps, D]
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                      # [G, ps]
-
         pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)              # [1, ps] global pos
         valid = pos < length
         if window is not None:
             valid &= pos > (length - 1 - window)
-        s = jnp.where(valid, s, -1e30)
-
-        m_prev = m_ref[:, :1]                          # [G, 1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)     # [G, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)                # rescale old state
-        p = jnp.exp(s - m_new)                         # [G, ps]
-        l_new = l_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        _attend_tile(q_ref, k_ref, v_ref, valid, Hkv, acc_ref, m_ref, l_ref)
 
     @pl.when(j == maxp - 1)
     def _finalize():
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)       # inactive slot: 0/eps
-        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)    # inactive slot: 0/eps
+        o_ref[0] = (acc_ref[...] / denom).reshape(Hq, D).astype(o_ref.dtype)
 
 
 def _paged_chunk_attn_kernel(table_ref, start_ref, step_ref, q_ref, k_ref,
                              v_ref, ck_ref, cv_ref, o_ref, acc_ref, m_ref,
-                             l_ref, *, page_size: int, window):
+                             l_ref, *, page_size: int, n_kv_heads: int,
+                             window):
     """Ragged paged attention + in-chunk segment under ONE online softmax.
 
-    Grid (B, Hkv, maxp+1): iterations j < maxp stream the slot's live
-    pages (the FROZEN prefix, valid strictly below the chunk start);
-    iteration j == maxp processes the [Kc] chunk buffer (entries 0..step)
-    and finalizes. The page loop's DMA skipping (dead iterations re-point
-    at the last live page) is unchanged from `_paged_attn_kernel`.
+    Grid (B, maxp+1): iterations j < maxp stream the slot's live pages
+    (the FROZEN prefix, valid strictly below the chunk start); iteration
+    j == maxp processes the [Kc] chunk buffer (entries 0..step) and
+    finalizes. The page loop's DMA skipping (dead iterations re-point at
+    the last live page) is unchanged from `_paged_attn_kernel`.
     """
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    maxp = pl.num_programs(2) - 1
+    j = pl.program_id(1)
+    maxp = pl.num_programs(1) - 1
     start = start_ref[b]              # frozen prefix length = chunk start
     step = step_ref[0]
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = n_kv_heads
 
     @pl.when(j == 0)
     def _init():
@@ -199,56 +225,33 @@ def _paged_chunk_attn_kernel(table_ref, start_ref, step_ref, q_ref, k_ref,
         m_ref[...] = jnp.full_like(m_ref, -1e30)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    def _merge(s, v):
-        # s [G, Tk] masked scores; v [Tk, D]
-        m_prev = m_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    q = q_ref[0, 0].astype(jnp.float32)                # [G, D]
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-
     @pl.when((j < maxp) & (j * page_size < start))
     def _pages():
-        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [ps, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                      # [G, ps]
         pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         valid = pos < start
         if window is not None:
             valid &= pos > (start + step - window)
-        _merge(jnp.where(valid, s, -1e30), v)
+        _attend_tile(q_ref, k_ref, v_ref, valid, Hkv, acc_ref, m_ref, l_ref)
 
     @pl.when(j == maxp)
     def _chunk():
-        ck = ck_ref[0, :, 0, :].astype(jnp.float32)    # [Kc, D]
-        cv = cv_ref[0, :, 0, :].astype(jnp.float32)
-        Kc = ck.shape[0]
-        s = jax.lax.dot_general(
-            q, ck, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                      # [G, Kc]
+        Kc = ck_ref.shape[1]
         idx = jax.lax.broadcasted_iota(jnp.int32, (1, Kc), 1)
         valid = idx <= step
         if window is not None:
             valid &= (start + idx) > (start + step - window)
-        _merge(jnp.where(valid, s, -1e30), cv)
+        _attend_tile(q_ref, ck_ref, cv_ref, valid, Hkv, acc_ref, m_ref, l_ref)
 
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).reshape(Hq, D).astype(o_ref.dtype)
+
+
+def _last_live_page(n, ps):
+    # (n - 1) // ps for n >= 1, clamped to 0 — via truncating lax.div on a
+    # guaranteed-nonnegative numerator: jnp's floor ``//`` expands into a
+    # sign/rem jaxpr that bloats the scalar-core index_map program
+    return jax.lax.div(jax.lax.max(n - 1, 0), jnp.int32(ps))
 
 
 @functools.partial(jax.jit, static_argnames=("window", "interpret"))
@@ -269,51 +272,50 @@ def paged_decode_gqa_attention_chunked(
     _, ps, Hkv, _ = k_pages.shape
     maxp = page_table.shape[1]
     G = Hq // Hkv
-    qg = q.reshape(B, Hkv, G, D)
     table = page_table.astype(jnp.int32)
     starts = starts.astype(jnp.int32)
     step_arr = jnp.reshape(step, (1,)).astype(jnp.int32)
 
-    def q_map(b, h, j, table_ref, start_ref, step_ref):
-        return (b, h, 0, 0)
+    def q_map(b, j, table_ref, start_ref, step_ref):
+        return (b, 0, 0)
 
-    def kv_map(b, h, j, table_ref, start_ref, step_ref):
+    def kv_map(b, j, table_ref, start_ref, step_ref):
         # dead/trailing iterations re-point at the last live page so their
         # DMA is skipped; empty prefix -> table[b, 0]
-        last_live = jnp.maximum((start_ref[b] - 1) // ps, 0)
-        return (table_ref[b, jnp.minimum(j, last_live)], 0, h, 0)
+        last_live = _last_live_page(start_ref[b], ps)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, 0, 0)
 
-    def chunk_map(b, h, j, table_ref, start_ref, step_ref):
-        return (b, 0, h, 0)
+    def chunk_map(b, j, table_ref, start_ref, step_ref):
+        return (b, 0, 0, 0)
 
-    def o_map(b, h, j, table_ref, start_ref, step_ref):
-        return (b, h, 0, 0)
+    def o_map(b, j, table_ref, start_ref, step_ref):
+        return (b, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, Hkv, maxp + 1),
+        grid=(B, maxp + 1),
         in_specs=[
-            pl.BlockSpec((1, 1, G, D), q_map),
-            pl.BlockSpec((1, ps, 1, D), kv_map),
-            pl.BlockSpec((1, ps, 1, D), kv_map),
-            pl.BlockSpec((1, chunk_k.shape[1], 1, D), chunk_map),
-            pl.BlockSpec((1, chunk_k.shape[1], 1, D), chunk_map),
+            pl.BlockSpec((1, Hq, D), q_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, chunk_k.shape[1], Hkv, D), chunk_map),
+            pl.BlockSpec((1, chunk_k.shape[1], Hkv, D), chunk_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, D), o_map),
+        out_specs=pl.BlockSpec((1, Hq, D), o_map),
         scratch_shapes=[
-            pltpu.VMEM((G, D), jnp.float32),    # acc
-            pltpu.VMEM((G, 128), jnp.float32),  # running max (broadcast)
-            pltpu.VMEM((G, 128), jnp.float32),  # running denom (broadcast)
+            pltpu.VMEM((Hkv, G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running max (bcast)
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running denom (bcast)
         ],
     )
     out = pl.pallas_call(
         functools.partial(_paged_chunk_attn_kernel, page_size=ps,
-                          window=window),
+                          n_kv_heads=Hkv, window=window),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=interpret,
-    )(table, starts, step_arr, qg, k_pages, v_pages, chunk_k, chunk_v)
-    return out.reshape(B, Hq, D)
+    )(table, starts, step_arr, q, k_pages, v_pages, chunk_k, chunk_v)
+    return out
 
 
 @functools.partial(
@@ -333,41 +335,41 @@ def paged_decode_gqa_attention(
     _, ps, Hkv, _ = k_pages.shape
     maxp = page_table.shape[1]
     G = Hq // Hkv
-    qg = q.reshape(B, Hkv, G, D)
     table = page_table.astype(jnp.int32)
     lengths = lengths.astype(jnp.int32)
 
-    def q_map(b, h, j, table_ref, len_ref):
-        return (b, h, 0, 0)
+    def q_map(b, j, table_ref, len_ref):
+        return (b, 0, 0)
 
-    def kv_map(b, h, j, table_ref, len_ref):
+    def kv_map(b, j, table_ref, len_ref):
         # dead iterations re-point at the last live page so their DMA is
         # skipped (same indices as the previous step); length 0 -> trash 0
-        last_live = jnp.maximum((len_ref[b] - 1) // ps, 0)
-        return (table_ref[b, jnp.minimum(j, last_live)], 0, h, 0)
+        last_live = _last_live_page(len_ref[b], ps)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, 0, 0)
 
-    def o_map(b, h, j, table_ref, len_ref):
-        return (b, h, 0, 0)
+    def o_map(b, j, table_ref, len_ref):
+        return (b, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, Hkv, maxp),
+        grid=(B, maxp),
         in_specs=[
-            pl.BlockSpec((1, 1, G, D), q_map),
-            pl.BlockSpec((1, ps, 1, D), kv_map),
-            pl.BlockSpec((1, ps, 1, D), kv_map),
+            pl.BlockSpec((1, Hq, D), q_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, D), o_map),
+        out_specs=pl.BlockSpec((1, Hq, D), o_map),
         scratch_shapes=[
-            pltpu.VMEM((G, D), jnp.float32),    # acc
-            pltpu.VMEM((G, 128), jnp.float32),  # running max (broadcast)
-            pltpu.VMEM((G, 128), jnp.float32),  # running denom (broadcast)
+            pltpu.VMEM((Hkv, G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running max (bcast)
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running denom (bcast)
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_attn_kernel, page_size=ps, window=window),
+        functools.partial(_paged_attn_kernel, page_size=ps, n_kv_heads=Hkv,
+                          window=window),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=interpret,
-    )(table, lengths, qg, k_pages, v_pages)
-    return out.reshape(B, Hq, D)
+    )(table, lengths, q, k_pages, v_pages)
+    return out
