@@ -192,6 +192,9 @@ def serving_stack(model: str, n_assistants: int, max_batch: int, max_seq: int,
         "SWARMDB_COMPILE_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     ))
+    # bench chips are dedicated: size the prefix pool at the full decode-
+    # cache footprint (the conservative library default is half that)
+    os.environ.setdefault("SWARMDB_PREFIX_TOKENS", str(max_batch * max_seq))
     with tempfile.TemporaryDirectory() as tmp:
         db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
                      autosave_interval=1e9, max_messages_per_file=10**9)
@@ -253,6 +256,14 @@ def _device_extras(service, model: str) -> dict:
         extras["kv_page_size"] = st["page_size"]
     else:
         extras["kv_cache"] = "dense"
+    if service.engine._prefix is not None:
+        ps = service.engine._prefix.stats()
+        extras["prefix_cache"] = {
+            k: ps[k] for k in ("cached_pages", "hit_tokens", "miss_tokens")
+        }
+        hit, miss = ps["hit_tokens"], ps["miss_tokens"]
+        if hit + miss:
+            extras["prefix_hit_rate"] = round(hit / (hit + miss), 4)
     return extras
 
 
@@ -298,7 +309,9 @@ def _run_window(db, seconds: float, pump, drain_grace: float = 2.0,
 
 def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
                     prompt_toks) -> dict:
-    c0, k0, pt0 = completed.value, tokens.value, prompt_toks.value
+    reused = db.metrics.counters["prefix_reused_tokens"]
+    c0, k0, pt0, r0 = (completed.value, tokens.value, prompt_toks.value,
+                       reused.value)
     sent0 = pump.sent
     t0 = time.time()
     pump(t0 + seconds)
@@ -308,7 +321,7 @@ def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
         time.sleep(0.05)
     elapsed = time.time() - t0
     p50 = db.metrics.latencies["send_to_first_token_s"].percentile(50)
-    return {
+    out = {
         "completed_per_sec": (completed.value - c0) / elapsed,
         "tokens_per_sec": (tokens.value - k0) / elapsed,
         "prompt_tokens_per_sec": round((prompt_toks.value - pt0) / elapsed, 1),
@@ -316,6 +329,15 @@ def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
         "window_s": round(elapsed, 2),
         "window_completed": completed.value - c0,
     }
+    if reused.value - r0:
+        # MFU must count COMPUTED tokens: prefix-cache hits skip their
+        # prefill FLOPs entirely (the KV is read back, not recomputed)
+        out["prompt_tokens_reused_per_sec"] = round(
+            (reused.value - r0) / elapsed, 1)
+        out["prompt_tokens_computed_per_sec"] = round(
+            out["prompt_tokens_per_sec"] - out["prompt_tokens_reused_per_sec"],
+            1)
+    return out
 
 
 def _make_pump(db, max_outstanding, make_message, completions_per_send=1):
@@ -443,7 +465,8 @@ def bench_serve(seconds: float) -> dict:
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
         "mfu": _mfu(extras, window["tokens_per_sec"],
-                    window.get("prompt_tokens_per_sec", 0.0)),
+                    window.get("prompt_tokens_computed_per_sec",
+                               window.get("prompt_tokens_per_sec", 0.0))),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -490,7 +513,8 @@ def bench_group(seconds: float) -> dict:
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
         "mfu": _mfu(extras, window["tokens_per_sec"],
-                    window.get("prompt_tokens_per_sec", 0.0)),
+                    window.get("prompt_tokens_computed_per_sec",
+                               window.get("prompt_tokens_per_sec", 0.0))),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -547,7 +571,8 @@ def bench_tooluse(seconds: float) -> dict:
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
         "mfu": _mfu(extras, window["tokens_per_sec"],
-                    window.get("prompt_tokens_per_sec", 0.0)),
+                    window.get("prompt_tokens_computed_per_sec",
+                               window.get("prompt_tokens_per_sec", 0.0))),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }
@@ -613,7 +638,8 @@ def bench_swarm100(seconds: float) -> dict:
         "new_tokens_per_reply": new_tokens,
         "tokens_per_sec": round(window["tokens_per_sec"], 1),
         "mfu": _mfu(extras, window["tokens_per_sec"],
-                    window.get("prompt_tokens_per_sec", 0.0)),
+                    window.get("prompt_tokens_computed_per_sec",
+                               window.get("prompt_tokens_per_sec", 0.0))),
         **{k: v for k, v in window.items() if k != "tokens_per_sec"},
         **extras,
     }

@@ -124,6 +124,9 @@ class Engine:
         prefill_batch: Optional[int] = None,
         chunked_fns: Optional[Tuple[Callable, Callable, Callable]] = None,
         pipeline_depth: int = 2,
+        prefix_fns: Optional[Tuple[Callable, Callable]] = None,
+        prefix_pages: int = 0,
+        prefix_page_size: int = 16,
     ) -> None:
         self.forward_fn = forward_fn
         self.params = params
@@ -280,26 +283,6 @@ class Engine:
             prefill_batch = 8
         self.prefill_batch = max(1, min(prefill_batch, max_batch))
 
-        def _prefill(params, tokens, lengths, cacheB, base_keys, temp, topk,
-                     topp):
-            # tokens [Bp, T] padded; lengths [Bp] true lengths. cacheB is
-            # sized [L, Bp, bucket, ...] — NOT max_seq — so the transient
-            # prefill memory scales with the prompt, not the decode window
-            # (review finding: a max_seq-sized temp cache per admission
-            # would transiently double the decode cache in HBM).
-            Bp, T = tokens.shape
-            positions = jnp.broadcast_to(
-                jnp.arange(T, dtype=jnp.int32)[None], (Bp, T)
-            )
-            logits, cacheB = self.forward_fn(params, tokens, positions, cacheB)
-            last = logits[jnp.arange(Bp), lengths - 1]  # [Bp, V]
-            next_tok = sample_tokens(
-                last, base_keys, lengths - 1, temp, topk, topp
-            )
-            return next_tok, cacheB
-
-        self._prefill = jax.jit(_prefill, donate_argnums=(3,))
-
         # ---- fused dense prefill: forward + sample + cache insert + fed-
         # token scatter in ONE compiled dispatch per admission group.
         # The round-3 bench collapse (BENCH_r03: 4.8 msg/s while the
@@ -333,12 +316,137 @@ class Engine:
 
         self._prefill_fused = jax.jit(_prefill_insert, donate_argnums=(4, 5))
 
-        # scatter prefill tokens into the device fed-token vector (async;
-        # paged admission path — the dense path folds this into the fused
-        # prefill above)
-        self._set_last_tokens = jax.jit(
-            lambda lt, idx, tok: lt.at[idx].set(tok), donate_argnums=(0,)
-        )
+        # ---- fused PAGED prefill: forward + sample + page scatter + fed-
+        # token scatter in ONE dispatch, pool-donating. The unfused path
+        # (temp-cache zeros + jitted prefill + eager pad + insert + token
+        # scatter) cost ~5 device round-trips per admission group; on the
+        # tunneled TPU that made paged prefill ~12x slower than the dense
+        # fused path (swarm100 r4: 3.4k vs 42k prompt tok/s).
+        def _prefill_paged_insert(params, tokens, lengths, target_pages,
+                                  slot_ids, k_pool, v_pool, last_tokens,
+                                  base_keys, temp, topk, topp):
+            # tokens [Bp, T]; target_pages [Bp, chunks] physical page ids
+            # (padding rows and short-prompt tail chunks -> trash page 0);
+            # slot_ids [Bp] fed-token scatter targets (padding -> max_batch,
+            # dropped).
+            Bp, T = tokens.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None], (Bp, T)
+            )
+            cacheB = self._prefill_cache_fn(Bp, T)
+            logits, cacheB = self.forward_fn(params, tokens, positions, cacheB)
+            last = logits[jnp.arange(Bp), lengths - 1]  # [Bp, V]
+            next_tok = sample_tokens(
+                last, base_keys, lengths - 1, temp, topk, topp
+            )
+            ck, cv = cacheB                             # [L, Bp, T, Hkv, D]
+            ps = self.paged.page_size
+            chunks = target_pages.shape[1]
+            pad_to = chunks * ps
+            if pad_to != T:
+                # pad region is prompt padding — length-masked, never read
+                pad = [(0, 0), (0, 0), (0, pad_to - T), (0, 0), (0, 0)]
+                ck = jnp.pad(ck, pad)
+                cv = jnp.pad(cv, pad)
+            L = ck.shape[0]
+            tail = ck.shape[3:]
+            kc = ck.reshape((L, Bp * chunks, ps) + tail)
+            vc = cv.reshape((L, Bp * chunks, ps) + tail)
+            flat = target_pages.reshape(-1)             # [Bp*chunks]
+            k_pool = k_pool.at[:, flat].set(kc.astype(k_pool.dtype))
+            v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
+            last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
+            return k_pool, v_pool, last_tokens
+
+        if paged is not None:
+            self._prefill_paged_fused = jax.jit(
+                _prefill_paged_insert, donate_argnums=(5, 6, 7)
+            )
+
+        # ---- automatic prefix caching (dense cache only) ------------------
+        # Chat serving re-prefills each conversation's WHOLE history every
+        # turn (prefill dominated decode ~15:1 on the round-4 serve
+        # profile). The prefix cache keeps page-aligned prompt KV in a
+        # side pool; admission reuses the longest cached prefix and
+        # prefills only the suffix. See ops/prefix_cache.py for the chain
+        # hashing + eviction-safety argument and models/llama.
+        # forward_prefix_lane for the ragged lane composition.
+        self._prefix = None
+        self._prefix_fns = prefix_fns
+        if prefix_fns is not None:
+            if paged is not None:
+                raise NotImplementedError(
+                    "prefix caching currently supports the dense cache "
+                    "path (the paged pool needs page pinning integration)"
+                )
+            if max_seq % prefix_page_size:
+                raise ValueError("max_seq must be a page-size multiple "
+                                 "for prefix caching")
+            from ..ops.prefix_cache import PrefixLRU
+
+            self._prefix_ps = prefix_page_size
+            self._prefix = PrefixLRU(max(2, prefix_pages), prefix_page_size)
+            lane_fwd, init_pool = prefix_fns
+            self._prefix_init_pool = init_pool
+            self._prefix_pool = init_pool(max(2, prefix_pages),
+                                          prefix_page_size)
+            maxp_lane = max_seq // prefix_page_size
+            # PP (prefix gather width) buckets: coarse set so compiled
+            # variant count stays |suffix buckets| x 3
+            self._prefix_pp_buckets = sorted({
+                max(1, maxp_lane // 4), max(1, maxp_lane // 2),
+                max(1, maxp_lane - 1),
+            })
+
+            def _prefill_prefix_insert(params, tokens, lengths, prefix_lens,
+                                       prefix_table, reg_cols, reg_pages,
+                                       slot_ids, cache, last_tokens, pool_k,
+                                       pool_v, base_keys, temp, topk, topp):
+                # tokens [Bp, T] SUFFIX tokens; prefix_table [Bp, PP] pool
+                # pages; reg_cols [Bp, RC] lane-page index to register
+                # (-1 = none); reg_pages [Bp, RC] target pool ids (0=trash)
+                Bp, T = tokens.shape
+                ps = self._prefix_ps
+                PP = prefix_table.shape[1]
+                lane_pages = min(PP + -(-T // ps), self.max_seq // ps)
+                logits, lane_k, lane_v = lane_fwd(
+                    params, tokens, prefix_table, prefix_lens, pool_k,
+                    pool_v, lane_pages,
+                )
+                last = logits[jnp.arange(Bp), lengths - 1]
+                # absolute position keys the PRNG fold => identical
+                # sampling to a full (non-cached) prefill of this prompt
+                next_tok = sample_tokens(
+                    last, base_keys, prefix_lens + lengths - 1, temp, topk,
+                    topp,
+                )
+                ck, cv = cache
+                lane_t = lane_pages * ps
+                ck = ck.at[:, slot_ids, :lane_t].set(lane_k, mode="drop")
+                cv = cv.at[:, slot_ids, :lane_t].set(lane_v, mode="drop")
+                # register: extract the named lane pages (one-hot einsum —
+                # per-row gathers don't compile well on TPU) into the pool
+                L = lane_k.shape[0]
+                RC = reg_cols.shape[1]
+                sel = (reg_cols[..., None]
+                       == jnp.arange(lane_pages)[None, None, :])
+                sel = sel.astype(lane_k.dtype)          # [Bp, RC, P_lane]
+                lk = lane_k.reshape(L, Bp, lane_pages, ps, *lane_k.shape[3:])
+                lv = lane_v.reshape(L, Bp, lane_pages, ps, *lane_v.shape[3:])
+                flat = reg_pages.reshape(-1)
+                ck_pages = jnp.einsum("brp,lbpshd->lbrshd", sel, lk)
+                cv_pages = jnp.einsum("brp,lbpshd->lbrshd", sel, lv)
+                pool_k = pool_k.at[:, flat].set(
+                    ck_pages.reshape(L, Bp * RC, ps, *lane_k.shape[3:]))
+                pool_v = pool_v.at[:, flat].set(
+                    cv_pages.reshape(L, Bp * RC, ps, *lane_v.shape[3:]))
+                last_tokens = last_tokens.at[slot_ids].set(next_tok,
+                                                           mode="drop")
+                return (ck, cv), last_tokens, pool_k, pool_v
+
+            self._prefill_prefix_fused = jax.jit(
+                _prefill_prefix_insert, donate_argnums=(8, 9, 10, 11)
+            )
 
         self.total_generated = 0
         self.total_requests = 0
@@ -406,6 +514,12 @@ class Engine:
                 "multi-host serving currently supports the dense cache "
                 "path only (the page allocator is coordinator-local)"
             )
+        if self._prefix is not None:
+            raise NotImplementedError(
+                "multi-host serving does not support prefix caching yet "
+                "(the prefix pool/table is coordinator-local); build the "
+                "engine with prefix_fns=None"
+            )
         from ..parallel.multihost import ControlPlane
 
         self._mh = ControlPlane(self.max_batch, self.prefill_batch)
@@ -464,6 +578,12 @@ class Engine:
         self._fail_all("engine_restart")
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
         self.cache = self._fresh_cache()
+        if self._prefix is not None:
+            # the pool was donated into the failed dispatch: rebuild it and
+            # forget every entry (they'd point at zeroed pages)
+            self._prefix_pool = self._prefix_init_pool(
+                self._prefix.num_pages, self._prefix_ps)
+            self._prefix.reset()
         self.metrics.counters["engine_restarts"].inc()
         self.start()
 
@@ -515,18 +635,18 @@ class Engine:
         for bucket in self.prefill_buckets:
             tokens = np.full((Bp, bucket), self.pad_id, np.int32)
             if self.paged:
-                cacheB = self._prefill_cache_fn(Bp, bucket)
-                next_toks, cacheB = self._prefill(
-                    self.params, tokens, lengths, cacheB, keys,
-                    zero_f, zero_i, ones_f,
-                )
-                # target page 0 = the trash page (absorbs garbage writes)
+                # target page 0 = the trash page (absorbs garbage writes);
+                # fed-token rows scatter to max_batch (dropped)
                 chunks = -(-bucket // self.paged.page_size)
-                self._paged_insert(cacheB, np.zeros((1, chunks), np.int32),
-                                   bucket)
-                self._last_tokens = self._set_last_tokens(
-                    self._last_tokens, np.zeros(1, np.int64), next_toks[:1]
+                drop = np.full(Bp, self.max_batch, np.int32)
+                k_pool, v_pool, self._last_tokens = self._prefill_paged_fused(
+                    self.params, tokens, lengths,
+                    np.zeros((Bp, chunks), np.int32), drop,
+                    self.cache["k"], self.cache["v"], self._last_tokens,
+                    keys, zero_f, zero_i, ones_f,
                 )
+                self.cache = {"k": k_pool, "v": v_pool,
+                              "page_table": self.cache["page_table"]}
             else:
                 drop = np.full(Bp, self.max_batch, np.int32)
                 if self._mh is not None:
@@ -536,6 +656,28 @@ class Engine:
                     self.params, tokens, lengths, drop, self.cache,
                     self._last_tokens, keys, zero_f, zero_i, ones_f,
                 )
+        if self._prefix is not None:
+            # prefix-prefill variants: one per (suffix bucket, PP width).
+            # Inputs are pure padding — trash-page gathers, drop-scattered
+            # rows, no registration (reg_cols all -1)
+            drop = np.full(Bp, self.max_batch, np.int32)
+            for bucket in self.prefill_buckets:
+                for ppb in self._prefix_pp_buckets:
+                    lane_pages = min(ppb + -(-bucket // self._prefix_ps),
+                                     self.max_seq // self._prefix_ps)
+                    tokens = np.full((Bp, bucket), self.pad_id, np.int32)
+                    pk, pv = self._prefix_pool
+                    self.cache, self._last_tokens, pk, pv = (
+                        self._prefill_prefix_fused(
+                            self.params, tokens, lengths,
+                            np.zeros(Bp, np.int32),
+                            np.zeros((Bp, ppb), np.int32),
+                            np.full((Bp, lane_pages), -1, np.int32),
+                            np.zeros((Bp, lane_pages), np.int32),
+                            drop, self.cache, self._last_tokens, pk, pv,
+                            keys, zero_f, zero_i, ones_f,
+                        ))
+                    self._prefix_pool = (pk, pv)
         jax.block_until_ready(self._last_tokens)
         dt = time.time() - t0
         self.metrics.latencies["warmup_s"].observe(dt)
@@ -713,26 +855,53 @@ class Engine:
                     np.asarray([r[0] for r in rows], np.int32),
                     np.stack([r[1] for r in rows]),
                 )
-            groups: Dict[int, List[Tuple[int, GenRequest]]] = {}
+            use_prefix = self._prefix is not None and self._mh is None
+            groups: Dict[Tuple[int, int], List[Tuple]] = {}
+            prefix_batch: List[Tuple] = []
+            max_suffix = max_hits = 0
             for slot_id, req in zip(free, popped):
-                groups.setdefault(self._bucket_for(len(req.prompt)), []).append(
-                    (slot_id, req)
-                )
-            for batch in groups.values():
+                if use_prefix and len(req.prompt) >= self._prefix_ps:
+                    # sub-page prompts (no hit possible, nothing to
+                    # register) stay on the plain path; everything else
+                    # goes through the prefix path even on a full miss so
+                    # its pages get REGISTERED for the next turn
+                    hits, chains = self._prefix_plan(req.prompt)
+                    suffix_len = len(req.prompt) - len(hits) * self._prefix_ps
+                    prefix_batch.append((slot_id, req, hits, chains))
+                    max_suffix = max(max_suffix, suffix_len)
+                    max_hits = max(max_hits, len(hits))
+                else:
+                    key = (self._bucket_for(len(req.prompt)), 0)
+                    groups.setdefault(key, []).append((slot_id, req))
+            if prefix_batch:
+                # ONE group per admission wave, padded to the wave's max
+                # (suffix bucket, prefix width): prefill cost is dominated
+                # by the weight read, so co-dispatching short-suffix rows
+                # with long ones is nearly free while per-(bucket, width)
+                # splitting multiplies whole-model HBM passes (measured:
+                # fragmentation cost more than prefix reuse saved)
+                key = (self._bucket_for(max(1, max_suffix)),
+                       self._pp_bucket_for(max(1, max_hits)))
+                groups[key] = prefix_batch
+            for (bucket, ppb), batch in groups.items():
                 try:
-                    self._prefill_batch(batch)
+                    if ppb > 0:
+                        self._prefill_prefix_batch(batch, bucket, ppb)
+                    else:
+                        self._prefill_batch(batch)
                 except Exception:
                     # the requests are already off the queue and not yet in
                     # slots: fail them here or their on_done would never fire
                     # (generate_sync / SSE streams would hang to the timeout)
                     logger.exception("prefill failed for %s",
-                                     [r.request_id for _, r in batch])
+                                     [item[1].request_id for item in batch])
                     if self._mh is not None:
                         # pod mode: the op may already be published (workers
                         # applied a prefill this coordinator didn't) —
                         # swallowing here would silently desynchronize the
                         # SPMD state; escalate to _run's pod-fatal handler
-                        for _sid, req in batch:
+                        for item in batch:
+                            req = item[1]
                             if req.on_done is not None:
                                 try:
                                     req.on_done(req.request_id, [],
@@ -740,7 +909,8 @@ class Engine:
                                 except Exception:
                                     pass
                         raise
-                    for slot_id, req in batch:
+                    for item in batch:
+                        slot_id, req = item[0], item[1]
                         if self.paged:
                             # release the slot's pages or the next occupant's
                             # allocate() raises "already holds pages" and the
@@ -757,6 +927,103 @@ class Engine:
             if n <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    # ------------------------------------------------------- prefix caching
+
+    def _pp_bucket_for(self, n: int) -> int:
+        """Smallest prefix-gather width bucket covering ``n`` hit pages."""
+        for b in self._prefix_pp_buckets:
+            if n <= b:
+                return b
+        return self._prefix_pp_buckets[-1]
+
+    def _prefix_plan(self, prompt: List[int]):
+        """Longest cached prefix for ``prompt`` -> (hit page ids, chain
+        hashes for every full prompt page). Hits are capped one page short
+        of the prompt so at least one suffix token remains to prefill
+        (the sampled first token needs logits)."""
+        from ..ops.prefix_cache import page_chains
+
+        ps = self._prefix_ps
+        n_full = len(prompt) // ps
+        chains = page_chains(prompt, ps, max_pages=n_full)
+        cap = n_full - 1 if n_full * ps == len(prompt) else n_full
+        cap = min(cap, self._prefix_pp_buckets[-1])
+        if cap <= 0:
+            return [], chains
+        hits = self._prefix.match(chains[:cap], prompt)
+        return hits, chains
+
+    def _prefill_prefix_batch(self, batch: List[Tuple], bucket: int,
+                              ppb: int) -> None:
+        """One fused suffix prefill for a group of admissions sharing a
+        (suffix bucket, prefix width) shape: gather reused prefix pages +
+        forward ONLY the suffix + compose/insert each row's KV lane +
+        register the prompt's fresh full pages — one dispatch, pool- and
+        cache-donating. Mirrors ``_prefill_batch``; see
+        ``_prefill_prefix_insert`` in ``__init__``."""
+        t0 = time.time()
+        ps = self._prefix_ps
+        Bp = self.prefill_batch
+        lane_pages = min(ppb + -(-bucket // ps), self.max_seq // ps)
+        RC = lane_pages
+        padded = np.full((Bp, bucket), self.pad_id, np.int32)
+        lengths = np.ones(Bp, np.int32)
+        plens = np.zeros(Bp, np.int32)
+        table = np.zeros((Bp, ppb), np.int32)
+        reg_cols = np.full((Bp, RC), -1, np.int32)
+        reg_pages = np.zeros((Bp, RC), np.int32)
+        gather = np.zeros(Bp, np.int64)
+        scatter = np.full(Bp, self.max_batch, np.int32)
+        reg_records = []
+        acquired: List[int] = []
+        for row, (slot_id, req, hits, chains) in enumerate(batch):
+            prompt = req.prompt
+            p0 = len(hits) * ps
+            suffix = prompt[p0:]
+            padded[row, : len(suffix)] = suffix
+            lengths[row] = len(suffix)
+            plens[row] = p0
+            table[row, : len(hits)] = hits
+            gather[row] = slot_id
+            scatter[row] = slot_id
+            s = req.sampling
+            self._temp[slot_id] = s.temperature
+            self._topk[slot_id] = s.top_k
+            self._topp[slot_id] = s.top_p
+            # register the prompt's fresh FULL pages (their lane content is
+            # final — decode writes start at len(prompt), past them)
+            n_full = len(prompt) // ps
+            new_idx = list(range(len(hits), n_full))
+            ids = self._prefix.acquire(len(new_idx)) if new_idx else []
+            acquired.extend(ids)
+            for r, (page_idx, pid) in enumerate(zip(new_idx, ids)):
+                reg_cols[row, r] = page_idx
+                reg_pages[row, r] = pid
+                reg_records.append(
+                    (chains[page_idx],
+                     tuple(prompt[page_idx * ps:(page_idx + 1) * ps]), pid))
+        pk, pv = self._prefix_pool
+        try:
+            self.cache, self._last_tokens, pk, pv = (
+                self._prefill_prefix_fused(
+                    self.params, padded, lengths, plens, table, reg_cols,
+                    reg_pages, scatter, self.cache, self._last_tokens,
+                    pk, pv,
+                    self._base_keys_np[gather],
+                    self._temp[gather],
+                    self._topk[gather],
+                    self._topp[gather],
+                ))
+        except Exception:
+            for pid in acquired:
+                self._prefix.release(pid)
+            raise
+        self._prefix_pool = (pk, pv)
+        for rec in reg_records:
+            self._prefix.register(*rec)
+        self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
+        self._activate([(s, r) for s, r, _, _ in batch], t0)
 
     def _prefill_batch(self, batch: List[Tuple[int, GenRequest]]) -> None:
         """One compiled prefill for up to ``prefill_batch`` admissions.
@@ -817,51 +1084,32 @@ class Engine:
             self._activate(batch, t0)
             return
 
-        cacheB = self._prefill_cache_fn(Bp, bucket)
-        next_toks, cacheB = self._prefill(
+        # slot rows allocated fewer pages than the bucket (short prompt
+        # in a big bucket) route the all-padding chunks to trash page 0;
+        # padding rows (beyond n) scatter entirely to trash
+        chunks = -(-bucket // self.paged.page_size)
+        target = np.zeros((Bp, chunks), np.int32)
+        for row in range(n):
+            pages = self.paged.allocator.pages_for(int(gather[row]))
+            m = min(len(pages), chunks)
+            target[row, :m] = pages[:m]
+        k_pool, v_pool, self._last_tokens = self._prefill_paged_fused(
             self.params,
             padded,                      # raw np: transfer rides the dispatch
             lengths,
-            cacheB,
+            target,
+            scatter,                     # padding rows -> max_batch, dropped
+            self.cache["k"],
+            self.cache["v"],
+            self._last_tokens,
             self._base_keys_np[gather],
             self._temp[gather],
             self._topk[gather],
             self._topp[gather],
         )
-        slot_ids = gather[:n]
-        # slot rows allocated fewer pages than the bucket (short prompt
-        # in a big bucket) route the all-padding chunks to trash page 0
-        chunks = -(-bucket // self.paged.page_size)
-        target = np.zeros((n, chunks), np.int32)
-        for row, sid in enumerate(slot_ids):
-            pages = self.paged.allocator.pages_for(int(sid))
-            m = min(len(pages), chunks)
-            target[row, :m] = pages[:m]
-        self._paged_insert(cacheB, target, bucket)
-        self._last_tokens = self._set_last_tokens(
-            self._last_tokens, slot_ids, next_toks[:n]
-        )
-        self._activate(batch, t0)
-
-    def _paged_insert(self, cacheB, target: np.ndarray, bucket: int) -> None:
-        """Scatter a dense bucket-shaped prefill cache into the page pool
-        rows named by ``target`` (shared by admission and warmup)."""
-        from ..ops.paged_kv import paged_insert_prefill_donating
-
-        ps = self.paged.page_size
-        # pad the bucket to a page multiple so chunks tile exactly; the
-        # pad region is prompt padding (never read — length-masked)
-        pad_to = -(-bucket // ps) * ps
-        ck, cv = cacheB
-        if pad_to != bucket:
-            pad = [(0, 0), (0, 0), (0, pad_to - bucket), (0, 0), (0, 0)]
-            ck = jnp.pad(ck, pad)
-            cv = jnp.pad(cv, pad)
-        new_k, new_v = paged_insert_prefill_donating(
-            self.cache["k"], self.cache["v"], ck, cv, target
-        )
-        self.cache = {"k": new_k, "v": new_v,
+        self.cache = {"k": k_pool, "v": v_pool,
                       "page_table": self.cache["page_table"]}
+        self._activate(batch, t0)
 
     def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:
         for slot_id, req in batch:
@@ -1012,7 +1260,7 @@ class Engine:
     # ------------------------------------------------------------------ info
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "active_slots": sum(1 for s in self.slots if s.active),
             "max_batch": self.max_batch,
             "queued": len(self._queue),
@@ -1025,3 +1273,6 @@ class Engine:
                 if k in self.metrics.latencies
             },
         }
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+        return out

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.layers import (
     gqa_attention,
     gqa_attention_chunked,
+    gqa_attention_prefix,
     merge_chunk_kv,
     qkv_proj,
     rms_norm,
@@ -189,6 +190,103 @@ def forward(
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
     return logits, (new_k, new_v)
+
+
+def forward_prefix_pages(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,        # [Bp, T] SUFFIX tokens (padded)
+    prefix_table: jnp.ndarray,  # [Bp, PP] int32 prefix-pool page ids
+    prefix_lens: jnp.ndarray,   # [Bp] int32 reused prefix length (tokens)
+    pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D] prefix page pool
+    pool_v: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefix-cache suffix prefill CORE: compute ONLY the suffix tokens,
+    attending each row's reused prefix pages + the suffix itself
+    (ops/layers.gqa_attention_prefix). Shared by the dense path (which
+    composes lane images via ops/layers.compose_prefix_lane) and the
+    paged path (which scatters the suffix straight into fresh pages).
+
+    Returns (fp32 logits [Bp, T, V], sfx_k, sfx_v [L, Bp, T, Hkv, D]).
+    """
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral")
+    Bp, T = tokens.shape
+    L, P = pool_k.shape[0], pool_k.shape[1]
+    ps = pool_k.shape[2]
+    PP = prefix_table.shape[1]
+    Pt = PP * ps
+    x = params["embed"][tokens]
+    positions = prefix_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    # one fused gather per layer: flatten (L, P) so layer index l and the
+    # page table combine into a single index array (a dynamic_slice of the
+    # pool followed by a page gather may or may not fuse; this form always
+    # reads only the needed pages)
+    pool_k_flat = pool_k.reshape((L * P,) + pool_k.shape[2:])
+    pool_v_flat = pool_v.reshape((L * P,) + pool_v.shape[2:])
+
+    def layer_step(x, scanned):
+        lp, l = scanned
+        kp = pool_k_flat[l * P + prefix_table].reshape(
+            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
+        vp = pool_v_flat[l * P + prefix_table].reshape(
+            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
+        attn = gqa_attention_prefix(q, kp, vp, k.astype(kp.dtype),
+                                    v.astype(vp.dtype), prefix_lens,
+                                    window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(Bp, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (k.astype(kp.dtype), v.astype(vp.dtype))
+
+    x, (sfx_k, sfx_v) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, sfx_k, sfx_v
+
+
+def forward_prefix_lane(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,        # [Bp, T] SUFFIX tokens (padded)
+    prefix_table: jnp.ndarray,  # [Bp, PP] int32 prefix-pool page ids
+    prefix_lens: jnp.ndarray,   # [Bp] int32 reused prefix length (tokens)
+    pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D] prefix page pool
+    pool_v: jnp.ndarray,
+    lane_pages: int,            # static: output lane length in pages
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dense-cache prefix prefill: ``forward_prefix_pages`` + per-row lane
+    composition (ops/layers.compose_prefix_lane) ready for one uniform
+    slot-cache insert. Returns (fp32 logits, lane_k, lane_v).
+    """
+    from ..ops.layers import compose_prefix_lane
+
+    logits, sfx_k, sfx_v = forward_prefix_pages(
+        params, cfg, tokens, prefix_table, prefix_lens, pool_k, pool_v)
+    lane_k, lane_v = compose_prefix_lane(
+        pool_k, pool_v, prefix_table, prefix_lens, sfx_k, sfx_v, lane_pages)
+    return logits, lane_k, lane_v
+
+
+def init_prefix_pool(
+    cfg: ModelConfig, num_pages: int, page_size: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Zeroed prefix-cache page pool (page 0 = trash)."""
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
 def init_chunk_kv(

@@ -219,13 +219,93 @@ def forward(
     return logits, (new_k, new_v)
 
 
-# chunk-KV helpers are attention-side and identical across families —
-# shared with the dense stack (one definition, review finding r4)
+# chunk-KV / prefix-pool helpers are attention-side and identical across
+# families — shared with the dense stack (one definition, review finding r4)
 from .llama import (  # noqa: E402, F401
     init_chunk_kv,
+    init_prefix_pool,
     merge_chunk,
     merge_paged_chunk,
 )
+
+
+def forward_prefix_pages(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,        # [Bp, T] SUFFIX tokens (padded)
+    prefix_table: jnp.ndarray,  # [Bp, PP] int32 prefix-pool page ids
+    prefix_lens: jnp.ndarray,   # [Bp] int32 reused prefix length (tokens)
+    pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D]
+    pool_v: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefix-cache suffix prefill core (see ``llama.forward_prefix_pages``
+    for the design); MoE FFN unchanged. Returns (fp32 logits, sfx_k,
+    sfx_v [L, Bp, T, Hkv, D])."""
+    if not cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is dense; use models.llama")
+    from ..ops.layers import gqa_attention_prefix
+
+    Bp, T = tokens.shape
+    L, P = pool_k.shape[0], pool_k.shape[1]
+    ps = pool_k.shape[2]
+    Pt = prefix_table.shape[1] * ps
+    x = params["embed"][tokens]
+    positions = prefix_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    pool_k_flat = pool_k.reshape((L * P,) + pool_k.shape[2:])
+    pool_v_flat = pool_v.reshape((L * P,) + pool_v.shape[2:])
+
+    def layer_step(x, scanned):
+        lp, l = scanned
+        kp = pool_k_flat[l * P + prefix_table].reshape(
+            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
+        vp = pool_v_flat[l * P + prefix_table].reshape(
+            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
+        attn = gqa_attention_prefix(q, kp, vp, k.astype(kp.dtype),
+                                    v.astype(vp.dtype), prefix_lens,
+                                    window=cfg.sliding_window)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(Bp, T, -1), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        moe_out, _load = moe_block(
+            h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.experts_per_token,
+        )
+        x = x + moe_out
+        return x, (k.astype(kp.dtype), v.astype(vp.dtype))
+
+    x, (sfx_k, sfx_v) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, sfx_k, sfx_v
+
+
+def forward_prefix_lane(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    prefix_table: jnp.ndarray,
+    prefix_lens: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    lane_pages: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dense-cache prefix prefill: core + shared lane composition (see
+    ``llama.forward_prefix_lane``)."""
+    from ..ops.layers import compose_prefix_lane
+
+    logits, sfx_k, sfx_v = forward_prefix_pages(
+        params, cfg, tokens, prefix_table, prefix_lens, pool_k, pool_v)
+    lane_k, lane_v = compose_prefix_lane(
+        pool_k, pool_v, prefix_table, prefix_lens, sfx_k, sfx_v, lane_pages)
+    return logits, lane_k, lane_v
 
 
 def forward_paged_chunked(

@@ -52,11 +52,16 @@ def _pallas_decode_enabled() -> bool:
     return os.environ.get("SWARMDB_PALLAS", "0") == "1"
 
 
-def _paged_pallas_enabled() -> bool:
-    """The ragged paged kernel DEFAULTS ON for TPU (it is the point of the
-    paged cache: HBM reads ∝ live pages); SWARMDB_PALLAS=0 forces the XLA
-    gather fallback, =1 forces the kernel even off-TPU (interpret mode —
-    slow, for tests)."""
+def _paged_pallas_enabled(kv_span: Optional[int] = None) -> bool:
+    """The ragged paged kernel defaults ON for TPU in the LONG-context
+    regime it exists for (HBM reads ∝ live pages). At short max_seq and
+    full occupancy the XLA gather path wins — its big fused einsums fill
+    the MXU where the kernel's per-page [G, ps] dots cannot (swarm100 on
+    v5e at S=256: gather 2150 tok/s vs kernel 1484), so the TPU default
+    flips to the kernel only when the table's coverage ``kv_span`` (maxp *
+    page_size) reaches SWARMDB_PALLAS_MIN_SEQ (default 1024). SWARMDB_
+    PALLAS=0 forces the gather fallback everywhere, =1 forces the kernel
+    even off-TPU (interpret mode — slow, for tests)."""
     if getattr(_pallas_ctx, "disabled", False):
         return False
     env = os.environ.get("SWARMDB_PALLAS", "")
@@ -64,7 +69,11 @@ def _paged_pallas_enabled() -> bool:
         return False
     if env == "1":
         return True
-    return jax.default_backend() == "tpu"
+    if jax.default_backend() != "tpu":
+        return False
+    if kv_span is None:
+        return True
+    return kv_span >= int(os.environ.get("SWARMDB_PALLAS_MIN_SEQ", "1024"))
 
 
 def paged_attention_dispatch(
@@ -78,7 +87,7 @@ def paged_attention_dispatch(
 ) -> jnp.ndarray:
     """Decode attention over the paged pool: ragged Pallas kernel on TPU,
     XLA page-gather fallback elsewhere. Returns [B, 1, Hq, D]."""
-    if _paged_pallas_enabled():
+    if _paged_pallas_enabled(page_table.shape[1] * k_pages.shape[1]):
         from .attention_pallas import paged_decode_gqa_attention
 
         lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
@@ -116,7 +125,7 @@ def paged_attention_dispatch_chunked(
     frozen-segment mask (kv_pos < chunk start) already expresses "pool
     holds strictly the prefix".
     """
-    if _paged_pallas_enabled():
+    if _paged_pallas_enabled(page_table.shape[1] * k_pages.shape[1]):
         from .attention_pallas import paged_decode_gqa_attention_chunked
 
         starts = (q_positions[:, 0] - step).astype(jnp.int32)
@@ -385,4 +394,115 @@ def gqa_attention(
     probs = jax.nn.softmax(scores, axis=-1)              # fp32
     out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(cache_v.dtype),
                      cache_v, preferred_element_type=jnp.float32)
+    return out.reshape(q.shape).astype(q.dtype)
+
+
+def compose_prefix_lane(
+    pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D] prefix page pool
+    pool_v: jnp.ndarray,
+    prefix_table: jnp.ndarray,  # [Bp, PP] int32 page ids per row
+    prefix_lens: jnp.ndarray,   # [Bp] int32 reused tokens per row
+    sfx_k: jnp.ndarray,         # [L, Bp, T, Hkv, D] suffix K (stacked)
+    sfx_v: jnp.ndarray,
+    lane_pages: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compose per-row KV LANE IMAGES for the dense-cache prefix path:
+    lane[b, j] = reused prefix page content for j < prefix_lens[b], else
+    the suffix K/V one-hot-placed at absolute position prefix_lens[b]+t.
+
+    The one-hot einsum expresses per-row ragged placement with uniform
+    shapes — per-row gather/scatter forms either serialize on TPU or take
+    minutes to compile (see merge_chunk_kv). Entries beyond a row's
+    prompt hold zeros/pad garbage, unreachable under the engine's
+    write-before-read invariant. Returns [L, Bp, lane_pages*ps, Hkv, D]
+    lane_k, lane_v.
+    """
+    L, P, ps = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
+    Bp, PP = prefix_table.shape
+    T = sfx_k.shape[2]
+    Pt = PP * ps
+    lane_t = lane_pages * ps
+
+    kp = pool_k[:, prefix_table].reshape((L, Bp, Pt) + pool_k.shape[3:])
+    vp = pool_v[:, prefix_table].reshape((L, Bp, Pt) + pool_v.shape[3:])
+    lane_j = jnp.arange(lane_t, dtype=jnp.int32)[None, :]
+    in_prefix = (lane_j < prefix_lens[:, None])[None, :, :, None, None]
+    sel = (lane_j[:, :, None]
+           == (prefix_lens[:, None, None]
+               + jnp.arange(T, dtype=jnp.int32)[None, None, :]))
+
+    def lane(prefix, fresh):
+        if lane_t > Pt:
+            pad = jnp.zeros((L, Bp, lane_t - Pt) + prefix.shape[3:],
+                            prefix.dtype)
+            pre = jnp.concatenate([prefix, pad], axis=2)
+        else:
+            pre = prefix[:, :, :lane_t]
+        suf = jnp.einsum("bjt,lbthd->lbjhd", sel.astype(fresh.dtype),
+                         fresh, preferred_element_type=prefix.dtype)
+        return jnp.where(in_prefix, pre, suf.astype(prefix.dtype))
+
+    return lane(kp, sfx_k), lane(vp, sfx_v)
+
+
+def gqa_attention_prefix(
+    q: jnp.ndarray,          # [B, T, Hq, D] suffix queries
+    prefix_k: jnp.ndarray,   # [B, Pt, Hkv, D] gathered prefix K (positions 0..)
+    prefix_v: jnp.ndarray,
+    suffix_k: jnp.ndarray,   # [B, T, Hkv, D] this call's K (current tokens)
+    suffix_v: jnp.ndarray,
+    prefix_lens: jnp.ndarray,  # [B] int32 — valid prefix length per row
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Two-segment PREFILL attention for prefix-cache reuse: the suffix's
+    queries attend a reused KV prefix (positions ``0..prefix_lens[b]``,
+    gathered from the prefix page pool) plus the suffix itself causally.
+
+    Row ``b``'s suffix token t sits at absolute position
+    ``prefix_lens[b] + t``; the prefix segment is valid strictly below
+    ``prefix_lens[b]`` (gather padding beyond a row's true prefix is
+    masked). One fp32 softmax spans both segments — this is
+    ``gqa_attention`` over the concatenated KV: because prefill attention
+    reads the bf16-WRITTEN cache, the reused prefix K/V bytes are
+    identical to a full recompute's, and only reduction tiling can differ
+    (last-ulp). Returns [B, T, Hq, D] in q.dtype.
+
+    No reference counterpart (the reference has no model/serving code);
+    the vLLM-style automatic prefix caching pattern is noted in PAPERS.md.
+    """
+    B, T = q.shape[0], q.shape[1]
+    Pt = prefix_k.shape[1]
+    Hq, Hkv = q.shape[2], prefix_k.shape[2]
+    group = Hq // Hkv
+    D = q.shape[-1]
+
+    qg = q.reshape(B, T, Hkv, group, D)
+    s_p = jnp.einsum("btkgd,bskd->bkgts", qg, prefix_k,
+                     preferred_element_type=jnp.float32)
+    s_s = jnp.einsum("btkgd,bskd->bkgts", qg, suffix_k,
+                     preferred_element_type=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    plen = prefix_lens[:, None, None]                    # [B, 1, 1]
+    q_abs = prefix_lens[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    kv_pos = jnp.arange(Pt)[None, None, :]               # [1, 1, Pt]
+    valid_p = kv_pos < plen                              # [B, 1→T, Pt]
+    valid_p = jnp.broadcast_to(valid_p, (B, T, Pt))
+    j = jnp.arange(T)[None, None, :]                     # [1, 1, T]
+    valid_s = j <= jnp.arange(T)[None, :, None]          # [1, T, T] causal
+    valid_s = jnp.broadcast_to(valid_s, (B, T, T))
+    if window is not None:
+        lo = q_abs[:, :, None] - window                  # [B, T, 1]
+        valid_p &= kv_pos > lo
+        valid_s &= (plen + j) > lo
+    s_p = jnp.where(valid_p[:, None, None], s_p * scale, jnp.float32(-1e30))
+    s_s = jnp.where(valid_s[:, None, None], s_s * scale, jnp.float32(-1e30))
+    s = jnp.concatenate([s_p, s_s], axis=-1)             # [B, Hkv, g, T, Pt+T]
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", p[..., :Pt].astype(prefix_v.dtype),
+                     prefix_v, preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("bkgts,bskd->btkgd",
+                           p[..., Pt:].astype(suffix_v.dtype), suffix_v,
+                           preferred_element_type=jnp.float32)
     return out.reshape(q.shape).astype(q.dtype)

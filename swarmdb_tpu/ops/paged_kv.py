@@ -124,9 +124,10 @@ def paged_insert_prefill(
     """Scatter the first n rows of a dense bucket prefill cache into pages.
 
     ``bucket`` must be a multiple of the page size (buckets are powers of
-    two >= page_size by construction). Hot callers should use
-    :func:`paged_insert_prefill_donating` — eager ``.at[].set`` on the full
-    pool would otherwise materialize a second pool copy per admission."""
+    two >= page_size by construction). REFERENCE implementation: the
+    engine's hot path performs this scatter inside its fused paged
+    prefill (`Engine._prefill_paged_fused`); tests check that fused path
+    against this standalone form."""
     L = k_pages.shape[0]
     ps = k_pages.shape[2]
     n, chunks = target_pages.shape
@@ -140,14 +141,6 @@ def paged_insert_prefill(
     k_pages = k_pages.at[:, flat].set(kc.astype(k_pages.dtype))
     v_pages = v_pages.at[:, flat].set(vc.astype(v_pages.dtype))
     return k_pages, v_pages
-
-
-# Jitted + pool-donating variant for the engine's admission path: the old
-# pool buffers are dead the moment the engine rebinds self.cache, so XLA can
-# scatter in place — no transient 2x-pool HBM, no full-pool copy bandwidth.
-paged_insert_prefill_donating = jax.jit(
-    paged_insert_prefill, donate_argnums=(0, 1)
-)
 
 
 def paged_write_chunk(

@@ -1,0 +1,183 @@
+"""Automatic prefix caching over a KV page pool (vLLM-style, TPU-shaped).
+
+The serve workload re-sends each conversation's whole history every turn
+(`backend/service.build_prompt`), so prefill work grows quadratically with
+conversation length and dominates decode ~15:1 on the round-4 profile. This
+module caches the KV of PAGE-ALIGNED prompt prefixes across requests:
+
+- Every full ``page_size``-token page of a prompt is identified by a CHAIN
+  hash — a running blake2b over all tokens from position 0 through the end
+  of that page — so equal chains imply equal token prefixes (the raw token
+  window is stored and compared too, making collisions impossible rather
+  than merely improbable).
+- At admission the engine looks up the longest cached chain run, reuses
+  those pages (attention reads them via ``ops.layers.gqa_attention_prefix``)
+  and prefills ONLY the suffix. After prefill it registers the prompt's
+  freshly-written full pages for future turns.
+- Pages live in a dedicated pool (dense engine) or the main paged pool;
+  eviction is LRU over pages no active slot depends on.
+
+Host-side safety argument (single engine thread + device program order):
+admission N's page reads are dispatched before admission N+1 is even
+matched, so an entry evicted and re-registered by N+1 can only be
+REWRITTEN by a dispatch that the device executes after N's reads. The
+table never points a chain at a page whose (eventual) content differs from
+that chain's tokens.
+
+No reference counterpart (the reference has no model/serving layer —
+SURVEY §5.7); the automatic-prefix-caching pattern is noted in PAPERS.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+
+def page_chains(tokens: Sequence[int], page_size: int,
+                max_pages: Optional[int] = None) -> List[bytes]:
+    """Chain hashes for every FULL page of ``tokens``.
+
+    chain[i] digests tokens[0 : (i+1)*page_size] — a prefix identity, not a
+    page identity, so page i can only hit behind a hit of page i-1.
+    """
+    n_full = len(tokens) // page_size
+    if max_pages is not None:
+        n_full = min(n_full, max_pages)
+    h = hashlib.blake2b(digest_size=16)
+    out: List[bytes] = []
+    for i in range(n_full):
+        page = tokens[i * page_size: (i + 1) * page_size]
+        h.update(b"".join(int(t).to_bytes(4, "little", signed=True)
+                          for t in page))
+        out.append(h.digest())
+    return out
+
+
+class PrefixLRU:
+    """Chain-hash → page-id table with LRU eviction over an id pool.
+
+    Page ids are ``1..num_pages-1`` (0 is the trash page, never cached).
+    ``pin``/``unpin`` guard pages that an ACTIVE slot's attention still
+    reads every decode step (dense mode never needs this — the gathered
+    prefix is copied into the slot's lane — but the paged engine reads
+    shared pages in place until retirement).
+    """
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the trash page)")
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        # chain -> (page_id, token window); insertion order == LRU order
+        self._entries: "OrderedDict[bytes, Tuple[int, Tuple[int, ...]]]" = (
+            OrderedDict()
+        )
+        self._pins: dict = {}            # page_id -> pin count
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    # ---------------------------------------------------------------- lookup
+
+    def match(self, chains: Sequence[bytes],
+              tokens: Sequence[int]) -> List[int]:
+        """Longest cached run of ``chains`` (from page 0); returns its page
+        ids and touches them MRU. ``tokens`` re-verifies content so a hash
+        collision cannot alias two different prefixes."""
+        pages: List[int] = []
+        ps = self.page_size
+        with self._lock:
+            for i, chain in enumerate(chains):
+                entry = self._entries.get(chain)
+                if entry is None:
+                    break
+                page_id, window = entry
+                if tuple(tokens[i * ps: (i + 1) * ps]) != window:
+                    break  # collision — treat as miss
+                self._entries.move_to_end(chain)
+                pages.append(page_id)
+            self.hits += len(pages)
+            self.misses += max(0, len(chains) - len(pages))
+        return pages
+
+    # ------------------------------------------------------------ allocation
+
+    def acquire(self, n: int) -> List[int]:
+        """Take UP TO ``n`` page ids for registration, evicting LRU
+        unpinned entries as needed; returns what the pool can cover
+        (possibly empty — the caller registers that much less)."""
+        with self._lock:
+            take: List[int] = []
+            while len(take) < n and self._free:
+                take.append(self._free.pop())
+            if len(take) < n:
+                evictable = [c for c, (p, _) in self._entries.items()
+                             if not self._pins.get(p)]
+                for chain in evictable:
+                    if len(take) >= n:
+                        break
+                    page_id, _ = self._entries.pop(chain)
+                    take.append(page_id)
+            return take
+
+    def reset(self) -> None:
+        """Forget everything (engine restart rebuilds the pool buffers, so
+        every cached entry would point at zeroed pages)."""
+        with self._lock:
+            self._free = list(range(self.num_pages - 1, 0, -1))
+            self._entries.clear()
+            self._pins.clear()
+
+    def register(self, chain: bytes, tokens: Tuple[int, ...],
+                 page_id: int) -> None:
+        """Bind ``chain`` to ``page_id`` (whose device content a dispatched
+        write is filling with exactly ``tokens``'s KV)."""
+        with self._lock:
+            old = self._entries.pop(chain, None)
+            if old is not None:
+                # duplicate registration (two slots prefilled the same new
+                # prefix in one round): keep the old page, recycle the new
+                self._free.append(page_id)
+                self._entries[chain] = old
+                self._entries.move_to_end(chain)
+                return
+            self._entries[chain] = (page_id, tuple(tokens))
+
+    def release(self, page_id: int) -> None:
+        """Return a page acquired but never registered (group failed)."""
+        with self._lock:
+            self._free.append(page_id)
+
+    # ---------------------------------------------------------------- pinning
+
+    def pin(self, page_ids: Sequence[int]) -> None:
+        with self._lock:
+            for p in page_ids:
+                self._pins[p] = self._pins.get(p, 0) + 1
+
+    def unpin(self, page_ids: Sequence[int]) -> None:
+        with self._lock:
+            for p in page_ids:
+                c = self._pins.get(p, 0) - 1
+                if c <= 0:
+                    self._pins.pop(p, None)
+                else:
+                    self._pins[p] = c
+
+    # ----------------------------------------------------------- introspection
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_pages": self.num_pages,
+                "free_pages": len(self._free),
+                "cached_pages": len(self._entries),
+                "pinned_pages": len(self._pins),
+                "page_size": self.page_size,
+                "hit_tokens": self.hits * self.page_size,
+                "miss_tokens": self.misses * self.page_size,
+            }
