@@ -203,6 +203,7 @@ def serving_stack(model: str, n_assistants: int, max_batch: int, max_seq: int,
             max_batch=max_batch, max_seq=max_seq, decode_chunk=decode_chunk,
             prefill_batch=_env("SWARMDB_BENCH_PREFILL_BATCH", 16),
             paged=paged or None,
+            page_size=_env("SWARMDB_BENCH_PAGE_SIZE", 16),
         )
         assistants = [f"assistant_{i}" for i in range(n_assistants)]
         for a in assistants:
@@ -420,10 +421,11 @@ def bench_serve(seconds: float) -> dict:
     max_seq = _env("SWARMDB_BENCH_SEQ", 256)
     new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16)
     decode_chunk = _env("SWARMDB_BENCH_CHUNK", 16)
+    paged = _env("SWARMDB_BENCH_PAGED", 0, int) == 1
     gen_meta = {"generation": {"max_new_tokens": new_tokens, "temperature": 0.0}}
 
     with serving_stack(model, n_assistants, max_batch, max_seq,
-                       decode_chunk) as (db, service, assistants):
+                       decode_chunk, paged=paged) as (db, service, assistants):
         users = [f"user_{i}" for i in range(n_users)]
         for u in users:
             db.register_agent(u)

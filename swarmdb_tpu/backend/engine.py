@@ -363,22 +363,83 @@ class Engine:
                 _prefill_paged_insert, donate_argnums=(5, 6, 7)
             )
 
-        # ---- automatic prefix caching (dense cache only) ------------------
+        # ---- automatic prefix caching --------------------------------------
         # Chat serving re-prefills each conversation's WHOLE history every
         # turn (prefill dominated decode ~15:1 on the round-4 serve
-        # profile). The prefix cache keeps page-aligned prompt KV in a
-        # side pool; admission reuses the longest cached prefix and
-        # prefills only the suffix. See ops/prefix_cache.py for the chain
-        # hashing + eviction-safety argument and models/llama.
-        # forward_prefix_lane for the ragged lane composition.
+        # profile). The prefix cache reuses page-aligned prompt KV across
+        # requests: admission matches the longest cached prefix and
+        # prefills only the suffix. Dense mode keeps a SIDE pool and
+        # copies reused pages into slot lanes; paged mode reuses pool
+        # pages IN PLACE (pinning them while referenced). See
+        # ops/prefix_cache.py for chain hashing + eviction safety.
         self._prefix = None
         self._prefix_fns = prefix_fns
-        if prefix_fns is not None:
-            if paged is not None:
-                raise NotImplementedError(
-                    "prefix caching currently supports the dense cache "
-                    "path (the paged pool needs page pinning integration)"
+        # paged mode: pages each live slot keeps pinned (matched hits +
+        # pages it registered); unpinned at retirement
+        self._slot_prefix_pins: Dict[int, List[int]] = {}
+        if prefix_fns is not None and paged is not None:
+            # PAGED mode: reuse IN PLACE — the main pool holds the cached
+            # pages, hit pages are pinned while a slot's table row
+            # references them, suffix KV scatters straight into the
+            # slot's fresh pages (page-aligned: reuse is page-granular),
+            # and registration is free (no copy — custody of the slot's
+            # full prompt pages just moves to the cache at registration).
+            if max_seq % paged.page_size:
+                raise ValueError("max_seq must be a page-size multiple "
+                                 "for prefix caching")
+            from ..ops.prefix_cache import PrefixLRU
+
+            self._prefix_ps = paged.page_size
+            self._prefix = PrefixLRU(paged.num_pages, paged.page_size,
+                                     manage_free=False)
+            pages_fwd = prefix_fns[0]
+            maxp_row = paged.allocator.maxp
+            self._prefix_pp_buckets = sorted({
+                max(1, maxp_row // 4), max(1, maxp_row // 2),
+                max(1, maxp_row - 1),
+            })
+
+            def _prefill_paged_prefix_insert(params, tokens, lengths,
+                                             prefix_lens, prefix_table,
+                                             target_pages, slot_ids, k_pool,
+                                             v_pool, last_tokens, base_keys,
+                                             temp, topk, topp):
+                # tokens [Bp, T] SUFFIX tokens; prefix_table [Bp, PP] live
+                # pool pages (gather); target_pages [Bp, chunks] fresh
+                # pages for the suffix (page-aligned since the reused
+                # prefix is page-granular; trash 0 for padding)
+                Bp, T = tokens.shape
+                ps = self.paged.page_size
+                logits, sk, sv = pages_fwd(
+                    params, tokens, prefix_table, prefix_lens, k_pool,
+                    v_pool,
                 )
+                last = logits[jnp.arange(Bp), lengths - 1]
+                next_tok = sample_tokens(
+                    last, base_keys, prefix_lens + lengths - 1, temp, topk,
+                    topp,
+                )
+                chunks = target_pages.shape[1]
+                pad_to = chunks * ps
+                if pad_to != T:
+                    pad = [(0, 0), (0, 0), (0, pad_to - T), (0, 0), (0, 0)]
+                    sk = jnp.pad(sk, pad)
+                    sv = jnp.pad(sv, pad)
+                L = sk.shape[0]
+                tail = sk.shape[3:]
+                kc = sk.reshape((L, Bp * chunks, ps) + tail)
+                vc = sv.reshape((L, Bp * chunks, ps) + tail)
+                flat = target_pages.reshape(-1)
+                k_pool = k_pool.at[:, flat].set(kc.astype(k_pool.dtype))
+                v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
+                last_tokens = last_tokens.at[slot_ids].set(next_tok,
+                                                           mode="drop")
+                return k_pool, v_pool, last_tokens
+
+            self._prefill_paged_prefix_fused = jax.jit(
+                _prefill_paged_prefix_insert, donate_argnums=(7, 8, 9)
+            )
+        elif prefix_fns is not None:
             if max_seq % prefix_page_size:
                 raise ValueError("max_seq must be a page-size multiple "
                                  "for prefix caching")
@@ -579,11 +640,14 @@ class Engine:
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
         self.cache = self._fresh_cache()
         if self._prefix is not None:
-            # the pool was donated into the failed dispatch: rebuild it and
-            # forget every entry (they'd point at zeroed pages)
-            self._prefix_pool = self._prefix_init_pool(
-                self._prefix.num_pages, self._prefix_ps)
+            # dense: the side pool was donated into the failed dispatch —
+            # rebuild it; paged: _fresh_cache rebuilt the main pool. Either
+            # way every cached entry now points at zeroed pages: forget all
+            if not self.paged:
+                self._prefix_pool = self._prefix_init_pool(
+                    self._prefix.num_pages, self._prefix_ps)
             self._prefix.reset()
+            self._slot_prefix_pins.clear()
         self.metrics.counters["engine_restarts"].inc()
         self.start()
 
@@ -659,13 +723,28 @@ class Engine:
         if self._prefix is not None:
             # prefix-prefill variants: one per (suffix bucket, PP width).
             # Inputs are pure padding — trash-page gathers, drop-scattered
-            # rows, no registration (reg_cols all -1)
+            # rows, no registration (reg_cols all -1 / trash targets)
             drop = np.full(Bp, self.max_batch, np.int32)
             for bucket in self.prefill_buckets:
                 for ppb in self._prefix_pp_buckets:
+                    tokens = np.full((Bp, bucket), self.pad_id, np.int32)
+                    if self.paged:
+                        chunks = -(-bucket // self._prefix_ps)
+                        pk, pv = self.cache["k"], self.cache["v"]
+                        pk, pv, self._last_tokens = (
+                            self._prefill_paged_prefix_fused(
+                                self.params, tokens, lengths,
+                                np.zeros(Bp, np.int32),
+                                np.zeros((Bp, ppb), np.int32),
+                                np.zeros((Bp, chunks), np.int32),
+                                drop, pk, pv, self._last_tokens,
+                                keys, zero_f, zero_i, ones_f,
+                            ))
+                        self.cache = {"k": pk, "v": pv,
+                                      "page_table": self.cache["page_table"]}
+                        continue
                     lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                      self.max_seq // self._prefix_ps)
-                    tokens = np.full((Bp, bucket), self.pad_id, np.int32)
                     pk, pv = self._prefix_pool
                     self.cache, self._last_tokens, pk, pv = (
                         self._prefill_prefix_fused(
@@ -789,6 +868,16 @@ class Engine:
                 try:
                     self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
                     self.cache = self._fresh_cache()
+                    if self._prefix is not None:
+                        # the rebuilt pool is zeroed and (paged) its pages
+                        # are back on the free list: stale chain entries
+                        # would hit zeroed or REUSED pages — forget all
+                        # (mirrors restart())
+                        if not self.paged:
+                            self._prefix_pool = self._prefix_init_pool(
+                                self._prefix.num_pages, self._prefix_ps)
+                        self._prefix.reset()
+                        self._slot_prefix_pins.clear()
                 except Exception:
                     logger.exception("cache re-init failed; stopping engine")
                     with self._cv:
@@ -826,9 +915,15 @@ class Engine:
                     # admit in priority order while the pool covers each
                     # request's worst-case page footprint; stop at the first
                     # that doesn't fit (no skip-ahead: prevents starvation
-                    # of long prompts behind a stream of short ones)
+                    # of long prompts behind a stream of short ones). With
+                    # the prefix cache, hit pages are pinned and referenced
+                    # in place; only the remainder needs fresh pages, and
+                    # LRU cache pages are evicted into the free list when
+                    # the pool runs short.
                     popped = []
                     rows = []
+                    plans: Dict[int, Tuple] = {}
+                    use_pp = self._prefix is not None and self._mh is None
                     for slot_id in free[:take]:
                         if not self._queue:
                             break
@@ -837,12 +932,21 @@ class Engine:
                             len(req.prompt), req.sampling.max_new_tokens,
                             self.decode_chunk,
                         )
-                        row = self.paged.allocator.allocate(slot_id, need)
+                        hits: List[int] = []
+                        chains: List[bytes] = []
+                        if use_pp and len(req.prompt) >= self._prefix_ps:
+                            hits, chains = self._prefix_plan(req.prompt,
+                                                             pin=True)
+                        row = self._paged_allocate(slot_id, hits,
+                                                   max(0, need - len(hits)))
                         if row is None:
+                            self._prefix.unpin(hits) if hits else None
                             break  # pool exhausted; retry after retirements
                         heapq.heappop(self._queue)
                         popped.append(req)
                         rows.append((slot_id, row))
+                        if use_pp and len(req.prompt) >= self._prefix_ps:
+                            plans[slot_id] = (hits, chains)
                     if not popped:
                         return
                 else:
@@ -860,12 +964,23 @@ class Engine:
             prefix_batch: List[Tuple] = []
             max_suffix = max_hits = 0
             for slot_id, req in zip(free, popped):
-                if use_prefix and len(req.prompt) >= self._prefix_ps:
-                    # sub-page prompts (no hit possible, nothing to
-                    # register) stay on the plain path; everything else
-                    # goes through the prefix path even on a full miss so
-                    # its pages get REGISTERED for the next turn
-                    hits, chains = self._prefix_plan(req.prompt)
+                # sub-page prompts (no hit possible, nothing to register)
+                # stay on the plain path; everything else goes through the
+                # prefix path even on a full miss so its pages get
+                # REGISTERED for the next turn. Paged requests were
+                # matched (and pinned) during the pop loop above —
+                # matching again would double-pin — so route on the plan's
+                # existence there.
+                if self.paged and self._prefix is not None:
+                    planned = slot_id in plans
+                else:
+                    planned = (use_prefix
+                               and len(req.prompt) >= self._prefix_ps)
+                if planned:
+                    if self.paged:
+                        hits, chains = plans[slot_id]
+                    else:
+                        hits, chains = self._prefix_plan(req.prompt)
                     suffix_len = len(req.prompt) - len(hits) * self._prefix_ps
                     prefix_batch.append((slot_id, req, hits, chains))
                     max_suffix = max(max_suffix, suffix_len)
@@ -885,7 +1000,9 @@ class Engine:
                 groups[key] = prefix_batch
             for (bucket, ppb), batch in groups.items():
                 try:
-                    if ppb > 0:
+                    if ppb > 0 and self.paged:
+                        self._prefill_paged_prefix_batch(batch, bucket, ppb)
+                    elif ppb > 0:
                         self._prefill_prefix_batch(batch, bucket, ppb)
                     else:
                         self._prefill_batch(batch)
@@ -916,6 +1033,8 @@ class Engine:
                             # allocate() raises "already holds pages" and the
                             # whole engine fails over (review finding)
                             self.paged.allocator.mark_retired(slot_id)
+                            if len(item) > 2 and item[2]:
+                                self._prefix.unpin(item[2])  # matched hits
                         if req.on_done is not None:
                             try:
                                 req.on_done(req.request_id, [], "engine_error")
@@ -937,11 +1056,13 @@ class Engine:
                 return b
         return self._prefix_pp_buckets[-1]
 
-    def _prefix_plan(self, prompt: List[int]):
+    def _prefix_plan(self, prompt: List[int], pin: bool = False):
         """Longest cached prefix for ``prompt`` -> (hit page ids, chain
         hashes for every full prompt page). Hits are capped one page short
         of the prompt so at least one suffix token remains to prefill
-        (the sampled first token needs logits)."""
+        (the sampled first token needs logits). ``pin=True`` (paged mode)
+        pins the hits so a later admission in the same round cannot evict
+        pages this request's table row is about to reference."""
         from ..ops.prefix_cache import page_chains
 
         ps = self._prefix_ps
@@ -951,8 +1072,96 @@ class Engine:
         cap = min(cap, self._prefix_pp_buckets[-1])
         if cap <= 0:
             return [], chains
-        hits = self._prefix.match(chains[:cap], prompt)
+        if pin:
+            hits = self._prefix.match_and_pin(chains[:cap], prompt)
+        else:
+            hits = self._prefix.match(chains[:cap], prompt)
         return hits, chains
+
+    def _paged_allocate(self, slot_id: int, hits: List[int],
+                        n_fresh: int) -> Optional[np.ndarray]:
+        """Allocate a paged slot row (= pinned hit pages + fresh pages),
+        evicting LRU prefix-cache pages into the allocator's free list
+        when the pool runs short. None if still uncoverable."""
+        alloc = self.paged.allocator
+        if self._prefix is not None:
+            shortfall = n_fresh - alloc.free_count()
+            if shortfall > 0:
+                evicted = self._prefix.evict_lru(shortfall)
+                if evicted:
+                    alloc.add_free(evicted)
+            return alloc.allocate_with_prefix(slot_id, hits, n_fresh)
+        return alloc.allocate(slot_id, n_fresh)
+
+    def _prefill_paged_prefix_batch(self, batch: List[Tuple], bucket: int,
+                                    ppb: int) -> None:
+        """Paged-pool prefix prefill: gather reused pages in place, forward
+        only the suffix, scatter its KV into the slot's fresh pages (the
+        reuse boundary is page-aligned, so suffix chunk c maps to fresh
+        page c), then REGISTER the prompt's fresh full pages — custody
+        moves to the cache with no copy. One fused pool-donating dispatch
+        per admission wave (see ``_prefill_paged_prefix_insert``)."""
+        t0 = time.time()
+        ps = self._prefix_ps
+        Bp = self.prefill_batch
+        chunks = -(-bucket // ps)
+        padded = np.full((Bp, bucket), self.pad_id, np.int32)
+        lengths = np.ones(Bp, np.int32)
+        plens = np.zeros(Bp, np.int32)
+        table = np.zeros((Bp, ppb), np.int32)
+        target = np.zeros((Bp, chunks), np.int32)
+        gather = np.zeros(Bp, np.int64)
+        scatter = np.full(Bp, self.max_batch, np.int32)
+        reg_records = []
+        for row, (slot_id, req, hits, chains) in enumerate(batch):
+            prompt = req.prompt
+            p0 = len(hits) * ps
+            suffix = prompt[p0:]
+            padded[row, : len(suffix)] = suffix
+            lengths[row] = len(suffix)
+            plens[row] = p0
+            table[row, : len(hits)] = hits
+            gather[row] = slot_id
+            scatter[row] = slot_id
+            fresh = self.paged.allocator.pages_for(slot_id)
+            m = min(len(fresh), chunks)
+            target[row, :m] = fresh[:m]
+            s = req.sampling
+            self._temp[slot_id] = s.temperature
+            self._topk[slot_id] = s.top_k
+            self._topp[slot_id] = s.top_p
+            n_full = len(prompt) // ps
+            for page_idx in range(len(hits), n_full):
+                f = page_idx - len(hits)
+                if f >= len(fresh):
+                    break
+                reg_records.append(
+                    (slot_id, chains[page_idx],
+                     tuple(prompt[page_idx * ps:(page_idx + 1) * ps]),
+                     fresh[f]))
+        pk, pv = self.cache["k"], self.cache["v"]
+        pk, pv, self._last_tokens = self._prefill_paged_prefix_fused(
+            self.params, padded, lengths, plens, table, target, scatter,
+            pk, pv, self._last_tokens,
+            self._base_keys_np[gather],
+            self._temp[gather],
+            self._topk[gather],
+            self._topp[gather],
+        )
+        self.cache = {"k": pk, "v": pv,
+                      "page_table": self.cache["page_table"]}
+        pins: Dict[int, List[int]] = {}
+        for slot_id, chain, toks, page_id in reg_records:
+            if self._prefix.register(chain, toks, page_id):
+                # custody -> cache; pin while this slot still reads it
+                self.paged.allocator.transfer_to_cache(slot_id, [page_id])
+                self._prefix.pin([page_id])
+                pins.setdefault(slot_id, []).append(page_id)
+        for slot_id, req, hits, _chains in batch:
+            # unpinned at retirement (together with the matched hits)
+            self._slot_prefix_pins[slot_id] = hits + pins.get(slot_id, [])
+        self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
+        self._activate([(s, r) for s, r, _, _ in batch], t0)
 
     def _prefill_prefix_batch(self, batch: List[Tuple], bucket: int,
                               ppb: int) -> None:
@@ -1235,6 +1444,12 @@ class Engine:
             # pages stay owned (absorbing end-of-chunk garbage writes) until
             # the next admission round zeroes the table row and frees them
             self.paged.allocator.mark_retired(slot_id)
+            pins = self._slot_prefix_pins.pop(slot_id, None)
+            if pins:
+                # eviction/rewrite of these pages can only be DISPATCHED
+                # after this point, so any in-flight chunk's reads (issued
+                # earlier) complete first — device program order
+                self._prefix.unpin(pins)
         self.metrics.counters["engine_completed"].inc()
         self.metrics.rates["requests_completed"].mark()
         if req and req.on_done is not None:

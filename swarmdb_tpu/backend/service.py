@@ -194,6 +194,14 @@ class ServingService:
             if kv_pool_tokens is None and "SWARMDB_KV_POOL_TOKENS" in os.environ:
                 kv_pool_tokens = int(os.environ["SWARMDB_KV_POOL_TOKENS"])
             pool_tokens = kv_pool_tokens or max_batch * maxp * page_size
+            if (kv_pool_tokens is None
+                    and os.environ.get("SWARMDB_PREFIX", "1") != "0"
+                    and seq % page_size == 0):
+                # prefix caching shares this pool: cached pages compete
+                # with slot footprints, so grow the default by the prefix
+                # budget or admissions starve once the cache warms up
+                pool_tokens += int(os.environ.get(
+                    "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
             num_pages = 1 + -(-pool_tokens // page_size)  # +1 trash page
             paged_spec = PagedKV(
                 decode_forward=paged_fwd,
@@ -214,17 +222,27 @@ class ServingService:
         # doubles an existing deployment's KV HBM; benches size it up).
         prefix_fns = None
         prefix_pages = 0
-        if (not paged and hasattr(mod, "forward_prefix_lane")
+        needed = "forward_prefix_pages" if paged else "forward_prefix_lane"
+        if (hasattr(mod, needed)
                 and os.environ.get("SWARMDB_PREFIX", "1") != "0"
                 and seq % page_size == 0):
-            prefix_tokens = int(os.environ.get(
-                "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
-            prefix_pages = 1 + -(-prefix_tokens // page_size)  # +1 trash
-            prefix_fns = (
-                lambda p, t, tab, pl, pk, pv, lp: mod.forward_prefix_lane(
-                    p, cfg, t, tab, pl, pk, pv, lp),
-                lambda n, ps: mod.init_prefix_pool(cfg, n, ps),
-            )
+            if paged:
+                # paged mode reuses the MAIN pool in place; only the
+                # suffix-forward core is needed (no side pool, no lane)
+                prefix_fns = (
+                    lambda p, t, tab, pl, pk, pv: mod.forward_prefix_pages(
+                        p, cfg, t, tab, pl, pk, pv),
+                    None,
+                )
+            else:
+                prefix_tokens = int(os.environ.get(
+                    "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
+                prefix_pages = 1 + -(-prefix_tokens // page_size)  # +1 trash
+                prefix_fns = (
+                    lambda p, t, tab, pl, pk, pv, lp: mod.forward_prefix_lane(
+                        p, cfg, t, tab, pl, pk, pv, lp),
+                    lambda n, ps: mod.init_prefix_pool(cfg, n, ps),
+                )
 
         tokenizer = default_tokenizer(cfg.vocab_size, tokenizer_path)
         engine = Engine(

@@ -177,12 +177,32 @@ def paged_write_chunk(
     return k_pages, v_pages
 
 
-@jax.jit
+_set_page_table_rows = jax.jit(
+    lambda pt, rows, values: pt.at[rows].set(values, mode="drop")
+)
+
+
 def set_page_table_rows(
-    page_table: jnp.ndarray, rows: jnp.ndarray, values: jnp.ndarray
+    page_table: jnp.ndarray, rows, values
 ) -> jnp.ndarray:
-    """Replace whole page-table rows (admission assigns, retirement zeroes)."""
-    return page_table.at[rows].set(values)
+    """Replace whole page-table rows (admission assigns, retirement zeroes).
+
+    The host arrays are padded to the full batch with out-of-bounds row
+    indices (dropped by the scatter): a shape per DISTINCT row count would
+    compile up to max_batch variants, each a multi-second stall on the
+    tunneled TPU — the round-4 paged-prefix bench collapse was exactly
+    these landing in the measured window."""
+    B, maxp = page_table.shape
+    rows = np.asarray(rows, np.int32)
+    values = np.asarray(values, np.int32).reshape(len(rows), maxp)
+    n = len(rows)
+    if n < B:
+        pad_rows = np.full(B, B, np.int32)       # B = out of bounds -> drop
+        pad_rows[:n] = rows
+        pad_vals = np.zeros((B, maxp), np.int32)
+        pad_vals[:n] = values
+        rows, values = pad_rows, pad_vals
+    return _set_page_table_rows(page_table, rows, values)
 
 
 @dataclass
@@ -238,6 +258,44 @@ class PageAllocator:
             row = np.zeros(self.maxp, np.int32)
             row[: len(pages)] = pages
             return row
+
+    def allocate_with_prefix(self, slot_id: int, prefix_pages: List[int],
+                             n_fresh: int) -> Optional[np.ndarray]:
+        """Row = ``prefix_pages`` (cache-custody pages the slot only
+        REFERENCES — the prefix cache pins them; they are not recorded in
+        ``_by_slot`` and retirement does not free them) followed by
+        ``n_fresh`` newly owned pages. None if the pool can't cover the
+        fresh part."""
+        with self._lock:
+            if len(self._free) < n_fresh:
+                return None
+            if slot_id in self._by_slot:
+                raise RuntimeError(f"slot {slot_id} already holds pages")
+            fresh = [self._free.pop() for _ in range(n_fresh)]
+            self._by_slot[slot_id] = _SlotPages(fresh)
+            row = np.zeros(self.maxp, np.int32)
+            pages = list(prefix_pages) + fresh
+            row[: len(pages)] = pages
+            return row
+
+    def transfer_to_cache(self, slot_id: int, page_ids: List[int]) -> None:
+        """Remove ``page_ids`` from a slot's OWNED set: custody moves to
+        the prefix cache (registration), so retirement won't free them."""
+        with self._lock:
+            sp = self._by_slot.get(slot_id)
+            if sp is not None:
+                drop = set(page_ids)
+                sp.pages = [p for p in sp.pages if p not in drop]
+
+    def add_free(self, page_ids: List[int]) -> None:
+        """Return cache-evicted pages to the pool (prefix-cache eviction
+        path; the caller guarantees no live slot references them)."""
+        with self._lock:
+            self._free.extend(page_ids)
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
 
     def pages_for(self, slot_id: int) -> List[int]:
         with self._lock:

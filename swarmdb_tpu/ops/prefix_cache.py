@@ -66,12 +66,20 @@ class PrefixLRU:
     shared pages in place until retirement).
     """
 
-    def __init__(self, num_pages: int, page_size: int) -> None:
+    def __init__(self, num_pages: int, page_size: int,
+                 manage_free: bool = True) -> None:
+        """``manage_free=False`` (paged-engine mode): this table does NOT
+        own a free list — pages are borrowed from the engine's
+        PageAllocator, ``acquire``/``evict_lru`` only evict entries, and
+        the caller returns evicted ids to the allocator."""
         if num_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is the trash page)")
         self.page_size = page_size
         self.num_pages = num_pages
-        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._manage_free = manage_free
+        self._free: List[int] = (
+            list(range(num_pages - 1, 0, -1)) if manage_free else []
+        )
         # chain -> (page_id, token window); insertion order == LRU order
         self._entries: "OrderedDict[bytes, Tuple[int, Tuple[int, ...]]]" = (
             OrderedDict()
@@ -124,28 +132,56 @@ class PrefixLRU:
                     take.append(page_id)
             return take
 
+    def evict_lru(self, n: int) -> List[int]:
+        """Evict up to ``n`` LRU unpinned entries, returning their page
+        ids for the caller's free list (paged-engine mode — the returned
+        pages are NOT retained here)."""
+        with self._lock:
+            out: List[int] = []
+            for chain in [c for c, (p, _) in self._entries.items()
+                          if not self._pins.get(p)]:
+                if len(out) >= n:
+                    break
+                page_id, _ = self._entries.pop(chain)
+                out.append(page_id)
+            return out
+
+    def match_and_pin(self, chains: Sequence[bytes],
+                      tokens: Sequence[int]) -> List[int]:
+        """``match`` + pin the hit pages atomically (paged mode: a later
+        admission in the same round must not evict pages this one is
+        about to attach to a slot)."""
+        pages = self.match(chains, tokens)
+        self.pin(pages)
+        return pages
+
     def reset(self) -> None:
         """Forget everything (engine restart rebuilds the pool buffers, so
         every cached entry would point at zeroed pages)."""
         with self._lock:
-            self._free = list(range(self.num_pages - 1, 0, -1))
+            self._free = (list(range(self.num_pages - 1, 0, -1))
+                          if self._manage_free else [])
             self._entries.clear()
             self._pins.clear()
 
     def register(self, chain: bytes, tokens: Tuple[int, ...],
-                 page_id: int) -> None:
+                 page_id: int) -> bool:
         """Bind ``chain`` to ``page_id`` (whose device content a dispatched
-        write is filling with exactly ``tokens``'s KV)."""
+        write is filling with exactly ``tokens``'s KV). Returns True if
+        custody of ``page_id`` was accepted; False on a DUPLICATE chain
+        (two slots prefilled the same new prefix in one round) — the old
+        page is kept and the caller retains custody of the new one (in
+        managed-free mode it is recycled here)."""
         with self._lock:
             old = self._entries.pop(chain, None)
             if old is not None:
-                # duplicate registration (two slots prefilled the same new
-                # prefix in one round): keep the old page, recycle the new
-                self._free.append(page_id)
                 self._entries[chain] = old
                 self._entries.move_to_end(chain)
-                return
+                if self._manage_free:
+                    self._free.append(page_id)
+                return False
             self._entries[chain] = (page_id, tuple(tokens))
+            return True
 
     def release(self, page_id: int) -> None:
         """Return a page acquired but never registered (group failed)."""

@@ -327,8 +327,100 @@ def test_prefix_lru_duplicate_registration_recycles():
     toks = list(range(1, 5))
     (chain,) = page_chains(toks, 4)
     p1 = lru.acquire(1)[0]
-    lru.register(chain, tuple(toks), p1)
+    assert lru.register(chain, tuple(toks), p1)
     p2 = lru.acquire(1)[0]
-    lru.register(chain, tuple(toks), p2)          # duplicate
+    assert not lru.register(chain, tuple(toks), p2)  # duplicate
     assert lru.match(page_chains(toks, 4), toks) == [p1]
     assert lru.stats()["free_pages"] == 2         # p2 went back
+
+
+# --------------------------------------------------------------- paged engine
+
+
+def _mk_paged_prefix_engine(pool_pages: int = 64):
+    """Paged engine with IN-PLACE prefix caching over the main pool."""
+    from swarmdb_tpu.backend.engine import Engine, PagedKV
+    from swarmdb_tpu.ops.paged_kv import PageAllocator, pages_per_slot
+
+    cfg = TINY
+    ps = 8
+    max_batch, max_seq = 4, 64
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+    init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+    num_pages = 1 + pool_pages
+    paged_spec = PagedKV(
+        decode_forward=lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c),
+        init_pool=lambda: llama.init_paged_cache(
+            cfg, max_batch, max_seq, num_pages, ps),
+        page_size=ps,
+        num_pages=num_pages,
+        allocator=PageAllocator(num_pages, ps, max_seq, max_batch),
+    )
+    chunked = (
+        lambda p, t, pos, c, hkv, s: llama.forward_paged_chunked(
+            p, cfg, t, pos, c, hkv, s),
+        lambda b, k: llama.init_chunk_kv(cfg, b, k),
+        llama.merge_paged_chunk,
+    )
+    eng = Engine(fwd, init_cache, params, max_batch=max_batch,
+                 max_seq=max_seq, eos_id=2, seed=0,
+                 prefill_buckets=[8, 16, 32, 63], decode_chunk=4,
+                 paged=paged_spec, chunked_fns=chunked,
+                 prefix_fns=(
+                     lambda p, t, tab, pl, pk, pv: llama.forward_prefix_pages(
+                         p, cfg, t, tab, pl, pk, pv),
+                     None,
+                 ))
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def paged_prefix_engine():
+    eng = _mk_paged_prefix_engine()
+    yield eng
+    eng.stop()
+
+
+def test_paged_prefix_matches_plain_multiturn(plain_engine,
+                                              paged_prefix_engine):
+    """Paged in-place prefix reuse: growing conversations generate exactly
+    the plain dense engine's tokens, with real cache hits."""
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    rng = np.random.default_rng(23)
+    history = rng.integers(3, TINY.vocab_size, size=11).tolist()
+    for turn in range(4):
+        a, ra = plain_engine.generate_sync(
+            list(history), SamplingParams(max_new_tokens=6))
+        b, rb = paged_prefix_engine.generate_sync(
+            list(history), SamplingParams(max_new_tokens=6))
+        assert (a, ra) == (b, rb), f"turn {turn}"
+        history.extend(a)
+        history.extend(rng.integers(3, TINY.vocab_size, size=5).tolist())
+
+    st = paged_prefix_engine.stats()["prefix_cache"]
+    assert st["hit_tokens"] > 0, st
+    assert st["pinned_pages"] == 0, st        # all retired -> all unpinned
+
+
+def test_paged_prefix_under_pool_pressure(plain_engine):
+    """A pool barely larger than one request's footprint: eviction must
+    free cached pages for new admissions, and tokens stay exact."""
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    eng = _mk_paged_prefix_engine(pool_pages=20)  # tight: maxp=8 per slot
+    try:
+        rng = np.random.default_rng(29)
+        for i in range(6):
+            prompt = rng.integers(3, TINY.vocab_size, size=30 + i).tolist()
+            a, _ = plain_engine.generate_sync(
+                list(prompt), SamplingParams(max_new_tokens=5))
+            b, _ = eng.generate_sync(
+                list(prompt), SamplingParams(max_new_tokens=5))
+            assert a == b, f"request {i}"
+        al = eng.paged.allocator.stats()
+        assert al["live_slots"] <= 1
+    finally:
+        eng.stop()
