@@ -175,6 +175,14 @@ class ServingService:
         # chunked graph).
         if paged is None:
             paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
+        # ONE prefix-cache enablement flag shared by paged pool sizing and
+        # prefix_fns wiring (review finding: duplicated conditions drift)
+        prefix_enabled = (
+            hasattr(mod, "forward_prefix_pages" if paged
+                    else "forward_prefix_lane")
+            and os.environ.get("SWARMDB_PREFIX", "1") != "0"
+            and seq % page_size == 0
+        )
         chunked_fns = None
         if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
             chunk_fwd = mod.forward_paged_chunked if paged else mod.forward_chunked
@@ -194,9 +202,7 @@ class ServingService:
             if kv_pool_tokens is None and "SWARMDB_KV_POOL_TOKENS" in os.environ:
                 kv_pool_tokens = int(os.environ["SWARMDB_KV_POOL_TOKENS"])
             pool_tokens = kv_pool_tokens or max_batch * maxp * page_size
-            if (kv_pool_tokens is None
-                    and os.environ.get("SWARMDB_PREFIX", "1") != "0"
-                    and seq % page_size == 0):
+            if kv_pool_tokens is None and prefix_enabled:
                 # prefix caching shares this pool: cached pages compete
                 # with slot footprints, so grow the default by the prefix
                 # budget or admissions starve once the cache warms up
@@ -212,26 +218,25 @@ class ServingService:
                 allocator=PageAllocator(num_pages, page_size, seq, max_batch),
             )
 
-        # Automatic prefix caching (dense cache only): chat serving
-        # re-prefills each conversation's history every turn, so reuse of
-        # page-aligned prompt KV is the dominant serve-mode lever (round-4
-        # profile: prefill FLOPs ~15:1 over decode). Default ON for the
-        # dense path; SWARMDB_PREFIX=0 disables, SWARMDB_PREFIX_TOKENS
-        # bounds the pool (HBM ∝ tokens; default max_batch*max_seq/2 —
-        # half the decode cache's footprint, so enabling the feature never
-        # doubles an existing deployment's KV HBM; benches size it up).
+        # Automatic prefix caching: chat serving re-prefills each
+        # conversation's history every turn, so reuse of page-aligned
+        # prompt KV is the dominant serve-mode lever (round-4 profile:
+        # prefill FLOPs ~15:1 over decode). Default ON; SWARMDB_PREFIX=0
+        # disables. DENSE engines keep a side pool (SWARMDB_PREFIX_TOKENS,
+        # default max_batch*max_seq/2 — half the decode cache's footprint,
+        # so enabling the feature never doubles an existing deployment's
+        # KV HBM; benches size it up). PAGED engines reuse the main pool
+        # in place (grown above by the same budget).
         prefix_fns = None
         prefix_pages = 0
-        needed = "forward_prefix_pages" if paged else "forward_prefix_lane"
-        if (hasattr(mod, needed)
-                and os.environ.get("SWARMDB_PREFIX", "1") != "0"
-                and seq % page_size == 0):
+        if prefix_enabled:
             if paged:
                 # paged mode reuses the MAIN pool in place; only the
                 # suffix-forward core is needed (no side pool, no lane)
                 prefix_fns = (
-                    lambda p, t, tab, pl, pk, pv: mod.forward_prefix_pages(
-                        p, cfg, t, tab, pl, pk, pv),
+                    lambda p, t, tab, pl, pk, pv, logits_at=None:
+                        mod.forward_prefix_pages(p, cfg, t, tab, pl, pk, pv,
+                                                 logits_at=logits_at),
                     None,
                 )
             else:
@@ -239,12 +244,19 @@ class ServingService:
                     "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
                 prefix_pages = 1 + -(-prefix_tokens // page_size)  # +1 trash
                 prefix_fns = (
-                    lambda p, t, tab, pl, pk, pv, lp: mod.forward_prefix_lane(
-                        p, cfg, t, tab, pl, pk, pv, lp),
+                    lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+                        mod.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
+                                                lp, logits_at=logits_at),
                     lambda n, ps: mod.init_prefix_pool(cfg, n, ps),
                 )
 
         tokenizer = default_tokenizer(cfg.vocab_size, tokenizer_path)
+        if cfg.is_moe:
+            fwd_last = lambda p, t, pos, c, at: mixtral.forward(
+                p, cfg, t, pos, c, logits_at=at)
+        else:
+            fwd_last = lambda p, t, pos, c, at: llama.forward(
+                p, cfg, t, pos, c, logits_at=at)
         engine = Engine(
             fwd, init_cache, params,
             max_batch=max_batch, max_seq=seq,
@@ -253,7 +265,7 @@ class ServingService:
             prefill_batch=prefill_batch, chunked_fns=chunked_fns,
             pipeline_depth=int(os.environ.get("SWARMDB_PIPELINE", "2")),
             prefix_fns=prefix_fns, prefix_pages=prefix_pages,
-            prefix_page_size=page_size,
+            prefix_page_size=page_size, forward_last_fn=fwd_last,
         )
         return cls(db, engine, tokenizer, backend_id=backend_id)
 

@@ -151,11 +151,19 @@ def forward(
     tokens: jnp.ndarray,       # [B, T] int32
     positions: jnp.ndarray,    # [B, T] int32 absolute positions per row
     cache: KVCache,            # ([L, B, S, Hkv, hd], ...)
+    logits_at: Optional[jnp.ndarray] = None,  # [B] int32 row indices into T
 ) -> Tuple[jnp.ndarray, KVCache]:
-    """One forward pass; returns fp32 logits [B, T, V] and updated cache.
+    """One forward pass; returns fp32 logits and updated cache.
 
     Works for mixed prefill/decode batches: each row's ``positions`` are its
     own absolute offsets, and attention masks by position (ops/layers.py).
+
+    ``logits_at`` computes the LM head ONLY at each row's named position,
+    returning [B, V] instead of [B, T, V] — same math (head columns are
+    per-position independent; only reduction tiling can differ) while
+    skipping the full-bucket fp32 logits the prefill path would otherwise
+    materialize (0.5 GB per admission wave at Bp=16, T=255, V=32k, and
+    ~7% of prefill FLOPs).
     """
     if cfg.is_moe:
         raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral.forward")
@@ -187,6 +195,11 @@ def forward(
     head = params.get("lm_head")
     if head is None:  # tied embeddings
         head = params["embed"].T
+    if logits_at is not None:
+        x = x[jnp.arange(x.shape[0]), logits_at]         # [B, D]
+        logits = jnp.einsum("bd,dv->bv", x, head,
+                            preferred_element_type=jnp.float32)
+        return logits, (new_k, new_v)
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
     return logits, (new_k, new_v)
@@ -200,6 +213,7 @@ def forward_prefix_pages(
     prefix_lens: jnp.ndarray,   # [Bp] int32 reused prefix length (tokens)
     pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D] prefix page pool
     pool_v: jnp.ndarray,
+    logits_at: Optional[jnp.ndarray] = None,  # [B] int32 row indices into T
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefix-cache suffix prefill CORE: compute ONLY the suffix tokens,
     attending each row's reused prefix pages + the suffix itself
@@ -207,7 +221,8 @@ def forward_prefix_pages(
     composes lane images via ops/layers.compose_prefix_lane) and the
     paged path (which scatters the suffix straight into fresh pages).
 
-    Returns (fp32 logits [Bp, T, V], sfx_k, sfx_v [L, Bp, T, Hkv, D]).
+    Returns (fp32 logits [Bp, T, V] — or [Bp, V] with ``logits_at``, see
+    ``forward`` — plus sfx_k, sfx_v [L, Bp, T, Hkv, D]).
     """
     if cfg.is_moe:
         raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral")
@@ -252,6 +267,11 @@ def forward_prefix_pages(
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
+    if logits_at is not None:
+        x = x[jnp.arange(x.shape[0]), logits_at]
+        logits = jnp.einsum("bd,dv->bv", x, head,
+                            preferred_element_type=jnp.float32)
+        return logits, sfx_k, sfx_v
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
     return logits, sfx_k, sfx_v
@@ -266,6 +286,7 @@ def forward_prefix_lane(
     pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D] prefix page pool
     pool_v: jnp.ndarray,
     lane_pages: int,            # static: output lane length in pages
+    logits_at: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Dense-cache prefix prefill: ``forward_prefix_pages`` + per-row lane
     composition (ops/layers.compose_prefix_lane) ready for one uniform
@@ -274,7 +295,8 @@ def forward_prefix_lane(
     from ..ops.layers import compose_prefix_lane
 
     logits, sfx_k, sfx_v = forward_prefix_pages(
-        params, cfg, tokens, prefix_table, prefix_lens, pool_k, pool_v)
+        params, cfg, tokens, prefix_table, prefix_lens, pool_k, pool_v,
+        logits_at=logits_at)
     lane_k, lane_v = compose_prefix_lane(
         pool_k, pool_v, prefix_table, prefix_lens, sfx_k, sfx_v, lane_pages)
     return logits, lane_k, lane_v

@@ -18,7 +18,7 @@ No reference counterpart: the reference has no model code (SURVEY §2.4).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -179,9 +179,11 @@ def forward(
     tokens: jnp.ndarray,
     positions: jnp.ndarray,
     cache: KVCache,
+    logits_at: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Forward pass; same contract as ``llama.forward`` (fp32 logits +
-    updated cache), with per-layer MoE FFN."""
+    updated cache, head-at-last-position via ``logits_at``), with
+    per-layer MoE FFN."""
     if not cfg.is_moe:
         raise ValueError(f"{cfg.name!r} is dense; use models.llama.forward")
     x = params["embed"][tokens]
@@ -214,6 +216,11 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if logits_at is not None:
+        x = x[jnp.arange(x.shape[0]), logits_at]
+        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"],
+                            preferred_element_type=jnp.float32)
+        return logits, (new_k, new_v)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
     return logits, (new_k, new_v)
@@ -237,6 +244,7 @@ def forward_prefix_pages(
     prefix_lens: jnp.ndarray,   # [Bp] int32 reused prefix length (tokens)
     pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D]
     pool_v: jnp.ndarray,
+    logits_at: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefix-cache suffix prefill core (see ``llama.forward_prefix_pages``
     for the design); MoE FFN unchanged. Returns (fp32 logits, sfx_k,
@@ -282,6 +290,11 @@ def forward_prefix_pages(
         (params["layers"], jnp.arange(L, dtype=jnp.int32)),
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if logits_at is not None:
+        x = x[jnp.arange(x.shape[0]), logits_at]
+        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"],
+                            preferred_element_type=jnp.float32)
+        return logits, sfx_k, sfx_v
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
     return logits, sfx_k, sfx_v
@@ -296,13 +309,15 @@ def forward_prefix_lane(
     pool_k: jnp.ndarray,
     pool_v: jnp.ndarray,
     lane_pages: int,
+    logits_at: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Dense-cache prefix prefill: core + shared lane composition (see
     ``llama.forward_prefix_lane``)."""
     from ..ops.layers import compose_prefix_lane
 
     logits, sfx_k, sfx_v = forward_prefix_pages(
-        params, cfg, tokens, prefix_table, prefix_lens, pool_k, pool_v)
+        params, cfg, tokens, prefix_table, prefix_lens, pool_k, pool_v,
+        logits_at=logits_at)
     lane_k, lane_v = compose_prefix_lane(
         pool_k, pool_v, prefix_table, prefix_lens, sfx_k, sfx_v, lane_pages)
     return logits, lane_k, lane_v

@@ -35,6 +35,8 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
+import numpy as np
+
 
 def page_chains(tokens: Sequence[int], page_size: int,
                 max_pages: Optional[int] = None) -> List[bytes]:
@@ -48,10 +50,13 @@ def page_chains(tokens: Sequence[int], page_size: int,
         n_full = min(n_full, max_pages)
     h = hashlib.blake2b(digest_size=16)
     out: List[bytes] = []
+    # one vectorized serialization — this runs per admission on the single
+    # engine thread; a per-int to_bytes loop was ~100x slower on long
+    # prompts (review finding)
+    raw = np.asarray(tokens[: n_full * page_size], dtype="<i4").tobytes()
+    stride = 4 * page_size
     for i in range(n_full):
-        page = tokens[i * page_size: (i + 1) * page_size]
-        h.update(b"".join(int(t).to_bytes(4, "little", signed=True)
-                          for t in page))
+        h.update(raw[i * stride: (i + 1) * stride])
         out.append(h.digest())
     return out
 
@@ -184,9 +189,12 @@ class PrefixLRU:
             return True
 
     def release(self, page_id: int) -> None:
-        """Return a page acquired but never registered (group failed)."""
+        """Return a page acquired but never registered (group failed).
+        In paged mode (manage_free=False) the caller returns the page to
+        the PageAllocator instead — appending here would fork custody."""
         with self._lock:
-            self._free.append(page_id)
+            if self._manage_free:
+                self._free.append(page_id)
 
     # ---------------------------------------------------------------- pinning
 

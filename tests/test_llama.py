@@ -167,3 +167,29 @@ def test_numerics_match_hf_reference():
     ours, _ = llama.forward(params, cfg, jnp.asarray(tokens_np, jnp.int32),
                             positions, cache)
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_logits_at_matches_full_forward():
+    """Head-at-last-position prefill (engine forward_last_fn) matches the
+    full forward's logits at those positions (same math; only reduction
+    tiling may differ -> tight tolerance, not bitwise)."""
+    cfg = get_config("tiny-debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    B, T = 3, 9
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(B, T)),
+                         jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lengths = jnp.asarray([9, 4, 7], jnp.int32)
+
+    full, (ck, cv) = llama.forward(params, cfg, tokens, positions,
+                                   llama.init_kv_cache(cfg, B, T))
+    last, (ck2, cv2) = llama.forward(params, cfg, tokens, positions,
+                                     llama.init_kv_cache(cfg, B, T),
+                                     logits_at=lengths - 1)
+    np.testing.assert_allclose(
+        np.asarray(last),
+        np.asarray(full[jnp.arange(B), lengths - 1]),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_array_equal(np.asarray(ck), np.asarray(ck2))

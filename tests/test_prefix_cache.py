@@ -198,8 +198,9 @@ def _mk_engine(prefix: bool, pool_pages: int = 64):
     if prefix:
         kw = dict(
             prefix_fns=(
-                lambda p, t, tab, pl, pk, pv, lp: llama.forward_prefix_lane(
-                    p, cfg, t, tab, pl, pk, pv, lp),
+                lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+                    llama.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
+                                              lp, logits_at=logits_at),
                 lambda n, ps: llama.init_prefix_pool(cfg, n, ps),
             ),
             prefix_pages=pool_pages,
@@ -368,8 +369,9 @@ def _mk_paged_prefix_engine(pool_pages: int = 64):
                  prefill_buckets=[8, 16, 32, 63], decode_chunk=4,
                  paged=paged_spec, chunked_fns=chunked,
                  prefix_fns=(
-                     lambda p, t, tab, pl, pk, pv: llama.forward_prefix_pages(
-                         p, cfg, t, tab, pl, pk, pv),
+                     lambda p, t, tab, pl, pk, pv, logits_at=None:
+                         llama.forward_prefix_pages(p, cfg, t, tab, pl, pk,
+                                                    pv, logits_at=logits_at),
                      None,
                  ))
     eng.start()
