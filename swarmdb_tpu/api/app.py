@@ -548,6 +548,23 @@ def create_app(
         n = await _run_sync(db.auto_scale_partitions)
         return _json({"status": "scaled", "num_partitions": n})
 
+    async def admin_llm_backend(request: web.Request) -> web.Response:
+        """POST /admin/llm_backend: attach an agent to a generation
+        backend over the wire. The reference keeps assign_llm_backend
+        Python-only (` main.py:1293-1311`) — without this route a deployed
+        server has no way to make an agent LLM-backed at runtime."""
+        require_admin(current_agent(request))
+        body = await request.json()
+        agent_id = body.get("agent_id")
+        backend_id = body.get("backend_id")
+        if not agent_id or not backend_id:
+            raise _error(422, "agent_id and backend_id are required")
+        if not isinstance(agent_id, str) or not isinstance(backend_id, str):
+            raise _error(422, "agent_id and backend_id must be strings")
+        await _run_sync(db.assign_llm_backend, agent_id, backend_id)
+        return _json({"status": "assigned", "agent_id": agent_id,
+                      "backend_id": backend_id})
+
     async def metrics(request: web.Request) -> web.Response:
         """GET /metrics: Prometheus text exposition of the runtime's
         counters/rates/latency percentiles. Unauthenticated by scraper
@@ -739,6 +756,7 @@ def create_app(
         web.post("/admin/flush", admin_flush),
         web.post("/admin/resend_failed", admin_resend),
         web.post("/admin/scale_partitions", admin_scale),
+        web.post("/admin/llm_backend", admin_llm_backend),
         # TPU-build additions (no reference routes)
         web.get("/metrics", metrics),
         web.get("/dashboard", dashboard),

@@ -74,6 +74,7 @@ class _Slot:
     position: int = 0           # next absolute position to write
     generated: List[int] = field(default_factory=list)
     pending_first: bool = False  # prefill token not yet surfaced to host
+    cancelled: bool = False      # retire at the next processed block
     first_token_at: Optional[float] = None
     # device-side next write position: advances by K at each DISPATCH
     # (pipelined chunks are issued before the previous block is read);
@@ -815,6 +816,43 @@ class Engine:
             self._cv.notify_all()
         return request.request_id
 
+    def cancel(self, request_id: str) -> bool:
+        """Stop a request early (client disconnect, stop-sequence match).
+
+        Queued requests are removed immediately (their ``on_done`` fires
+        with reason "cancelled"); an ACTIVE request's slot is flagged and
+        retires when the engine processes its next token block — its lane
+        computes at most one more chunk of garbage, exactly like a natural
+        EOS mid-chunk. Returns False for unknown/finished ids (cancel of a
+        completed request is a no-op, not an error — the races are
+        inherent). Thread-safe."""
+        with self._cv:
+            for i, item in enumerate(self._queue):
+                if item[3].request_id == request_id:
+                    req = item[3]
+                    del self._queue[i]
+                    heapq.heapify(self._queue)
+                    break
+            else:
+                req = None
+            if req is None:
+                for slot in self.slots:
+                    if (slot.active and slot.request is not None
+                            and slot.request.request_id == request_id):
+                        slot.cancelled = True
+                        self.metrics.counters["engine_cancelled"].inc()
+                        return True
+                return False
+        # queued removal: fire completion outside the lock (callbacks may
+        # re-enter submit()/stats())
+        self.metrics.counters["engine_cancelled"].inc()
+        if req.on_done is not None:
+            try:
+                req.on_done(req.request_id, [], "cancelled")
+            except Exception:
+                logger.exception("on_done callback failed")
+        return True
+
     def generate_sync(self, prompt: List[int], sampling: SamplingParams,
                       timeout: float = 120.0) -> Tuple[List[int], str]:
         """Blocking convenience API (tests, benches)."""
@@ -1352,6 +1390,7 @@ class Engine:
             slot.dispatched_position = slot.position
             slot.generated = []
             slot.pending_first = True
+            slot.cancelled = False
             slot.first_token_at = None
             self.total_requests += 1
             # prefill work accounting (bench MFU: prompt tokens cost the
@@ -1413,6 +1452,9 @@ class Engine:
             s = self.slots[i]
             if not s.active or s.request is not req:
                 continue  # retired mid-flight (possibly re-admitted)
+            if s.cancelled:
+                self._retire(i, "cancelled")
+                continue
             if s.pending_first:
                 # row 0 is the fed token == this slot's prefill sample,
                 # which the host deliberately never fetched at admission

@@ -20,12 +20,18 @@ import jax.numpy as jnp
 
 @dataclass(frozen=True)
 class SamplingParams:
-    """Per-request knobs. temperature=0 means greedy (argmax)."""
+    """Per-request knobs. temperature=0 means greedy (argmax).
+
+    ``stop`` is HOST-side: the serving layer watches decoded text, cancels
+    the engine request at the first match, and truncates the reply — the
+    compiled sampler never sees it (string matching has no place in a
+    fixed-shape TPU program)."""
 
     temperature: float = 0.0
     top_k: int = 0        # 0 = disabled
     top_p: float = 1.0    # 1.0 = disabled
     max_new_tokens: int = 128
+    stop: tuple = ()      # stop strings (each ends generation when seen)
 
 
 def make_slot_keys(seed: int, batch: int) -> jnp.ndarray:

@@ -27,7 +27,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,11 +82,16 @@ def sampling_from_message(msg: Message) -> SamplingParams:
     already reserves for annotations, ` main.py:80`)."""
     g = msg.metadata.get("generation", {}) if isinstance(msg.metadata, dict) else {}
     # clamp untrusted wire input to sane ranges
+    raw_stop = g.get("stop", ())
+    if isinstance(raw_stop, str):
+        raw_stop = (raw_stop,)
+    stop = tuple(str(s)[:64] for s in list(raw_stop)[:4] if s)
     return SamplingParams(
         temperature=max(0.0, float(g.get("temperature", 0.0))),
         top_k=max(0, int(g.get("top_k", 0))),
         top_p=min(1.0, max(1e-3, float(g.get("top_p", 1.0)))),
         max_new_tokens=min(4096, max(1, int(g.get("max_new_tokens", 64)))),
+        stop=stop,
     )
 
 
@@ -401,7 +406,33 @@ class ServingService:
         def _done(rid: str, tokens: List[int], reason: str) -> None:
             # engine thread: just hand off — emission runs on _reply_loop
             msg.stage_stamp("done")
-            self._reply_queue.put((msg, rid, tokens, reason, on_done))
+            self._reply_queue.put((msg, rid, tokens, reason, sampling.stop,
+                                   on_done))
+
+        # stop-sequence watch (host-side): keep a bounded tail of decoded
+        # text and CANCEL the engine request at the first match — the
+        # remaining lane work is at most one chunk of discarded garbage.
+        # Final truncation happens at reply emission regardless, so a
+        # match straddling a chunk boundary still yields a clean reply.
+        stop_tail: List[int] = []
+        stop_chars = max((len(s) for s in sampling.stop), default=0)
+        # window in TOKENS: a char is up to 4 UTF-8 bytes and the byte
+        # tokenizer is one token per byte, so a char-sized window could
+        # never match multi-byte stop strings (review finding)
+        stop_window = 4 * stop_chars + 8
+        stop_hit = False
+
+        def _watch_stop(rid: str, token: int) -> None:
+            nonlocal stop_hit
+            if stop_hit:
+                return
+            stop_tail.append(token)
+            if len(stop_tail) > stop_window:
+                del stop_tail[0]
+            text = self.tokenizer.decode(stop_tail)
+            if any(s in text for s in sampling.stop):
+                stop_hit = True
+                self.engine.cancel(rid)
 
         def _tok(rid: str, token: int) -> None:
             if "first_token" not in msg.metadata.get("stages", {}):
@@ -414,6 +445,8 @@ class ServingService:
                     # load (the engine's priority admission, bench swarm100)
                     self.db.metrics.latencies[
                         f"send_to_first_token_prio{priority}_s"].observe(ttft)
+            if sampling.stop:
+                _watch_stop(rid, token)
             if on_token is not None:
                 on_token(rid, token)
 
@@ -430,9 +463,9 @@ class ServingService:
             item = self._reply_queue.get()
             if item is None:
                 return
-            msg, rid, tokens, reason, on_done = item
+            msg, rid, tokens, reason, stop, on_done = item
             try:
-                self._emit_reply(msg, tokens, reason)
+                self._emit_reply(msg, tokens, reason, stop)
             except Exception:
                 logger.exception("failed to emit reply for %s", msg.id)
             if on_done is not None:
@@ -441,8 +474,17 @@ class ServingService:
                 except Exception:
                     logger.exception("on_done callback failed for %s", msg.id)
 
-    def _emit_reply(self, msg: Message, tokens: List[int], reason: str) -> None:
+    def _emit_reply(self, msg: Message, tokens: List[int], reason: str,
+                    stop: tuple = ()) -> None:
         text = self.tokenizer.decode(tokens)
+        if stop:
+            # truncate at the FIRST occurrence of any stop string (the
+            # engine cancel lags by up to a chunk of extra tokens)
+            cut = min((i for i in (text.find(s) for s in stop) if i >= 0),
+                      default=-1)
+            if cut >= 0:
+                text = text[:cut]
+                reason = "stop"
         reply_type = (
             MessageType.FUNCTION_RESULT
             if msg.type == MessageType.FUNCTION_CALL
@@ -483,22 +525,58 @@ class ServingService:
         def on_done(rid: str, tokens: List[int], reason: str) -> None:
             loop.call_soon_threadsafe(q.put_nowait, ("done", reason))
 
-        self.serve_message(msg, on_token=on_token, on_done=on_done)
+        stop = sampling_from_message(msg).stop
+        emitted = ""
+
+        def _guard(piece: str) -> Tuple[str, bool]:
+            """Truncate ``piece`` so the STREAM never shows a stop string
+            (the engine cancel lags by up to a chunk — without this the
+            stream and the stored reply would disagree, review finding).
+            Returns (text to yield, matched)."""
+            nonlocal emitted
+            if not stop:
+                emitted += piece
+                return piece, False
+            candidate = emitted + piece
+            cut = min((i for i in (candidate.find(s) for s in stop)
+                       if i >= 0), default=-1)
+            if cut < 0:
+                emitted = candidate
+                return piece, False
+            # a match can only END in the new piece (earlier pieces were
+            # checked before being emitted), so cut >= len(emitted) holds
+            keep = candidate[len(emitted):cut]
+            emitted = candidate[:cut]
+            return keep, True
+
+        rid = self.serve_message(msg, on_token=on_token, on_done=on_done)
         pending: List[int] = []
-        while True:
-            kind, value = await q.get()
-            if kind == "token":
-                pending.append(value)
-                # decode greedily; UTF-8 continuation bytes may be incomplete,
-                # so flush only when decode round-trips cleanly
-                text = self.tokenizer.decode(pending)
-                if text and not text.endswith("�"):
-                    yield text
-                    pending = []
-            else:
-                if pending:
-                    yield self.tokenizer.decode(pending)
-                return
+        try:
+            while True:
+                kind, value = await q.get()
+                if kind == "token":
+                    pending.append(value)
+                    # decode greedily; UTF-8 continuation bytes may be
+                    # incomplete, so flush only when decode round-trips
+                    text = self.tokenizer.decode(pending)
+                    if text and not text.endswith("�"):
+                        out, matched = _guard(text)
+                        if out:
+                            yield out
+                        if matched:
+                            return
+                        pending = []
+                else:
+                    if pending:
+                        out, _ = _guard(self.tokenizer.decode(pending))
+                        if out:
+                            yield out
+                    return
+        finally:
+            # client disconnect closes this generator mid-stream: stop the
+            # generation instead of burning the slot to max_new_tokens
+            # (no-op if the request already finished)
+            self.engine.cancel(rid)
 
     async def stream_group(self, msgs: List[Message]) -> AsyncIterator[Dict[str, Any]]:
         """Fan-out streaming: serve every group message concurrently (they
@@ -507,37 +585,59 @@ class ServingService:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         remaining = 0
+        rids: List[str] = []
 
-        for msg in msgs:
-            if msg is None:
-                continue
-            remaining += 1
+        try:
+            # submit INSIDE the try: if a later member's submit raises,
+            # the finally still cancels the already-running ones (review
+            # finding — otherwise they'd decode to max_new_tokens with no
+            # consumer)
+            for msg in msgs:
+                if msg is None:
+                    continue
+                remaining += 1
+                stop = sampling_from_message(msg).stop
 
-            def mk(msg_id: str):
-                def on_token(rid: str, token: int) -> None:
-                    loop.call_soon_threadsafe(
-                        q.put_nowait,
-                        {"event": "token", "message_id": msg_id, "token": token},
-                    )
+                def mk(msg_id: str, stop: tuple):
+                    def on_token(rid: str, token: int) -> None:
+                        loop.call_soon_threadsafe(
+                            q.put_nowait,
+                            {"event": "token", "message_id": msg_id,
+                             "token": token},
+                        )
 
-                def on_done(rid: str, tokens: List[int], reason: str) -> None:
-                    loop.call_soon_threadsafe(
-                        q.put_nowait,
-                        {"event": "reply_done", "message_id": msg_id,
-                         "finish_reason": reason,
-                         "text": self.tokenizer.decode(tokens)},
-                    )
+                    def on_done(rid: str, tokens: List[int],
+                                reason: str) -> None:
+                        # mirror _emit_reply's stop truncation so the
+                        # stream's final text and the stored reply agree
+                        text = self.tokenizer.decode(tokens)
+                        if stop:
+                            cut = min((i for i in (text.find(s)
+                                                   for s in stop)
+                                       if i >= 0), default=-1)
+                            if cut >= 0:
+                                text = text[:cut]
+                                reason = "stop"
+                        loop.call_soon_threadsafe(
+                            q.put_nowait,
+                            {"event": "reply_done", "message_id": msg_id,
+                             "finish_reason": reason, "text": text},
+                        )
 
-                return on_token, on_done
+                    return on_token, on_done
 
-            on_token, on_done = mk(msg.id)
-            self.serve_message(msg, on_token=on_token, on_done=on_done)
+                on_token, on_done = mk(msg.id, stop)
+                rids.append(self.serve_message(msg, on_token=on_token,
+                                               on_done=on_done))
 
-        while remaining > 0:
-            item = await q.get()
-            if item.get("event") == "reply_done":
-                remaining -= 1
-            yield item
+            while remaining > 0:
+                item = await q.get()
+                if item.get("event") == "reply_done":
+                    remaining -= 1
+                yield item
+        finally:
+            for rid in rids:  # client disconnect: stop all fan-out members
+                self.engine.cancel(rid)
 
     # --------------------------------------------------------------- health
 

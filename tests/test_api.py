@@ -606,3 +606,31 @@ def test_worker_recycling_hook(tmp_path):
 
     api_drive(drive, tmp_path, config=cfg,
               on_max_requests=lambda: fired.append(1))
+
+
+def test_admin_llm_backend_route(tmp_path):
+    """POST /admin/llm_backend wires an agent to a backend over the wire
+    (the reference keeps assign_llm_backend Python-only)."""
+    async def drive(client, db):
+        admin = await get_token(client, "admin")
+        user = await get_token(client, "someone")
+        r = await client.post("/agents/register", json={"agent_id": "bot"},
+                              headers=admin)
+        assert r.status == 200
+        # non-admin refused
+        r = await client.post("/admin/llm_backend",
+                              json={"agent_id": "bot", "backend_id": "tpu-0"},
+                              headers=user)
+        assert r.status == 403
+        # missing fields rejected
+        r = await client.post("/admin/llm_backend", json={"agent_id": "bot"},
+                              headers=admin)
+        assert r.status == 422
+        r = await client.post("/admin/llm_backend",
+                              json={"agent_id": "bot", "backend_id": "tpu-0"},
+                              headers=admin)
+        assert r.status == 200
+        assert db.get_llm_backend("bot") == "tpu-0"
+        assert db.agents_for_backend("tpu-0") == ["bot"]
+
+    api_drive(drive, tmp_path)

@@ -1,0 +1,214 @@
+"""Request cancellation + stop sequences (serving-API parity features).
+
+Reference counterpart: none — the reference never dispatches generation at
+all (SURVEY §3.2); these match the de-facto serving API surface (client
+disconnects must stop burning decode slots; ``stop`` strings end a
+completion early and truncate the reply).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from swarmdb_tpu.backend.engine import Engine, GenRequest
+from swarmdb_tpu.backend.sampling import SamplingParams
+from swarmdb_tpu.models import llama
+from swarmdb_tpu.models.configs import get_config
+
+TINY = get_config("tiny-debug")
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+    init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+    eng = Engine(fwd, init_cache, params, max_batch=2, max_seq=128,
+                 eos_id=-1, seed=0, prefill_buckets=[16, 32, 127],
+                 decode_chunk=4)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_cancel_active_request(engine):
+    """Cancelling an in-flight request fires on_done('cancelled') promptly
+    instead of running to max_new_tokens."""
+    done = threading.Event()
+    result = {}
+
+    def on_done(rid, toks, reason):
+        result["reason"] = reason
+        result["n"] = len(toks)
+        done.set()
+
+    got_first = threading.Event()
+
+    def on_token(rid, tok):
+        got_first.set()
+
+    rid = engine.submit(GenRequest(
+        prompt=[5, 6, 7], sampling=SamplingParams(max_new_tokens=4096),
+        on_token=on_token, on_done=on_done))
+    assert got_first.wait(timeout=60)
+    assert engine.cancel(rid) is True
+    assert done.wait(timeout=60)
+    assert result["reason"] == "cancelled"
+    assert result["n"] < 4096
+
+
+def test_cancel_queued_request(engine):
+    """A request still in the queue is removed immediately."""
+    # fill both slots with long generations so the third stays queued
+    blockers = []
+    for _ in range(2):
+        ev = threading.Event()
+        blockers.append(ev)
+        engine.submit(GenRequest(
+            prompt=[1, 2], sampling=SamplingParams(max_new_tokens=2000),
+            on_done=lambda r, t, x, ev=ev: ev.set()))
+    done = threading.Event()
+    result = {}
+
+    def on_done(rid, toks, reason):
+        result["reason"] = reason
+        done.set()
+
+    queued = GenRequest(prompt=[3, 4],
+                        sampling=SamplingParams(max_new_tokens=10),
+                        on_done=on_done)
+    engine.submit(queued)
+    assert engine.cancel(queued.request_id) is True
+    assert done.wait(timeout=10)
+    assert result["reason"] == "cancelled"
+    # unknown id -> False
+    assert engine.cancel("nope") is False
+    # unblock the slots
+    for s in engine.slots:
+        if s.active:
+            s.cancelled = True
+    for ev in blockers:
+        assert ev.wait(timeout=60)
+
+
+def test_stop_sequence_truncates_reply(tmp_path):
+    """ServingService: a stop string ends generation early and the reply
+    text is truncated before it."""
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.backend.service import ServingService
+
+    db = SwarmDB(save_dir=str(tmp_path), autosave_interval=1e9)
+    db.register_agent("u")
+    db.register_agent("bot")
+    db.assign_llm_backend("bot", "tpu-0")
+    svc = ServingService.from_model_name(
+        db, "tiny-debug", backend_id="tpu-0", max_batch=2, max_seq=128,
+        decode_chunk=4)
+    svc.start(warmup=False)
+    try:
+        # first: an unconstrained reply to learn what the model emits
+        mid = db.send_message("u", "bot", "hello",
+                              metadata={"generation": {
+                                  "max_new_tokens": 24,
+                                  "temperature": 0.0}})
+        free = None
+        deadline = time.time() + 120
+        while time.time() < deadline and free is None:
+            for m in db.receive_messages("u", timeout=0.5):
+                if m.metadata.get("reply_to") == mid:
+                    free = m
+        assert free is not None
+        full_text = free.content
+        assert len(full_text) > 2
+        stop = full_text[1:3]  # a substring the model WILL generate again
+
+        db2 = SwarmDB(save_dir=str(tmp_path / "2"), autosave_interval=1e9)
+        db2.register_agent("u")
+        db2.register_agent("bot")
+        db2.assign_llm_backend("bot", "tpu-0")
+        svc2 = ServingService(db2, svc.engine, svc.tokenizer,
+                              backend_id="tpu-0")
+        svc2.start(warmup=False)
+        try:
+            mid2 = db2.send_message("u", "bot", "hello",
+                                    metadata={"generation": {
+                                        "max_new_tokens": 24,
+                                        "temperature": 0.0,
+                                        "stop": [stop]}})
+            got = None
+            deadline = time.time() + 120
+            while time.time() < deadline and got is None:
+                for m in db2.receive_messages("u", timeout=0.5):
+                    if m.metadata.get("reply_to") == mid2:
+                        got = m
+            assert got is not None
+            assert stop not in got.content
+            assert got.metadata["finish_reason"] == "stop"
+            assert got.content == full_text[:full_text.find(stop)]
+        finally:
+            svc2.stop()
+            db2.close()
+    finally:
+        svc.stop()
+        db.close()
+
+
+def test_stream_reply_truncates_at_stop(tmp_path):
+    """The SSE stream itself never shows post-stop text (the stored reply
+    and the stream agree)."""
+    import asyncio
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.core.messages import Message, MessageType
+    from swarmdb_tpu.backend.service import ServingService
+
+    db = SwarmDB(save_dir=str(tmp_path), autosave_interval=1e9)
+    db.register_agent("u")
+    db.register_agent("bot")
+    db.assign_llm_backend("bot", "tpu-0")
+    svc = ServingService.from_model_name(
+        db, "tiny-debug", backend_id="tpu-0", max_batch=2, max_seq=128,
+        decode_chunk=4)
+    svc.start(warmup=False)
+    try:
+        async def stream(service, gen_meta):
+            msg = Message(sender_id="u", receiver_id="bot",
+                          content="stream stop test",
+                          type=MessageType.CHAT,
+                          metadata={"generation": gen_meta})
+            msg.stage_stamp("enqueued")
+            out = []
+            async for piece in service.stream_reply(msg):
+                out.append(piece)
+            return "".join(out)
+
+        free = asyncio.run(stream(svc, {"max_new_tokens": 24,
+                                        "temperature": 0.0}))
+        assert len(free) > 2
+        stop = free[1:3]
+        # fresh db (the first reply joined the conversation history) but
+        # the SAME engine/tokenizer -> byte-identical prompt
+        db2 = SwarmDB(save_dir=str(tmp_path / "2"), autosave_interval=1e9)
+        db2.register_agent("u")
+        db2.register_agent("bot")
+        db2.assign_llm_backend("bot", "tpu-0")
+        svc2 = ServingService(db2, svc.engine, svc.tokenizer,
+                              backend_id="tpu-0")
+        svc2.start(warmup=False)
+        try:
+            constrained = asyncio.run(stream(svc2, {"max_new_tokens": 24,
+                                                    "temperature": 0.0,
+                                                    "stop": [stop]}))
+            assert stop not in constrained
+            assert constrained == free[:free.find(stop)]
+        finally:
+            svc2.stop()
+            db2.close()
+    finally:
+        svc.stop()
+        db.close()
