@@ -554,16 +554,15 @@ def create_app(
         Python-only (` main.py:1293-1311`) — without this route a deployed
         server has no way to make an agent LLM-backed at runtime."""
         require_admin(current_agent(request))
-        body = await request.json()
-        agent_id = body.get("agent_id")
-        backend_id = body.get("backend_id")
-        if not agent_id or not backend_id:
-            raise _error(422, "agent_id and backend_id are required")
-        if not isinstance(agent_id, str) or not isinstance(backend_id, str):
-            raise _error(422, "agent_id and backend_id must be strings")
-        await _run_sync(db.assign_llm_backend, agent_id, backend_id)
-        return _json({"status": "assigned", "agent_id": agent_id,
-                      "backend_id": backend_id})
+        req = await _parse(request, schemas.LlmBackendRequest)
+        if not req.agent_id or not req.backend_id:
+            raise _error(422, "agent_id and backend_id must be non-empty")
+        known = await _run_sync(lambda: req.agent_id in db.registered_agents)
+        if not known:
+            raise _error(404, f"agent {req.agent_id} not registered")
+        await _run_sync(db.assign_llm_backend, req.agent_id, req.backend_id)
+        return _json({"status": "assigned", "agent_id": req.agent_id,
+                      "backend_id": req.backend_id})
 
     async def metrics(request: web.Request) -> web.Response:
         """GET /metrics: Prometheus text exposition of the runtime's

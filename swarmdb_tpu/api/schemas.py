@@ -76,6 +76,11 @@ class BroadcastResponse(BaseModel):
     message_id: str
 
 
+class LlmBackendRequest(BaseModel):
+    agent_id: str
+    backend_id: str
+
+
 class AgentRegistrationRequest(BaseModel):
     agent_id: str
     description: Optional[str] = None

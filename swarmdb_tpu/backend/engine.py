@@ -168,7 +168,14 @@ class Engine:
         # array from the host is an eager dispatch per admission (and on
         # the tunneled TPU of this image every eager round-trip is ~ms);
         # numpy fancy-indexing is free and the result rides the jit call
-        self._base_keys_np = np.asarray(self.base_keys)
+        # WRITABLE host copy (np.asarray of a device array is read-only):
+        # per-request seeds rewrite rows in place
+        self._base_keys_np = np.array(self.base_keys)
+        # pristine per-slot keys: a request with an explicit seed rewrites
+        # its slot's row for its lifetime; the next occupant without one
+        # restores the default (reproducible replays either way — the
+        # per-step key is fold_in(row, absolute position))
+        self._default_keys_np = self._base_keys_np.copy()
         self.slots = [_Slot() for _ in range(max_batch)]
         # device-resident fed-token vector: slot i's next input token lives
         # here between chunks so decode->decode and prefill->decode handoffs
@@ -198,6 +205,13 @@ class Engine:
         self._topp = np.ones(max_batch, np.float32)
 
         self._queue: List[Tuple[int, float, int, GenRequest]] = []  # heap
+        # requests popped from the queue but not yet activated into slots
+        # (prefill in flight): cancel() can neither find them queued nor
+        # active, so it flags them here and _activate retires them at the
+        # next processed block (review finding — a disconnect during a
+        # first-bucket compile otherwise orphans the request)
+        self._admitting: set = set()
+        self._cancel_pending: set = set()
         self._tiebreak = itertools.count()
         self._cv = threading.Condition()
         self._stop = False
@@ -583,8 +597,9 @@ class Engine:
             lambda: jnp.zeros((B,), jnp.int32), out_shardings=rep)()
         self.base_keys = jax.jit(
             lambda: make_slot_keys(self._seed, B), out_shardings=rep)()
-        self._base_keys_np = np.asarray(
+        self._base_keys_np = np.array(
             jax.device_get(self.base_keys))
+        self._default_keys_np = self._base_keys_np.copy()
 
     def enable_multihost(self) -> None:
         """Publish every device call to worker hosts (coordinator side).
@@ -629,11 +644,11 @@ class Engine:
             if op == mh.OP_STOP:
                 return
             if op == mh.OP_DECODE:
-                variant, positions, temp, topk, topp = args
+                variant, positions, keys, temp, topk, topp = args
                 fn = self._decode_variants[variant]
                 all_toks, self._last_tokens, self.cache = fn(
                     self.params, self._last_tokens, positions, self.cache,
-                    self.base_keys, temp, topk, topp,
+                    keys, temp, topk, topp,
                 )
             elif op == mh.OP_PREFILL:
                 tokens, lengths, scatter, keys, temp, topk, topp = args
@@ -706,11 +721,12 @@ class Engine:
         positions = np.zeros((self.max_batch,), np.int32)
         for variant, decode in enumerate(self._decode_variants):
             if self._mh is not None:
-                self._mh.publish_decode(variant, positions, self._temp,
+                self._mh.publish_decode(variant, positions,
+                                        self._base_keys_np, self._temp,
                                         self._topk, self._topp)
             all_toks, self._last_tokens, self.cache = decode(
                 self.params, self._last_tokens, positions, self.cache,
-                self.base_keys, self._temp, self._topk, self._topp,
+                self._base_keys_np, self._temp, self._topk, self._topp,
             )
             jax.block_until_ready(all_toks)
 
@@ -836,6 +852,12 @@ class Engine:
             else:
                 req = None
             if req is None:
+                if request_id in self._admitting:
+                    # popped but not yet activated (prefill in flight, can
+                    # take seconds on a cold compile): flag for _activate
+                    self._cancel_pending.add(request_id)
+                    self.metrics.counters["engine_cancelled"].inc()
+                    return True
                 for slot in self.slots:
                     if (slot.active and slot.request is not None
                             and slot.request.request_id == request_id):
@@ -1004,6 +1026,7 @@ class Engine:
                             self._prefix.unpin(hits) if hits else None
                             break  # pool exhausted; retry after retirements
                         heapq.heappop(self._queue)
+                        self._admitting.add(req.request_id)
                         popped.append(req)
                         rows.append((slot_id, row))
                         if use_pp and len(req.prompt) >= self._prefix_ps:
@@ -1012,6 +1035,7 @@ class Engine:
                         return
                 else:
                     popped = [heapq.heappop(self._queue)[3] for _ in range(take)]
+                    self._admitting.update(r.request_id for r in popped)
             if self.paged and rows:
                 from ..ops.paged_kv import set_page_table_rows
 
@@ -1089,6 +1113,9 @@ class Engine:
                         raise
                     for item in batch:
                         slot_id, req = item[0], item[1]
+                        with self._cv:
+                            self._admitting.discard(req.request_id)
+                            self._cancel_pending.discard(req.request_id)
                         if self.paged:
                             # release the slot's pages or the next occupant's
                             # allocate() raises "already holds pages" and the
@@ -1107,6 +1134,16 @@ class Engine:
             if n <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    def _set_slot_key(self, slot_id: int, seed) -> None:
+        """Per-request PRNG seed: rewrite the slot's key row (host array;
+        the keys ride every dispatch as a numpy argument, so this costs
+        nothing on device). None restores the engine-default slot key."""
+        if seed is None:
+            self._base_keys_np[slot_id] = self._default_keys_np[slot_id]
+        else:
+            s = int(seed) & 0xFFFFFFFFFFFFFFFF
+            self._base_keys_np[slot_id] = (s >> 32, s & 0xFFFFFFFF)
 
     # ------------------------------------------------------- prefix caching
 
@@ -1191,6 +1228,7 @@ class Engine:
             self._temp[slot_id] = s.temperature
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
+            self._set_slot_key(slot_id, s.seed)
             n_full = len(prompt) // ps
             for page_idx in range(len(hits), n_full):
                 f = page_idx - len(hits)
@@ -1261,6 +1299,7 @@ class Engine:
             self._temp[slot_id] = s.temperature
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
+            self._set_slot_key(slot_id, s.seed)
             # register the prompt's fresh FULL pages (their lane content is
             # final — decode writes start at len(prompt), past them)
             n_full = len(prompt) // ps
@@ -1328,6 +1367,7 @@ class Engine:
             self._temp[slot_id] = s.temperature
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
+            self._set_slot_key(slot_id, s.seed)
 
         if not self.paged:
             # ONE dispatch: forward + sample + slot insert + token scatter.
@@ -1390,7 +1430,12 @@ class Engine:
             slot.dispatched_position = slot.position
             slot.generated = []
             slot.pending_first = True
-            slot.cancelled = False
+            with self._cv:
+                self._admitting.discard(req.request_id)
+                # cancelled while the prefill was in flight: retire at the
+                # next processed block
+                slot.cancelled = req.request_id in self._cancel_pending
+                self._cancel_pending.discard(req.request_id)
             slot.first_token_at = None
             self.total_requests += 1
             # prefill work accounting (bench MFU: prompt tokens cost the
@@ -1428,11 +1473,14 @@ class Engine:
         variant = (0 if needs_filters else 1 if needs_sampling else 2)
         decode = self._decode_variants[variant]
         if self._mh is not None:
-            self._mh.publish_decode(variant, positions, self._temp,
-                                    self._topk, self._topp)
+            self._mh.publish_decode(variant, positions, self._base_keys_np,
+                                    self._temp, self._topk, self._topp)
+        # keys ride as a raw [B, 2] numpy argument (like temp/topk/topp):
+        # per-REQUEST seeds just rewrite a host row at admission, with no
+        # graph change and no eager transfer
         all_toks, self._last_tokens, self.cache = decode(
             self.params, self._last_tokens, positions,
-            self.cache, self.base_keys,
+            self.cache, self._base_keys_np,
             self._temp, self._topk, self._topp,
         )
         return all_toks, snapshot
@@ -1530,6 +1578,8 @@ class Engine:
         with self._cv:
             pending = [item[3] for item in self._queue]
             self._queue.clear()
+            self._admitting.clear()
+            self._cancel_pending.clear()
         for req in pending:
             if req.on_done is not None:
                 try:

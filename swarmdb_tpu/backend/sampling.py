@@ -32,6 +32,7 @@ class SamplingParams:
     top_p: float = 1.0    # 1.0 = disabled
     max_new_tokens: int = 128
     stop: tuple = ()      # stop strings (each ends generation when seen)
+    seed: "int | None" = None  # per-request PRNG seed (None = engine default)
 
 
 def make_slot_keys(seed: int, batch: int) -> jnp.ndarray:

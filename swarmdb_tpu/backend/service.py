@@ -86,12 +86,14 @@ def sampling_from_message(msg: Message) -> SamplingParams:
     if isinstance(raw_stop, str):
         raw_stop = (raw_stop,)
     stop = tuple(str(s)[:64] for s in list(raw_stop)[:4] if s)
+    seed = g.get("seed")
     return SamplingParams(
         temperature=max(0.0, float(g.get("temperature", 0.0))),
         top_k=max(0, int(g.get("top_k", 0))),
         top_p=min(1.0, max(1e-3, float(g.get("top_p", 1.0)))),
         max_new_tokens=min(4096, max(1, int(g.get("max_new_tokens", 64)))),
         stop=stop,
+        seed=int(seed) if seed is not None else None,
     )
 
 
@@ -526,28 +528,36 @@ class ServingService:
             loop.call_soon_threadsafe(q.put_nowait, ("done", reason))
 
         stop = sampling_from_message(msg).stop
-        emitted = ""
+        held = ""  # seen but not yet released (possible stop-match prefix)
 
-        def _guard(piece: str) -> Tuple[str, bool]:
-            """Truncate ``piece`` so the STREAM never shows a stop string
-            (the engine cancel lags by up to a chunk — without this the
-            stream and the stored reply would disagree, review finding).
-            Returns (text to yield, matched)."""
-            nonlocal emitted
+        def _guard(piece: str, flush: bool = False) -> Tuple[str, bool]:
+            """Release text so the STREAM never shows a stop string (the
+            engine cancel lags by up to a chunk — without this the stream
+            and the stored reply would disagree). Any released suffix that
+            could still begin a stop match is HELD BACK until disproven —
+            a match straddling two pieces must never leak its first half
+            (review finding). Returns (text to yield, matched)."""
+            nonlocal held
             if not stop:
-                emitted += piece
                 return piece, False
-            candidate = emitted + piece
-            cut = min((i for i in (candidate.find(s) for s in stop)
-                       if i >= 0), default=-1)
-            if cut < 0:
-                emitted = candidate
-                return piece, False
-            # a match can only END in the new piece (earlier pieces were
-            # checked before being emitted), so cut >= len(emitted) holds
-            keep = candidate[len(emitted):cut]
-            emitted = candidate[:cut]
-            return keep, True
+            buf = held + piece
+            cut = min((i for i in (buf.find(s) for s in stop) if i >= 0),
+                      default=-1)
+            if cut >= 0:
+                held = ""
+                return buf[:cut], True
+            if flush:
+                held = ""
+                return buf, False
+            # longest suffix of buf that is a proper prefix of any stop
+            hold = 0
+            for s in stop:
+                for n in range(min(len(s) - 1, len(buf)), hold, -1):
+                    if buf.endswith(s[:n]):
+                        hold = n
+                        break
+            held = buf[len(buf) - hold:] if hold else ""
+            return buf[:len(buf) - hold], False
 
         rid = self.serve_message(msg, on_token=on_token, on_done=on_done)
         pending: List[int] = []
@@ -567,10 +577,10 @@ class ServingService:
                             return
                         pending = []
                 else:
-                    if pending:
-                        out, _ = _guard(self.tokenizer.decode(pending))
-                        if out:
-                            yield out
+                    tail = self.tokenizer.decode(pending) if pending else ""
+                    out, _ = _guard(tail, flush=True)
+                    if out:
+                        yield out
                     return
         finally:
             # client disconnect closes this generator mid-stream: stop the

@@ -68,11 +68,12 @@ class ControlPlane:
     # ---------------------------------------------------------- coordinator
 
     def publish_decode(self, variant: int, positions: np.ndarray,
-                       temp: np.ndarray, topk: np.ndarray,
-                       topp: np.ndarray) -> None:
+                       keys: np.ndarray, temp: np.ndarray,
+                       topk: np.ndarray, topp: np.ndarray) -> None:
         _broadcast(np.asarray([OP_DECODE, variant, 0, 0], np.int64))
-        _broadcast((positions.astype(np.int32), temp.astype(np.float32),
-                    topk.astype(np.int32), topp.astype(np.float32)))
+        _broadcast((positions.astype(np.int32), keys.astype(np.uint32),
+                    temp.astype(np.float32), topk.astype(np.int32),
+                    topp.astype(np.float32)))
 
     def publish_prefill(self, tokens: np.ndarray, lengths: np.ndarray,
                         scatter: np.ndarray, keys: np.ndarray,
@@ -99,8 +100,9 @@ class ControlPlane:
         B, Bp = self.max_batch, self.prefill_batch
         if op == OP_DECODE:
             args = _broadcast((
-                np.zeros(B, np.int32), np.zeros(B, np.float32),
-                np.zeros(B, np.int32), np.zeros(B, np.float32),
+                np.zeros(B, np.int32), np.zeros((B, 2), np.uint32),
+                np.zeros(B, np.float32), np.zeros(B, np.int32),
+                np.zeros(B, np.float32),
             ))
             return op, [int(header[1]), *[np.asarray(a) for a in args]]
         if op == OP_PREFILL:

@@ -626,6 +626,16 @@ def test_admin_llm_backend_route(tmp_path):
         r = await client.post("/admin/llm_backend", json={"agent_id": "bot"},
                               headers=admin)
         assert r.status == 422
+        # malformed body -> 400, not 500
+        r = await client.post("/admin/llm_backend", data=b"not json",
+                              headers={**admin,
+                                       "Content-Type": "application/json"})
+        assert r.status == 400
+        # unknown agent -> 404
+        r = await client.post("/admin/llm_backend",
+                              json={"agent_id": "ghost", "backend_id": "t"},
+                              headers=admin)
+        assert r.status == 404
         r = await client.post("/admin/llm_backend",
                               json={"agent_id": "bot", "backend_id": "tpu-0"},
                               headers=admin)

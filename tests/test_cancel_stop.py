@@ -212,3 +212,19 @@ def test_stream_reply_truncates_at_stop(tmp_path):
     finally:
         svc.stop()
         db.close()
+
+
+def test_per_request_seed(engine):
+    """Explicit seed: reproducible across requests/slots; absent seed
+    restores the slot's default key."""
+    sp = lambda **kw: SamplingParams(max_new_tokens=8, temperature=0.9,
+                                     **kw)
+    prompt = [11, 12, 13, 14]
+    base1, _ = engine.generate_sync(list(prompt), sp())
+    seeded1, _ = engine.generate_sync(list(prompt), sp(seed=1234))
+    seeded2, _ = engine.generate_sync(list(prompt), sp(seed=1234))
+    other, _ = engine.generate_sync(list(prompt), sp(seed=99))
+    base2, _ = engine.generate_sync(list(prompt), sp())
+    assert seeded1 == seeded2                 # reproducible
+    assert seeded1 != other                   # seed actually keys the draw
+    assert base1 == base2                     # default key restored
