@@ -48,7 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.metrics import MetricsRegistry
-from .sampling import SamplingParams, make_slot_keys, sample_tokens
+from .sampling import (SamplingParams, make_slot_keys,
+                       sample_tokens, token_logprob)
 
 logger = logging.getLogger("swarmdb_tpu.engine")
 
@@ -73,6 +74,7 @@ class _Slot:
     request: Optional[GenRequest] = None
     position: int = 0           # next absolute position to write
     generated: List[int] = field(default_factory=list)
+    logprobs: List[float] = field(default_factory=list)  # parallel to generated
     pending_first: bool = False  # prefill token not yet surfaced to host
     cancelled: bool = False      # retire at the next processed block
     first_token_at: Optional[float] = None
@@ -181,6 +183,8 @@ class Engine:
         # here between chunks so decode->decode and prefill->decode handoffs
         # never touch the host
         self._last_tokens = jnp.zeros((max_batch,), jnp.int32)
+        # raw-model logprob of each slot's fed token (same lifecycle)
+        self._last_lps = jnp.zeros((max_batch,), jnp.float32)
 
         if prefill_buckets is None:
             prefill_buckets = [
@@ -217,7 +221,7 @@ class Engine:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
 
-        donate = (3,) if donate_cache else ()
+        donate = (4,) if donate_cache else ()
         K = self.decode_chunk
 
         # ---- compiled chunk: K decode steps per host round-trip -----------
@@ -236,9 +240,17 @@ class Engine:
         # - fallback (chunked_fns=None): per-step cache threading.
         self._chunked_fns = chunked_fns
 
-        def _decode(params, last_tokens, positions, cache, base_keys, temp,
-                    topk, topp, *, use_filters, assume_greedy=False):
-            # last_tokens [B] fed tokens, positions [B] next write positions
+        def _decode(params, last_tokens, last_lps, positions, cache,
+                    base_keys, temp, topk, topp, *, use_filters,
+                    assume_greedy=False):
+            # last_tokens [B] fed tokens, last_lps [B] their raw-model
+            # logprobs (computed where they were sampled — prefill or the
+            # previous chunk), positions [B] next write positions.
+            # Logprobs are computed UNCONDITIONALLY: the per-step
+            # log_softmax is ~0.3% of a measured decode chunk and the
+            # extra host block is 8 KB/chunk, while gating it would double
+            # the compiled variant count (each 10-80 s over this image's
+            # tunneled compile path) for a flag most requests leave off.
             if self._chunked_fns is not None:
                 chunk_fwd, init_chunk, merge_chunk = self._chunked_fns
                 chunk_kv = init_chunk(self.max_batch, K)
@@ -252,15 +264,17 @@ class Engine:
                     nxt = sample_tokens(logits[:, -1], base_keys, pos, temp,
                                         topk, topp, use_filters=use_filters,
                                         assume_greedy=assume_greedy)
-                    return (nxt, pos + 1, chunk_kv), nxt
+                    lp = token_logprob(logits[:, -1], nxt)
+                    return (nxt, pos + 1, chunk_kv), (nxt, lp)
 
-                (last, _, chunk_kv), sampled = jax.lax.scan(
+                (last, _, chunk_kv), (sampled, lps) = jax.lax.scan(
                     body, (last_tokens, positions, chunk_kv),
                     jnp.arange(K, dtype=jnp.int32),
                 )
                 new_cache = merge_chunk(cache, chunk_kv, positions)
                 all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
-                return all_toks, last, new_cache
+                all_lps = jnp.concatenate([last_lps[None], lps], axis=0)
+                return all_toks, all_lps, last, lps[-1], new_cache
 
             def body(carry, _):
                 tok, pos, cache = carry
@@ -270,15 +284,17 @@ class Engine:
                 nxt = sample_tokens(logits[:, -1], base_keys, pos, temp,
                                     topk, topp, use_filters=use_filters,
                                     assume_greedy=assume_greedy)
-                return (nxt, pos + 1, cache), nxt
+                lp = token_logprob(logits[:, -1], nxt)
+                return (nxt, pos + 1, cache), (nxt, lp)
 
-            (last, _, cache), sampled = jax.lax.scan(
+            (last, _, cache), (sampled, lps) = jax.lax.scan(
                 body, (last_tokens, positions, cache), None, length=K
             )
             # row 0 = the fed tokens (surfaces prefill samples the host has
             # never seen); rows 1..K = this chunk's samples
             all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
-            return all_toks, last, cache
+            all_lps = jnp.concatenate([last_lps[None], lps], axis=0)
+            return all_toks, all_lps, last, lps[-1], cache
 
         self._decode = jax.jit(
             functools.partial(_decode, use_filters=True),
@@ -331,7 +347,8 @@ class Engine:
         self._forward_last_of = _forward_last_of
 
         def _prefill_insert(params, tokens, lengths, slot_ids, cache,
-                            last_tokens, base_keys, temp, topk, topp):
+                            last_tokens, last_lps, base_keys, temp, topk,
+                            topp):
             Bp, T = tokens.shape
             positions = jnp.broadcast_to(
                 jnp.arange(T, dtype=jnp.int32)[None], (Bp, T)
@@ -342,15 +359,18 @@ class Engine:
             next_tok = sample_tokens(
                 last, base_keys, lengths - 1, temp, topk, topp
             )
+            lp = token_logprob(last, next_tok)
             cache = jax.tree.map(
                 lambda full, fresh: full.at[:, slot_ids, :T].set(
                     fresh, mode="drop"),
                 cache, cacheB,
             )
             last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
-            return cache, last_tokens
+            last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+            return cache, last_tokens, last_lps
 
-        self._prefill_fused = jax.jit(_prefill_insert, donate_argnums=(4, 5))
+        self._prefill_fused = jax.jit(_prefill_insert,
+                                      donate_argnums=(4, 5, 6))
 
         # ---- fused PAGED prefill: forward + sample + page scatter + fed-
         # token scatter in ONE dispatch, pool-donating. The unfused path
@@ -360,7 +380,7 @@ class Engine:
         # fused path (swarm100 r4: 3.4k vs 42k prompt tok/s).
         def _prefill_paged_insert(params, tokens, lengths, target_pages,
                                   slot_ids, k_pool, v_pool, last_tokens,
-                                  base_keys, temp, topk, topp):
+                                  last_lps, base_keys, temp, topk, topp):
             # tokens [Bp, T]; target_pages [Bp, chunks] physical page ids
             # (padding rows and short-prompt tail chunks -> trash page 0);
             # slot_ids [Bp] fed-token scatter targets (padding -> max_batch,
@@ -375,6 +395,7 @@ class Engine:
             next_tok = sample_tokens(
                 last, base_keys, lengths - 1, temp, topk, topp
             )
+            lp = token_logprob(last, next_tok)
             ck, cv = cacheB                             # [L, Bp, T, Hkv, D]
             ps = self.paged.page_size
             chunks = target_pages.shape[1]
@@ -392,11 +413,12 @@ class Engine:
             k_pool = k_pool.at[:, flat].set(kc.astype(k_pool.dtype))
             v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
             last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
-            return k_pool, v_pool, last_tokens
+            last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+            return k_pool, v_pool, last_tokens, last_lps
 
         if paged is not None:
             self._prefill_paged_fused = jax.jit(
-                _prefill_paged_insert, donate_argnums=(5, 6, 7)
+                _prefill_paged_insert, donate_argnums=(5, 6, 7, 8)
             )
 
         # ---- automatic prefix caching --------------------------------------
@@ -438,8 +460,8 @@ class Engine:
             def _prefill_paged_prefix_insert(params, tokens, lengths,
                                              prefix_lens, prefix_table,
                                              target_pages, slot_ids, k_pool,
-                                             v_pool, last_tokens, base_keys,
-                                             temp, topk, topp):
+                                             v_pool, last_tokens, last_lps,
+                                             base_keys, temp, topk, topp):
                 # tokens [Bp, T] SUFFIX tokens; prefix_table [Bp, PP] live
                 # pool pages (gather); target_pages [Bp, chunks] fresh
                 # pages for the suffix (page-aligned since the reused
@@ -456,6 +478,7 @@ class Engine:
                     last, base_keys, prefix_lens + lengths - 1, temp, topk,
                     topp,
                 )
+                lp = token_logprob(last, next_tok)
                 chunks = target_pages.shape[1]
                 pad_to = chunks * ps
                 if pad_to != T:
@@ -471,10 +494,11 @@ class Engine:
                 v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
-                return k_pool, v_pool, last_tokens
+                last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+                return k_pool, v_pool, last_tokens, last_lps
 
             self._prefill_paged_prefix_fused = jax.jit(
-                _prefill_paged_prefix_insert, donate_argnums=(7, 8, 9)
+                _prefill_paged_prefix_insert, donate_argnums=(7, 8, 9, 10)
             )
         elif prefix_fns is not None:
             if max_seq % prefix_page_size:
@@ -498,8 +522,9 @@ class Engine:
 
             def _prefill_prefix_insert(params, tokens, lengths, prefix_lens,
                                        prefix_table, reg_cols, reg_pages,
-                                       slot_ids, cache, last_tokens, pool_k,
-                                       pool_v, base_keys, temp, topk, topp):
+                                       slot_ids, cache, last_tokens,
+                                       last_lps, pool_k, pool_v, base_keys,
+                                       temp, topk, topp):
                 # tokens [Bp, T] SUFFIX tokens; prefix_table [Bp, PP] pool
                 # pages; reg_cols [Bp, RC] lane-page index to register
                 # (-1 = none); reg_pages [Bp, RC] target pool ids (0=trash)
@@ -519,6 +544,7 @@ class Engine:
                     last, base_keys, prefix_lens + lengths - 1, temp, topk,
                     topp,
                 )
+                lp = token_logprob(last, next_tok)
                 ck, cv = cache
                 lane_t = lane_pages * ps
                 ck = ck.at[:, slot_ids, :lane_t].set(lane_k, mode="drop")
@@ -541,10 +567,11 @@ class Engine:
                     cv_pages.reshape(L, Bp * RC, ps, *lane_v.shape[3:]))
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
-                return (ck, cv), last_tokens, pool_k, pool_v
+                last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+                return (ck, cv), last_tokens, last_lps, pool_k, pool_v
 
             self._prefill_prefix_fused = jax.jit(
-                _prefill_prefix_insert, donate_argnums=(8, 9, 10, 11)
+                _prefill_prefix_insert, donate_argnums=(8, 9, 10, 11, 12)
             )
 
         self.total_generated = 0
@@ -595,6 +622,8 @@ class Engine:
         B = self.max_batch
         self._last_tokens = jax.jit(
             lambda: jnp.zeros((B,), jnp.int32), out_shardings=rep)()
+        self._last_lps = jax.jit(
+            lambda: jnp.zeros((B,), jnp.float32), out_shardings=rep)()
         self.base_keys = jax.jit(
             lambda: make_slot_keys(self._seed, B), out_shardings=rep)()
         self._base_keys_np = np.array(
@@ -646,16 +675,19 @@ class Engine:
             if op == mh.OP_DECODE:
                 variant, positions, keys, temp, topk, topp = args
                 fn = self._decode_variants[variant]
-                all_toks, self._last_tokens, self.cache = fn(
-                    self.params, self._last_tokens, positions, self.cache,
-                    keys, temp, topk, topp,
+                (all_toks, _lps, self._last_tokens, self._last_lps,
+                 self.cache) = fn(
+                    self.params, self._last_tokens, self._last_lps,
+                    positions, self.cache, keys, temp, topk, topp,
                 )
             elif op == mh.OP_PREFILL:
                 tokens, lengths, scatter, keys, temp, topk, topp = args
-                self.cache, self._last_tokens = self._prefill_fused(
-                    self.params, tokens, lengths, scatter, self.cache,
-                    self._last_tokens, keys, temp, topk, topp,
-                )
+                self.cache, self._last_tokens, self._last_lps = \
+                    self._prefill_fused(
+                        self.params, tokens, lengths, scatter, self.cache,
+                        self._last_tokens, self._last_lps, keys, temp, topk,
+                        topp,
+                    )
 
     def restart(self) -> None:
         """Recover from a fatal engine death (SURVEY §5.3 failure
@@ -677,6 +709,7 @@ class Engine:
             self._stop = False
         self._fail_all("engine_restart")
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
+        self._last_lps = jnp.zeros((self.max_batch,), jnp.float32)
         self.cache = self._fresh_cache()
         if self._prefix is not None:
             # dense: the side pool was donated into the failed dispatch —
@@ -724,9 +757,11 @@ class Engine:
                 self._mh.publish_decode(variant, positions,
                                         self._base_keys_np, self._temp,
                                         self._topk, self._topp)
-            all_toks, self._last_tokens, self.cache = decode(
-                self.params, self._last_tokens, positions, self.cache,
-                self._base_keys_np, self._temp, self._topk, self._topp,
+            (all_toks, _lps, self._last_tokens, self._last_lps,
+             self.cache) = decode(
+                self.params, self._last_tokens, self._last_lps, positions,
+                self.cache, self._base_keys_np, self._temp, self._topk,
+                self._topp,
             )
             jax.block_until_ready(all_toks)
 
@@ -743,11 +778,12 @@ class Engine:
                 # fed-token rows scatter to max_batch (dropped)
                 chunks = -(-bucket // self.paged.page_size)
                 drop = np.full(Bp, self.max_batch, np.int32)
-                k_pool, v_pool, self._last_tokens = self._prefill_paged_fused(
+                (k_pool, v_pool, self._last_tokens,
+                 self._last_lps) = self._prefill_paged_fused(
                     self.params, tokens, lengths,
                     np.zeros((Bp, chunks), np.int32), drop,
                     self.cache["k"], self.cache["v"], self._last_tokens,
-                    keys, zero_f, zero_i, ones_f,
+                    self._last_lps, keys, zero_f, zero_i, ones_f,
                 )
                 self.cache = {"k": k_pool, "v": v_pool,
                               "page_table": self.cache["page_table"]}
@@ -756,10 +792,12 @@ class Engine:
                 if self._mh is not None:
                     self._mh.publish_prefill(tokens, lengths, drop, keys,
                                              zero_f, zero_i, ones_f)
-                self.cache, self._last_tokens = self._prefill_fused(
-                    self.params, tokens, lengths, drop, self.cache,
-                    self._last_tokens, keys, zero_f, zero_i, ones_f,
-                )
+                self.cache, self._last_tokens, self._last_lps = \
+                    self._prefill_fused(
+                        self.params, tokens, lengths, drop, self.cache,
+                        self._last_tokens, self._last_lps, keys, zero_f,
+                        zero_i, ones_f,
+                    )
         if self._prefix is not None:
             # prefix-prefill variants: one per (suffix bucket, PP width).
             # Inputs are pure padding — trash-page gathers, drop-scattered
@@ -771,14 +809,15 @@ class Engine:
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
                         pk, pv = self.cache["k"], self.cache["v"]
-                        pk, pv, self._last_tokens = (
+                        pk, pv, self._last_tokens, self._last_lps = (
                             self._prefill_paged_prefix_fused(
                                 self.params, tokens, lengths,
                                 np.zeros(Bp, np.int32),
                                 np.zeros((Bp, ppb), np.int32),
                                 np.zeros((Bp, chunks), np.int32),
                                 drop, pk, pv, self._last_tokens,
-                                keys, zero_f, zero_i, ones_f,
+                                self._last_lps, keys, zero_f, zero_i,
+                                ones_f,
                             ))
                         self.cache = {"k": pk, "v": pv,
                                       "page_table": self.cache["page_table"]}
@@ -786,14 +825,16 @@ class Engine:
                     lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                      self.max_seq // self._prefix_ps)
                     pk, pv = self._prefix_pool
-                    self.cache, self._last_tokens, pk, pv = (
+                    (self.cache, self._last_tokens, self._last_lps,
+                     pk, pv) = (
                         self._prefill_prefix_fused(
                             self.params, tokens, lengths,
                             np.zeros(Bp, np.int32),
                             np.zeros((Bp, ppb), np.int32),
                             np.full((Bp, lane_pages), -1, np.int32),
                             np.zeros((Bp, lane_pages), np.int32),
-                            drop, self.cache, self._last_tokens, pk, pv,
+                            drop, self.cache, self._last_tokens,
+                            self._last_lps, pk, pv,
                             keys, zero_f, zero_i, ones_f,
                         ))
                     self._prefix_pool = (pk, pv)
@@ -950,6 +991,7 @@ class Engine:
                 # so the engine survives the error
                 try:
                     self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
+                    self._last_lps = jnp.zeros((self.max_batch,), jnp.float32)
                     self.cache = self._fresh_cache()
                     if self._prefix is not None:
                         # the rebuilt pool is zeroed and (paged) its pages
@@ -1239,14 +1281,15 @@ class Engine:
                      tuple(prompt[page_idx * ps:(page_idx + 1) * ps]),
                      fresh[f]))
         pk, pv = self.cache["k"], self.cache["v"]
-        pk, pv, self._last_tokens = self._prefill_paged_prefix_fused(
-            self.params, padded, lengths, plens, table, target, scatter,
-            pk, pv, self._last_tokens,
-            self._base_keys_np[gather],
-            self._temp[gather],
-            self._topk[gather],
-            self._topp[gather],
-        )
+        pk, pv, self._last_tokens, self._last_lps = \
+            self._prefill_paged_prefix_fused(
+                self.params, padded, lengths, plens, table, target, scatter,
+                pk, pv, self._last_tokens, self._last_lps,
+                self._base_keys_np[gather],
+                self._temp[gather],
+                self._topk[gather],
+                self._topp[gather],
+            )
         self.cache = {"k": pk, "v": pv,
                       "page_table": self.cache["page_table"]}
         pins: Dict[int, List[int]] = {}
@@ -1314,11 +1357,11 @@ class Engine:
                      tuple(prompt[page_idx * ps:(page_idx + 1) * ps]), pid))
         pk, pv = self._prefix_pool
         try:
-            self.cache, self._last_tokens, pk, pv = (
+            (self.cache, self._last_tokens, self._last_lps, pk, pv) = (
                 self._prefill_prefix_fused(
                     self.params, padded, lengths, plens, table, reg_cols,
                     reg_pages, scatter, self.cache, self._last_tokens,
-                    pk, pv,
+                    self._last_lps, pk, pv,
                     self._base_keys_np[gather],
                     self._temp[gather],
                     self._topk[gather],
@@ -1379,18 +1422,20 @@ class Engine:
                     padded, lengths, scatter, self._base_keys_np[gather],
                     self._temp[gather], self._topk[gather],
                     self._topp[gather])
-            self.cache, self._last_tokens = self._prefill_fused(
-                self.params,
-                padded,                  # raw np: transfer rides the dispatch
-                lengths,
-                scatter,
-                self.cache,
-                self._last_tokens,
-                self._base_keys_np[gather],
-                self._temp[gather],
-                self._topk[gather],
-                self._topp[gather],
-            )
+            self.cache, self._last_tokens, self._last_lps = \
+                self._prefill_fused(
+                    self.params,
+                    padded,              # raw np: transfer rides the dispatch
+                    lengths,
+                    scatter,
+                    self.cache,
+                    self._last_tokens,
+                    self._last_lps,
+                    self._base_keys_np[gather],
+                    self._temp[gather],
+                    self._topk[gather],
+                    self._topp[gather],
+                )
             self._activate(batch, t0)
             return
 
@@ -1403,20 +1448,22 @@ class Engine:
             pages = self.paged.allocator.pages_for(int(gather[row]))
             m = min(len(pages), chunks)
             target[row, :m] = pages[:m]
-        k_pool, v_pool, self._last_tokens = self._prefill_paged_fused(
-            self.params,
-            padded,                      # raw np: transfer rides the dispatch
-            lengths,
-            target,
-            scatter,                     # padding rows -> max_batch, dropped
-            self.cache["k"],
-            self.cache["v"],
-            self._last_tokens,
-            self._base_keys_np[gather],
-            self._temp[gather],
-            self._topk[gather],
-            self._topp[gather],
-        )
+        k_pool, v_pool, self._last_tokens, self._last_lps = \
+            self._prefill_paged_fused(
+                self.params,
+                padded,                  # raw np: transfer rides the dispatch
+                lengths,
+                target,
+                scatter,                 # padding rows -> max_batch, dropped
+                self.cache["k"],
+                self.cache["v"],
+                self._last_tokens,
+                self._last_lps,
+                self._base_keys_np[gather],
+                self._temp[gather],
+                self._topk[gather],
+                self._topp[gather],
+            )
         self.cache = {"k": k_pool, "v": v_pool,
                       "page_table": self.cache["page_table"]}
         self._activate(batch, t0)
@@ -1429,6 +1476,7 @@ class Engine:
             slot.position = len(req.prompt)  # next write position
             slot.dispatched_position = slot.position
             slot.generated = []
+            slot.logprobs = []
             slot.pending_first = True
             with self._cv:
                 self._admitting.discard(req.request_id)
@@ -1478,22 +1526,25 @@ class Engine:
         # keys ride as a raw [B, 2] numpy argument (like temp/topk/topp):
         # per-REQUEST seeds just rewrite a host row at admission, with no
         # graph change and no eager transfer
-        all_toks, self._last_tokens, self.cache = decode(
-            self.params, self._last_tokens, positions,
-            self.cache, self._base_keys_np,
-            self._temp, self._topk, self._topp,
-        )
-        return all_toks, snapshot
+        all_toks, all_lps, self._last_tokens, self._last_lps, self.cache = \
+            decode(
+                self.params, self._last_tokens, self._last_lps, positions,
+                self.cache, self._base_keys_np,
+                self._temp, self._topk, self._topp,
+            )
+        return all_toks, all_lps, snapshot
 
-    def _process_block(self, all_toks, snapshot) -> None:
-        """Fetch one dispatched chunk's [K+1, B] token block (the one
-        host sync) and emit its tokens.
+    def _process_block(self, all_toks, all_lps, snapshot) -> None:
+        """Fetch one dispatched chunk's [K+1, B] token block (+ matching
+        raw-model logprobs) with the one host sync and emit its tokens.
 
         Token (s+1, i) was sampled at write position ``pos0_i + s`` —
         emission stops at a slot's EOS / max_new_tokens / max_seq and the
         remainder of its lane is discarded garbage.
         """
-        block = np.asarray(jax.device_get(all_toks))
+        block, lps = jax.device_get((all_toks, all_lps))
+        block = np.asarray(block)
+        lps = np.asarray(lps)
         now = time.time()
         K = self.decode_chunk
         for i, req, pos0 in snapshot:
@@ -1507,7 +1558,8 @@ class Engine:
                 # row 0 is the fed token == this slot's prefill sample,
                 # which the host deliberately never fetched at admission
                 s.pending_first = False
-                self._emit_token(i, int(block[0, i]), now)
+                self._emit_token(i, int(block[0, i]), now,
+                                 logprob=float(lps[0, i]))
             for step in range(K):
                 if not s.active:
                     break
@@ -1515,12 +1567,14 @@ class Engine:
                     # the cache lane is full; later writes were dropped
                     self._retire(i, "max_seq")
                     break
-                self._emit_token(i, int(block[step + 1, i]), now)
+                self._emit_token(i, int(block[step + 1, i]), now,
+                                 logprob=float(lps[step + 1, i]))
             if s.active:
                 s.position = pos0 + K
 
     def _emit_token(self, slot_id: int, token: int,
-                    now: Optional[float] = None) -> None:
+                    now: Optional[float] = None,
+                    logprob: Optional[float] = None) -> None:
         """Record a sampled token for a slot, stream it, retire if finished."""
         slot = self.slots[slot_id]
         req = slot.request
@@ -1534,6 +1588,8 @@ class Engine:
             finished_reason = "eos"
         else:
             slot.generated.append(token)
+            if logprob is not None:
+                slot.logprobs.append(logprob)
             self.total_generated += 1
             self.metrics.rates["tokens_generated"].mark(now)
             self.metrics.counters["tokens_generated"].inc()
@@ -1565,6 +1621,10 @@ class Engine:
                 self._prefix.unpin(pins)
         self.metrics.counters["engine_completed"].inc()
         self.metrics.rates["requests_completed"].mark()
+        if req is not None:
+            # raw-model logprobs of the generated tokens (parallel list);
+            # delivered via request metadata so on_done's signature stays
+            req.metadata["logprobs"] = list(slot.logprobs)
         if req and req.on_done is not None:
             try:
                 req.on_done(req.request_id, list(slot.generated), reason)

@@ -41,6 +41,14 @@ def make_slot_keys(seed: int, batch: int) -> jnp.ndarray:
     return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(batch))
 
 
+def token_logprob(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """log P(token) under the RAW model distribution (before temperature /
+    filtering — the OpenAI-style logprob convention). [B, V], [B] -> [B]."""
+    ls = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(ls, tokens[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+
+
 def sample_tokens(
     logits: jnp.ndarray,        # [B, V] fp32
     base_keys: jnp.ndarray,     # [B, 2] uint32 per-slot base keys

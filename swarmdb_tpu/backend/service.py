@@ -405,11 +405,19 @@ class ServingService:
         priority = int(msg.priority.value if hasattr(msg.priority, "value")
                        else msg.priority)
 
+        g = msg.metadata.get("generation", {}) if isinstance(
+            msg.metadata, dict) else {}
+        want_logprobs = bool(g.get("logprobs"))
+
         def _done(rid: str, tokens: List[int], reason: str) -> None:
-            # engine thread: just hand off — emission runs on _reply_loop
+            # engine thread: just hand off — emission runs on _reply_loop.
+            # Logprobs travel IN the queue tuple (not via msg.metadata,
+            # which a client could pre-populate — review finding)
             msg.stage_stamp("done")
+            lps = (list(req.metadata.get("logprobs", []))
+                   if want_logprobs else None)
             self._reply_queue.put((msg, rid, tokens, reason, sampling.stop,
-                                   on_done))
+                                   lps, on_done))
 
         # stop-sequence watch (host-side): keep a bounded tail of decoded
         # text and CANCEL the engine request at the first match — the
@@ -465,9 +473,9 @@ class ServingService:
             item = self._reply_queue.get()
             if item is None:
                 return
-            msg, rid, tokens, reason, stop, on_done = item
+            msg, rid, tokens, reason, stop, lps, on_done = item
             try:
-                self._emit_reply(msg, tokens, reason, stop)
+                self._emit_reply(msg, tokens, reason, stop, lps)
             except Exception:
                 logger.exception("failed to emit reply for %s", msg.id)
             if on_done is not None:
@@ -477,7 +485,8 @@ class ServingService:
                     logger.exception("on_done callback failed for %s", msg.id)
 
     def _emit_reply(self, msg: Message, tokens: List[int], reason: str,
-                    stop: tuple = ()) -> None:
+                    stop: tuple = (), logprobs: Optional[List[float]] = None
+                    ) -> None:
         text = self.tokenizer.decode(tokens)
         if stop:
             # truncate at the FIRST occurrence of any stop string (the
@@ -487,23 +496,35 @@ class ServingService:
             if cut >= 0:
                 text = text[:cut]
                 reason = "stop"
+                if logprobs is not None:
+                    # keep logprobs parallel to the VISIBLE completion:
+                    # largest token prefix whose decode fits text[:cut]
+                    n = 0
+                    while (n < len(tokens)
+                           and len(self.tokenizer.decode(tokens[:n + 1]))
+                           <= cut):
+                        n += 1
+                    logprobs = logprobs[:n]
         reply_type = (
             MessageType.FUNCTION_RESULT
             if msg.type == MessageType.FUNCTION_CALL
             else MessageType.CHAT
         )
+        reply_meta = {
+            "reply_to": msg.id,
+            "backend_id": self.backend_id,
+            "finish_reason": reason,
+            "completion_tokens": len(tokens),
+        }
+        if logprobs is not None:
+            reply_meta["logprobs"] = [round(x, 6) for x in logprobs]
         reply_id = self.db.send_message(
             msg.receiver_id or self.backend_id,
             msg.sender_id,
             text,
             message_type=reply_type,
             priority=msg.priority,
-            metadata={
-                "reply_to": msg.id,
-                "backend_id": self.backend_id,
-                "finish_reason": reason,
-                "completion_tokens": len(tokens),
-            },
+            metadata=reply_meta,
         )
         msg.metadata["reply_id"] = reply_id
         self.db.mark_message_as_processed(msg.id)

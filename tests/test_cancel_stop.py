@@ -228,3 +228,143 @@ def test_per_request_seed(engine):
     assert seeded1 == seeded2                 # reproducible
     assert seeded1 != other                   # seed actually keys the draw
     assert base1 == base2                     # default key restored
+
+
+def test_logprobs_match_direct_forward(engine):
+    """Greedy generation's logprobs equal log_softmax of a direct forward
+    at each position (raw-model convention, OpenAI-style)."""
+    import jax.numpy as jnp
+
+    cfg = TINY
+    prompt = [3, 1, 4, 1, 5]
+    done = threading.Event()
+    out = {}
+
+    def on_done(rid, toks, reason):
+        out["tokens"] = toks
+        done.set()
+
+    req = GenRequest(prompt=list(prompt),
+                     sampling=SamplingParams(max_new_tokens=6),
+                     on_done=on_done)
+    engine.submit(req)
+    assert done.wait(timeout=120)
+    lps = req.metadata["logprobs"]
+    toks = out["tokens"]
+    assert len(lps) == len(toks) == 6
+
+    # teacher-forced forward over prompt+generated, same params
+    params = engine.params
+    seq = prompt + toks
+    cache = llama.init_kv_cache(cfg, 1, len(seq))
+    logits, _ = llama.forward(
+        params, cfg, jnp.asarray([seq], jnp.int32),
+        jnp.arange(len(seq), dtype=jnp.int32)[None], cache)
+    ls = jax.nn.log_softmax(logits[0], axis=-1)
+    expect = [float(ls[len(prompt) - 1 + i, toks[i]]) for i in range(6)]
+    np.testing.assert_allclose(lps, expect, rtol=1e-3, atol=1e-3)
+
+
+def test_logprobs_in_reply_metadata(tmp_path):
+    """generation.logprobs=true surfaces per-token logprobs in the reply."""
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.backend.service import ServingService
+
+    db = SwarmDB(save_dir=str(tmp_path), autosave_interval=1e9)
+    db.register_agent("u")
+    db.register_agent("bot")
+    db.assign_llm_backend("bot", "tpu-0")
+    svc = ServingService.from_model_name(
+        db, "tiny-debug", backend_id="tpu-0", max_batch=2, max_seq=128,
+        decode_chunk=4)
+    svc.start(warmup=False)
+    try:
+        mid = db.send_message("u", "bot", "logprob me",
+                              metadata={"generation": {
+                                  "max_new_tokens": 7,
+                                  "temperature": 0.0,
+                                  "logprobs": True}})
+        got = None
+        deadline = time.time() + 120
+        while time.time() < deadline and got is None:
+            for m in db.receive_messages("u", timeout=0.5):
+                if m.metadata.get("reply_to") == mid:
+                    got = m
+        assert got is not None
+        lps = got.metadata["logprobs"]
+        assert len(lps) == got.metadata["completion_tokens"] == 7
+        assert all(isinstance(x, float) and x <= 0.0 for x in lps)
+        # unrequested -> absent
+        mid2 = db.send_message("u", "bot", "no logprobs",
+                               metadata={"generation": {
+                                   "max_new_tokens": 4,
+                                   "temperature": 0.0}})
+        got2 = None
+        deadline = time.time() + 120
+        while time.time() < deadline and got2 is None:
+            for m in db.receive_messages("u", timeout=0.5):
+                if m.metadata.get("reply_to") == mid2:
+                    got2 = m
+        assert got2 is not None
+        assert "logprobs" not in got2.metadata
+    finally:
+        svc.stop()
+        db.close()
+
+
+def test_logprobs_truncated_with_stop(tmp_path):
+    """stop + logprobs: the logprob list stays parallel to the VISIBLE
+    (truncated) completion, and client-planted metadata cannot spoof it."""
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.backend.service import ServingService
+
+    db = SwarmDB(save_dir=str(tmp_path), autosave_interval=1e9)
+    db.register_agent("u")
+    db.register_agent("bot")
+    db.assign_llm_backend("bot", "tpu-0")
+    svc = ServingService.from_model_name(
+        db, "tiny-debug", backend_id="tpu-0", max_batch=2, max_seq=128,
+        decode_chunk=4)
+    svc.start(warmup=False)
+
+    def ask(dbx, meta):
+        mid = dbx.send_message("u", "bot", "hello", metadata=meta)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            for m in dbx.receive_messages("u", timeout=0.5):
+                if m.metadata.get("reply_to") == mid:
+                    return m
+        raise AssertionError("no reply")
+
+    try:
+        free = ask(db, {"generation": {"max_new_tokens": 24,
+                                       "temperature": 0.0}})
+        stop = free.content[1:3]
+        db2 = SwarmDB(save_dir=str(tmp_path / "2"), autosave_interval=1e9)
+        db2.register_agent("u")
+        db2.register_agent("bot")
+        db2.assign_llm_backend("bot", "tpu-0")
+        svc2 = ServingService(db2, svc.engine, svc.tokenizer,
+                              backend_id="tpu-0")
+        svc2.start(warmup=False)
+        try:
+            got = ask(db2, {"generation": {"max_new_tokens": 24,
+                                           "temperature": 0.0,
+                                           "stop": [stop],
+                                           "logprobs": True},
+                            # spoof attempt: must NOT surface in the reply
+                            "logprobs": ["bogus"]})
+            assert got.metadata["finish_reason"] == "stop"
+            lps = got.metadata["logprobs"]
+            assert all(isinstance(x, float) for x in lps)
+            # ByteTokenizer: 1 token ~ 1 text unit minus multibyte merges;
+            # the list must not exceed the visible completion's tokens
+            visible = svc.tokenizer.encode(got.content)
+            assert len(lps) <= len(visible) + 1
+            assert "bogus" not in lps
+        finally:
+            svc2.stop()
+            db2.close()
+    finally:
+        svc.stop()
+        db.close()
