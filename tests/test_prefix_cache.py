@@ -426,3 +426,53 @@ def test_paged_prefix_under_pool_pressure(plain_engine):
         assert al["live_slots"] <= 1
     finally:
         eng.stop()
+
+
+def test_engine_recovery_resets_prefix_cache(plain_engine):
+    """An in-loop engine error rebuilds the pool; the prefix table must be
+    forgotten (stale entries would point at zeroed/reused pages) and
+    generation must stay token-correct afterwards."""
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    eng = _mk_engine(prefix=True, pool_pages=64)
+    try:
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(3, TINY.vocab_size, size=20).tolist()
+        ref, _ = plain_engine.generate_sync(
+            list(prompt), SamplingParams(max_new_tokens=6))
+        out1, _ = eng.generate_sync(list(prompt),
+                                    SamplingParams(max_new_tokens=6))
+        assert out1 == ref
+        assert eng.stats()["prefix_cache"]["cached_pages"] > 0
+
+        # force one engine-loop failure: next dispatch raises
+        original = eng._dispatch_decode
+        calls = {"n": 0}
+
+        def boom(*a, **kw):
+            calls["n"] += 1
+            eng._dispatch_decode = original  # fail exactly once
+            raise RuntimeError("injected device error")
+
+        eng._dispatch_decode = boom
+        toks, reason = eng.generate_sync(list(prompt),
+                                         SamplingParams(max_new_tokens=6),
+                                         timeout=60)
+        assert reason in ("engine_error", "length")
+        assert calls["n"] == 1
+
+        # the recovery path must have forgotten every cached page
+        st = eng.stats()["prefix_cache"]
+        assert st["cached_pages"] == 0, st
+        assert st["pinned_pages"] == 0, st
+
+        # and serving continues, token-correct, re-warming the cache
+        out2, _ = eng.generate_sync(list(prompt),
+                                    SamplingParams(max_new_tokens=6))
+        assert out2 == ref
+        out3, _ = eng.generate_sync(list(prompt),
+                                    SamplingParams(max_new_tokens=6))
+        assert out3 == ref  # served from the re-registered cache
+        assert eng.stats()["prefix_cache"]["hit_tokens"] > 0
+    finally:
+        eng.stop()
