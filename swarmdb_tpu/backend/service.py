@@ -21,6 +21,7 @@ drives real serving (SURVEY §3.2 "graft point"):
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import json
 import logging
 import os
@@ -123,6 +124,9 @@ class ServingService:
         # chunk, was the round-3 bottleneck).
         self._reply_queue: "queue.Queue" = queue.Queue()
         self._reply_thread: Optional[threading.Thread] = None
+        # n>1 fan-out groups: completion-0 rid -> all member rids, so a
+        # cancel reaches every alternative (popped at aggregate emission)
+        self._fanout: Dict[str, List[str]] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -408,6 +412,11 @@ class ServingService:
         g = msg.metadata.get("generation", {}) if isinstance(
             msg.metadata, dict) else {}
         want_logprobs = bool(g.get("logprobs"))
+        # n parallel completions (OpenAI-style): alternatives occupy their
+        # own engine slots but SHARE the prompt's KV through the prefix
+        # cache, so extra completions cost ~decode only. Completion 0 is
+        # the reply body (and the streamed one); 1..n-1 ride metadata.
+        n = min(4, max(1, int(g.get("n", 1))))
 
         def _done(rid: str, tokens: List[int], reason: str) -> None:
             # engine thread: just hand off — emission runs on _reply_loop.
@@ -417,7 +426,7 @@ class ServingService:
             lps = (list(req.metadata.get("logprobs", []))
                    if want_logprobs else None)
             self._reply_queue.put((msg, rid, tokens, reason, sampling.stop,
-                                   lps, on_done))
+                                   lps, None, on_done))
 
         # stop-sequence watch (host-side): keep a bounded tail of decoded
         # text and CANCEL the engine request at the first match — the
@@ -465,7 +474,101 @@ class ServingService:
             on_token=_tok, on_done=_done,
             metadata={"message_id": msg.id},
         )
+        if n > 1:
+            return self._serve_n(msg, req, prompt, sampling, priority, n,
+                                 want_logprobs, on_done)
         return self.engine.submit(req)
+
+    def _serve_n(self, msg: Message, req0: GenRequest, prompt: List[int],
+                 sampling: SamplingParams, priority: int, n: int,
+                 want_logprobs: bool, on_done) -> str:
+        """Fan ``n`` completions over engine slots; emit ONE reply whose
+        body is completion 0 and whose metadata carries the alternatives.
+        Distinctness: alternatives get derived seeds (seed+i when the
+        request is seeded, else drawn fresh) — without them two
+        completions landing on the same slot would replay identical PRNG
+        folds and collapse into copies. Greedy (temperature=0) duplicates
+        by definition; allowed, documented."""
+        base_seed = sampling.seed
+        if base_seed is None and sampling.temperature > 0:
+            base_seed = int.from_bytes(os.urandom(8), "little")
+        results: Dict[int, Tuple[List[int], str, Optional[List[float]]]] = {}
+        lock = threading.Lock()
+
+        def mk_done(idx: int, reqs: List[GenRequest]):
+            def _done_i(rid: str, tokens: List[int], reason: str) -> None:
+                lps = (list(reqs[idx].metadata.get("logprobs", []))
+                       if want_logprobs else None)
+                with lock:
+                    results[idx] = (tokens, reason, lps)
+                    if len(results) < n:
+                        return
+                # last completion: emit the aggregate
+                self._fanout.pop(reqs[0].request_id, None)
+                msg.stage_stamp("done")
+                toks0, reason0, lps0 = results[0]
+                alts = [results[i] for i in range(1, n)]
+                self._reply_queue.put(
+                    (msg, reqs[0].request_id, toks0, reason0, sampling.stop,
+                     lps0, alts, on_done))
+            return _done_i
+
+        reqs: List[GenRequest] = []
+        for i in range(n):
+            sp = dataclasses.replace(
+                sampling, seed=None if base_seed is None else base_seed + i)
+            # EVERY completion watches its own stop match (each alternative
+            # stops independently); completion 0 also keeps the original
+            # token/TTFT callback — it is the streamed one
+            watch = self._make_stop_watch(sp)
+            prev = req0.on_token if i == 0 else None
+
+            def on_tok(rid, token, watch=watch, prev=prev):
+                if watch is not None:
+                    watch(rid, token)
+                if prev is not None:
+                    prev(rid, token)
+
+            reqs.append(GenRequest(
+                prompt=list(prompt), sampling=sp, priority=priority,
+                on_token=on_tok, metadata=dict(req0.metadata, alt=i),
+            ))
+        for i, r in enumerate(reqs):
+            r.on_done = mk_done(i, reqs)
+        # cancel_request(rid0) must reach every member (client disconnects
+        # would otherwise leave n-1 slots decoding to max_new_tokens)
+        self._fanout[reqs[0].request_id] = [r.request_id for r in reqs]
+        for r in reqs:
+            self.engine.submit(r)
+        return reqs[0].request_id
+
+    def _make_stop_watch(self, sampling: SamplingParams):
+        """Host-side stop-sequence watcher bound to one engine request
+        (see serve_message's inline twin); None when no stop configured."""
+        if not sampling.stop:
+            return None
+        tail: List[int] = []
+        window = 4 * max(len(s) for s in sampling.stop) + 8
+        hit = [False]
+
+        def _watch(rid: str, token: int) -> None:
+            if hit[0]:
+                return
+            tail.append(token)
+            if len(tail) > window:
+                del tail[0]
+            text = self.tokenizer.decode(tail)
+            if any(s in text for s in sampling.stop):
+                hit[0] = True
+                self.engine.cancel(rid)
+
+        return _watch
+
+    def cancel_request(self, rid: str) -> None:
+        """Cancel a serve_message request INCLUDING any n>1 fan-out
+        members (engine.cancel alone only reaches completion 0)."""
+        for r in self._fanout.pop(rid, [rid]):
+            self.engine.cancel(r)
 
     def _reply_loop(self) -> None:
         """Drain completed generations into reply messages (worker thread)."""
@@ -473,9 +576,9 @@ class ServingService:
             item = self._reply_queue.get()
             if item is None:
                 return
-            msg, rid, tokens, reason, stop, lps, on_done = item
+            msg, rid, tokens, reason, stop, lps, alts, on_done = item
             try:
-                self._emit_reply(msg, tokens, reason, stop, lps)
+                self._emit_reply(msg, tokens, reason, stop, lps, alts)
             except Exception:
                 logger.exception("failed to emit reply for %s", msg.id)
             if on_done is not None:
@@ -484,9 +587,12 @@ class ServingService:
                 except Exception:
                     logger.exception("on_done callback failed for %s", msg.id)
 
-    def _emit_reply(self, msg: Message, tokens: List[int], reason: str,
-                    stop: tuple = (), logprobs: Optional[List[float]] = None
-                    ) -> None:
+    def _finish_completion(self, tokens: List[int], reason: str,
+                           stop: tuple,
+                           logprobs: Optional[List[float]]
+                           ) -> Tuple[str, str, Optional[List[float]]]:
+        """Decode + stop-truncate one completion (text, reason, logprobs
+        kept parallel to the VISIBLE text)."""
         text = self.tokenizer.decode(tokens)
         if stop:
             # truncate at the FIRST occurrence of any stop string (the
@@ -497,7 +603,6 @@ class ServingService:
                 text = text[:cut]
                 reason = "stop"
                 if logprobs is not None:
-                    # keep logprobs parallel to the VISIBLE completion:
                     # largest token prefix whose decode fits text[:cut]
                     n = 0
                     while (n < len(tokens)
@@ -505,6 +610,13 @@ class ServingService:
                            <= cut):
                         n += 1
                     logprobs = logprobs[:n]
+        return text, reason, logprobs
+
+    def _emit_reply(self, msg: Message, tokens: List[int], reason: str,
+                    stop: tuple = (), logprobs: Optional[List[float]] = None,
+                    alts: Optional[List[Tuple]] = None) -> None:
+        text, reason, logprobs = self._finish_completion(
+            tokens, reason, stop, logprobs)
         reply_type = (
             MessageType.FUNCTION_RESULT
             if msg.type == MessageType.FUNCTION_CALL
@@ -518,6 +630,17 @@ class ServingService:
         }
         if logprobs is not None:
             reply_meta["logprobs"] = [round(x, 6) for x in logprobs]
+        if alts:
+            rendered = []
+            for toks_i, reason_i, lps_i in alts:
+                text_i, reason_i, lps_i = self._finish_completion(
+                    toks_i, reason_i, stop, lps_i)
+                entry = {"text": text_i, "finish_reason": reason_i,
+                         "completion_tokens": len(toks_i)}
+                if lps_i is not None:
+                    entry["logprobs"] = [round(x, 6) for x in lps_i]
+                rendered.append(entry)
+            reply_meta["alternatives"] = rendered
         reply_id = self.db.send_message(
             msg.receiver_id or self.backend_id,
             msg.sender_id,
@@ -605,9 +728,9 @@ class ServingService:
                     return
         finally:
             # client disconnect closes this generator mid-stream: stop the
-            # generation instead of burning the slot to max_new_tokens
-            # (no-op if the request already finished)
-            self.engine.cancel(rid)
+            # generation (and any n>1 fan-out members) instead of burning
+            # slots to max_new_tokens (no-op if already finished)
+            self.cancel_request(rid)
 
     async def stream_group(self, msgs: List[Message]) -> AsyncIterator[Dict[str, Any]]:
         """Fan-out streaming: serve every group message concurrently (they
@@ -668,7 +791,7 @@ class ServingService:
                 yield item
         finally:
             for rid in rids:  # client disconnect: stop all fan-out members
-                self.engine.cancel(rid)
+                self.cancel_request(rid)
 
     # --------------------------------------------------------------- health
 

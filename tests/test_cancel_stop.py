@@ -368,3 +368,95 @@ def test_logprobs_truncated_with_stop(tmp_path):
     finally:
         svc.stop()
         db.close()
+
+
+def test_n_parallel_completions(tmp_path):
+    """generation.n>1 returns alternatives in the reply metadata; sampled
+    alternatives are distinct, and each gets its logprobs."""
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.backend.service import ServingService
+
+    db = SwarmDB(save_dir=str(tmp_path), autosave_interval=1e9)
+    db.register_agent("u")
+    db.register_agent("bot")
+    db.assign_llm_backend("bot", "tpu-0")
+    svc = ServingService.from_model_name(
+        db, "tiny-debug", backend_id="tpu-0", max_batch=4, max_seq=128,
+        decode_chunk=4)
+    svc.start(warmup=False)
+
+    def ask(meta):
+        mid = db.send_message("u", "bot", "pick one", metadata=meta)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            for m in db.receive_messages("u", timeout=0.5):
+                if m.metadata.get("reply_to") == mid:
+                    return m
+        raise AssertionError("no reply")
+
+    try:
+        got = ask({"generation": {"max_new_tokens": 8, "temperature": 0.9,
+                                  "n": 3, "seed": 77, "logprobs": True}})
+        alts = got.metadata["alternatives"]
+        assert len(alts) == 2
+        texts = {got.content} | {a["text"] for a in alts}
+        assert len(texts) == 3                    # all distinct (seed+i)
+        assert len(got.metadata["logprobs"]) == 8
+        for a in alts:
+            assert len(a["logprobs"]) == a["completion_tokens"] == 8
+
+        # seeded n>1 is reproducible end to end
+        got2 = ask({"generation": {"max_new_tokens": 8, "temperature": 0.9,
+                                   "n": 3, "seed": 77}})
+        # got2's prompt includes history, so only structure is comparable
+        assert len(got2.metadata["alternatives"]) == 2
+        assert "logprobs" not in got2.metadata
+
+        # n=1 stays the old shape
+        got3 = ask({"generation": {"max_new_tokens": 4,
+                                   "temperature": 0.0}})
+        assert "alternatives" not in got3.metadata
+    finally:
+        svc.stop()
+        db.close()
+
+
+def test_n_fanout_cancel_reaches_alternatives(tmp_path):
+    """cancel_request(rid0) stops every fan-out member (a dropped SSE
+    client must not leave n-1 slots decoding)."""
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.core.messages import Message, MessageType
+    from swarmdb_tpu.backend.service import ServingService
+
+    db = SwarmDB(save_dir=str(tmp_path), autosave_interval=1e9)
+    db.register_agent("u")
+    db.register_agent("bot")
+    db.assign_llm_backend("bot", "tpu-0")
+    svc = ServingService.from_model_name(
+        db, "tiny-debug", backend_id="tpu-0", max_batch=4, max_seq=128,
+        decode_chunk=4)
+    svc.start(warmup=False)
+    try:
+        msg = Message(sender_id="u", receiver_id="bot", content="go",
+                      type=MessageType.CHAT,
+                      metadata={"generation": {"max_new_tokens": 4000,
+                                               "temperature": 0.8,
+                                               "n": 3, "seed": 1}})
+        msg.stage_stamp("enqueued")
+        rid = svc.serve_message(msg)
+        # wait until generation is running, then group-cancel
+        deadline = time.time() + 60
+        while (time.time() < deadline
+               and svc.engine.stats()["active_slots"] < 3):
+            time.sleep(0.05)
+        assert svc.engine.stats()["active_slots"] == 3
+        svc.cancel_request(rid)
+        deadline = time.time() + 60
+        while (time.time() < deadline
+               and svc.engine.stats()["active_slots"] > 0):
+            time.sleep(0.05)
+        assert svc.engine.stats()["active_slots"] == 0
+        assert svc.engine.total_generated < 3 * 4000
+    finally:
+        svc.stop()
+        db.close()

@@ -461,8 +461,16 @@ def test_engine_recovery_resets_prefix_cache(plain_engine):
         assert reason in ("engine_error", "length")
         assert calls["n"] == 1
 
-        # the recovery path must have forgotten every cached page
-        st = eng.stats()["prefix_cache"]
+        # the recovery path must forget every cached page; on_done fires
+        # from _fail_all BEFORE the engine thread reaches the reset, so
+        # poll briefly instead of racing it
+        import time as _t
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            st = eng.stats()["prefix_cache"]
+            if st["cached_pages"] == 0 and st["pinned_pages"] == 0:
+                break
+            _t.sleep(0.05)
         assert st["cached_pages"] == 0, st
         assert st["pinned_pages"] == 0, st
 
