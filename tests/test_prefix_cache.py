@@ -484,3 +484,41 @@ def test_engine_recovery_resets_prefix_cache(plain_engine):
         assert eng.stats()["prefix_cache"]["hit_tokens"] > 0
     finally:
         eng.stop()
+
+
+def test_prefix_attention_respects_sliding_window():
+    """gqa_attention_prefix with a window smaller than the prefix must
+    match the full forward's windowed attention (windowed models reuse
+    prefixes too)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, sliding_window=12)
+    ps = 8
+    rng = np.random.default_rng(17)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+
+    prompt = rng.integers(1, cfg.vocab_size, size=22).tolist()
+    PP, P0 = 2, 16
+    T, lane_pages = 8, 3
+    cache = llama.init_kv_cache(cfg, 1, len(prompt))
+    logits_full, (ck, cv) = llama.forward(
+        params, cfg, jnp.asarray([prompt], jnp.int32),
+        jnp.arange(len(prompt), dtype=jnp.int32)[None], cache)
+
+    pool_k, pool_v = llama.init_prefix_pool(cfg, 4, ps)
+    for p in range(PP):
+        pool_k = pool_k.at[:, p + 1].set(ck[:, 0, p * ps:(p + 1) * ps])
+        pool_v = pool_v.at[:, p + 1].set(cv[:, 0, p * ps:(p + 1) * ps])
+
+    suffix = prompt[P0:]
+    sfx = np.zeros((1, T), np.int32)
+    sfx[0, :len(suffix)] = suffix
+    logits_sfx, _sk, _sv = llama.forward_prefix_pages(
+        params, cfg, jnp.asarray(sfx), jnp.asarray([[1, 2]], jnp.int32),
+        jnp.asarray([P0], jnp.int32), pool_k, pool_v,
+    )
+    n = len(suffix)
+    np.testing.assert_allclose(
+        np.asarray(logits_sfx[0, :n]),
+        np.asarray(logits_full[0, P0:P0 + n]), rtol=2e-3, atol=2e-3,
+    )
