@@ -538,8 +538,19 @@ class ServingService:
         # cancel_request(rid0) must reach every member (client disconnects
         # would otherwise leave n-1 slots decoding to max_new_tokens)
         self._fanout[reqs[0].request_id] = [r.request_id for r in reqs]
-        for r in reqs:
-            self.engine.submit(r)
+        submitted = []
+        try:
+            for r in reqs:
+                self.engine.submit(r)
+                submitted.append(r)
+        except Exception:
+            # a later member failed to submit: without the full group the
+            # aggregate (len(results) == n) would never emit — cancel the
+            # submitted members and surface the error to the caller
+            self._fanout.pop(reqs[0].request_id, None)
+            for r in submitted:
+                self.engine.cancel(r.request_id)
+            raise
         return reqs[0].request_id
 
     def _make_stop_watch(self, sampling: SamplingParams):
