@@ -676,11 +676,20 @@ class ServingService:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
+        def _post(item) -> None:
+            # the client's event loop closes on disconnect while in-flight
+            # engine callbacks still land here; the cancel is already on
+            # its way, so a closed loop is expected — not traceback spam
+            try:
+                loop.call_soon_threadsafe(q.put_nowait, item)
+            except RuntimeError:
+                pass
+
         def on_token(rid: str, token: int) -> None:
-            loop.call_soon_threadsafe(q.put_nowait, ("token", token))
+            _post(("token", token))
 
         def on_done(rid: str, tokens: List[int], reason: str) -> None:
-            loop.call_soon_threadsafe(q.put_nowait, ("done", reason))
+            _post(("done", reason))
 
         stop = sampling_from_message(msg).stop
         held = ""  # seen but not yet released (possible stop-match prefix)
@@ -763,13 +772,16 @@ class ServingService:
                 remaining += 1
                 stop = sampling_from_message(msg).stop
 
+                def _post(item) -> None:
+                    try:
+                        loop.call_soon_threadsafe(q.put_nowait, item)
+                    except RuntimeError:
+                        pass  # loop closed on disconnect; cancel in flight
+
                 def mk(msg_id: str, stop: tuple):
                     def on_token(rid: str, token: int) -> None:
-                        loop.call_soon_threadsafe(
-                            q.put_nowait,
-                            {"event": "token", "message_id": msg_id,
-                             "token": token},
-                        )
+                        _post({"event": "token", "message_id": msg_id,
+                               "token": token})
 
                     def on_done(rid: str, tokens: List[int],
                                 reason: str) -> None:
@@ -783,11 +795,9 @@ class ServingService:
                             if cut >= 0:
                                 text = text[:cut]
                                 reason = "stop"
-                        loop.call_soon_threadsafe(
-                            q.put_nowait,
-                            {"event": "reply_done", "message_id": msg_id,
-                             "finish_reason": reason, "text": text},
-                        )
+                        _post({"event": "reply_done",
+                               "message_id": msg_id,
+                               "finish_reason": reason, "text": text})
 
                     return on_token, on_done
 
