@@ -707,6 +707,10 @@ def run_mode(mode: str, seconds: float) -> dict:
     if tpu_error:
         result["tpu_error"] = tpu_error
         result["fallback"] = "cpu"
+        # a CPU-fallback number is a liveness proof, not a perf claim —
+        # the most recent ON-SILICON measurements are tabulated in
+        # PROFILE.md (round 4: serve 185.6-192.0 msgs/sec on the v5e)
+        result["tpu_numbers_recorded_in"] = "PROFILE.md"
     return result
 
 
