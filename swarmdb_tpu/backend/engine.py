@@ -186,17 +186,31 @@ class Engine:
         # raw-model logprob of each slot's fed token (same lifecycle)
         self._last_lps = jnp.zeros((max_batch,), jnp.float32)
 
+        # ONE long-context policy flag, read by the bucket ladder here and
+        # both prefix-PP width sites below — retune the threshold in one
+        # place only
+        self._long_context = max_seq >= 512
         if prefill_buckets is None:
-            prefill_buckets = [
-                b for b in (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
-                if b <= max_seq
-            ]
+            if self._long_context:
+                # long-context: x4 bucket growth. Every compiled variant
+                # costs 30-90 s on this image's tunneled XLA service and
+                # warmup compiles |buckets| x (1 + |PP widths|) prefill
+                # variants — at S=1024 the x2 ladder put ~31 compiles in
+                # warmup and blew the bench's 1500 s watchdog. Padding
+                # waste from the coarser ladder is bounded by prefill
+                # being batch-fused (padding rows ride along) and by the
+                # prefix cache absorbing most long-prompt re-prefill.
+                ladder = (64, 256, 1024, 4096)
+            else:
+                ladder = (16, 32, 64, 128, 256)
+            prefill_buckets = [b for b in ladder if b <= max_seq]
         prefill_buckets = sorted(prefill_buckets)
         # the largest bucket must hold the longest admissible prompt
-        # (max_seq - 1), or an oversized prompt would crash prefill and
-        # collateral-fail every in-flight request
+        # (max_seq - 1). Append max_seq itself — not max_seq - 1 — so the
+        # top (hottest) bucket stays tile/page aligned when max_seq is
+        # a power of two or page multiple
         if not prefill_buckets or prefill_buckets[-1] < max_seq - 1:
-            prefill_buckets.append(max_seq - 1)
+            prefill_buckets.append(max_seq)
         self.prefill_buckets = prefill_buckets
 
         # host-side per-slot sampling params. These are handed to the jitted
@@ -452,10 +466,7 @@ class Engine:
                                      manage_free=False)
             pages_fwd = prefix_fns[0]
             maxp_row = paged.allocator.maxp
-            self._prefix_pp_buckets = sorted({
-                max(1, maxp_row // 4), max(1, maxp_row // 2),
-                max(1, maxp_row - 1),
-            })
+            self._prefix_pp_buckets = self._pp_widths(maxp_row)
 
             def _prefill_paged_prefix_insert(params, tokens, lengths,
                                              prefix_lens, prefix_table,
@@ -513,12 +524,7 @@ class Engine:
             self._prefix_pool = init_pool(max(2, prefix_pages),
                                           prefix_page_size)
             maxp_lane = max_seq // prefix_page_size
-            # PP (prefix gather width) buckets: coarse set so compiled
-            # variant count stays |suffix buckets| x 3
-            self._prefix_pp_buckets = sorted({
-                max(1, maxp_lane // 4), max(1, maxp_lane // 2),
-                max(1, maxp_lane - 1),
-            })
+            self._prefix_pp_buckets = self._pp_widths(maxp_lane)
 
             def _prefill_prefix_insert(params, tokens, lengths, prefix_lens,
                                        prefix_table, reg_cols, reg_pages,
@@ -1170,6 +1176,16 @@ class Engine:
                                 req.on_done(req.request_id, [], "engine_error")
                             except Exception:
                                 pass
+
+    def _pp_widths(self, maxp: int) -> List[int]:
+        """Prefix-PP gather-width buckets (both prefix engines): each
+        width multiplies warmup's compile count by |prefill buckets|, so
+        long context drops the quarter width — its high-hit-rate regime
+        matches near-full prefixes anyway (see the prefill-bucket ladder
+        comment in __init__ for the per-compile cost)."""
+        widths = ({maxp // 2, maxp - 1} if self._long_context
+                  else {maxp // 4, maxp // 2, maxp - 1})
+        return sorted({max(1, w) for w in widths})
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:

@@ -299,3 +299,26 @@ def test_warmup_covers_all_variants():
         eng.stop()
     assert eng._prefill_fused._cache_size() == pre_prefill
     assert sum(d._cache_size() for d in eng._decode_variants) == pre_decode
+
+
+def test_default_bucket_ladder_scales_with_max_seq():
+    """Long-context engines use the x4 ladder: every bucket is a compiled
+    XLA variant (30-90 s each on the tunneled TPU image), and the x2
+    ladder at S=1024 put enough compiles in warmup to exceed the bench
+    watchdog. Short-context engines keep the fine x2 ladder."""
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def make(max_seq):
+        return Engine(
+            lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+            lambda b, s: llama.init_kv_cache(cfg, b, s),
+            params, max_batch=2, max_seq=max_seq, eos_id=2,
+        )
+
+    assert make(256).prefill_buckets == [16, 32, 64, 128, 256]
+    assert make(1024).prefill_buckets == [64, 256, 1024]
+    # the largest admissible prompt (max_seq - 1) must always fit, and the
+    # auto-appended top bucket is max_seq itself (stays tile/page aligned)
+    assert make(96).prefill_buckets == [16, 32, 64, 96]
+    assert make(600).prefill_buckets == [64, 256, 600]
