@@ -33,10 +33,12 @@ positions, cache)`` callable (Llama or Mixtral) plus cache constructors.
 
 from __future__ import annotations
 
+import concurrent.futures
 import functools
 import heapq
 import itertools
 import logging
+import os
 import threading
 import time
 import uuid
@@ -757,6 +759,27 @@ class Engine:
         """
         assert not self._any_active(), "warmup requires an idle engine"
         t0 = time.time()
+        try:
+            parallel = int(os.environ.get("SWARMDB_WARMUP_PARALLEL", "1"))
+        except ValueError:
+            logger.warning("SWARMDB_WARMUP_PARALLEL=%r is not an int; "
+                           "warming up sequentially",
+                           os.environ.get("SWARMDB_WARMUP_PARALLEL"))
+            parallel = 1
+        if parallel > 1:
+            # AOT-compile every variant concurrently FIRST: the serialized
+            # executables land in the persistent cache, so the sequential
+            # jit executions below deserialize in seconds instead of
+            # compiling for 30-90 s each (tunneled XLA service). Without
+            # the persistent cache the AOT executables would be discarded
+            # and everything would compile TWICE — refuse, loudly.
+            if jax.config.jax_compilation_cache_dir:
+                self.precompile(parallel)
+            else:
+                logger.warning(
+                    "SWARMDB_WARMUP_PARALLEL=%d ignored: persistent "
+                    "compile cache is off (set SWARMDB_COMPILE_CACHE), so "
+                    "parallel AOT results could not be reused", parallel)
         positions = np.zeros((self.max_batch,), np.int32)
         for variant, decode in enumerate(self._decode_variants):
             if self._mh is not None:
@@ -849,6 +872,99 @@ class Engine:
         self.metrics.latencies["warmup_s"].observe(dt)
         logger.info("engine warmup compiled %d prefill buckets + decode "
                     "chunk in %.1fs", len(self.prefill_buckets), dt)
+        return dt
+
+    def warmup_call_plan(self) -> List[Tuple[Any, Tuple[Any, ...]]]:
+        """(jitted fn, ShapeDtypeStruct args) for every variant warmup()
+        executes — the decode chunk x3 samplers, one prefill per bucket,
+        and one prefix prefill per (bucket, PP width). Must mirror
+        warmup()'s calls exactly — drift is caught end-to-end by
+        `test_precompile_cache_covers_warmup`, which asserts a
+        precompiled engine's warmup adds ZERO new persistent-cache
+        entries (any shape/dtype/arg-order/donation mismatch shows up
+        as a fresh compile)."""
+        sds = jax.ShapeDtypeStruct
+
+        def spec(x):
+            return jax.tree.map(lambda a: sds(a.shape, a.dtype), x)
+
+        B, Bp = self.max_batch, self.prefill_batch
+        params_s, cache_s = spec(self.params), spec(self.cache)
+        lt_s = sds((B,), jnp.int32)
+        llp_s = sds((B,), jnp.float32)
+        keys_B = spec(self._base_keys_np)
+        key_dt = self._base_keys_np.dtype
+        f32_B, i32_B = sds((B,), np.float32), sds((B,), np.int32)
+        plan: List[Tuple[Any, Tuple[Any, ...]]] = []
+        for decode in self._decode_variants:
+            plan.append((decode, (params_s, lt_s, llp_s, i32_B, cache_s,
+                                  keys_B, f32_B, i32_B, f32_B)))
+
+        keys_Bp = sds((Bp,) + self._base_keys_np.shape[1:], key_dt)
+        i32_Bp, f32_Bp = sds((Bp,), np.int32), sds((Bp,), np.float32)
+        for bucket in self.prefill_buckets:
+            tok = sds((Bp, bucket), np.int32)
+            if self.paged:
+                chunks = -(-bucket // self.paged.page_size)
+                plan.append((self._prefill_paged_fused, (
+                    params_s, tok, i32_Bp, sds((Bp, chunks), np.int32),
+                    i32_Bp, cache_s["k"], cache_s["v"], lt_s, llp_s,
+                    keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+            else:
+                plan.append((self._prefill_fused, (
+                    params_s, tok, i32_Bp, i32_Bp, cache_s, lt_s, llp_s,
+                    keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+        if self._prefix is not None:
+            for bucket in self.prefill_buckets:
+                for ppb in self._prefix_pp_buckets:
+                    tok = sds((Bp, bucket), np.int32)
+                    table = sds((Bp, ppb), np.int32)
+                    if self.paged:
+                        chunks = -(-bucket // self._prefix_ps)
+                        plan.append((self._prefill_paged_prefix_fused, (
+                            params_s, tok, i32_Bp, i32_Bp, table,
+                            sds((Bp, chunks), np.int32), i32_Bp,
+                            cache_s["k"], cache_s["v"], lt_s, llp_s,
+                            keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+                    else:
+                        lane_pages = min(ppb + -(-bucket // self._prefix_ps),
+                                         self.max_seq // self._prefix_ps)
+                        reg = sds((Bp, lane_pages), np.int32)
+                        plan.append((self._prefill_prefix_fused, (
+                            params_s, tok, i32_Bp, i32_Bp, table, reg, reg,
+                            i32_Bp, cache_s, lt_s, llp_s,
+                            spec(self._prefix_pool[0]),
+                            spec(self._prefix_pool[1]),
+                            keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+        return plan
+
+    def precompile(self, parallel: int = 4) -> float:
+        """AOT-compile every warmup variant with ``parallel`` threads and
+        return seconds spent. Compilation releases the GIL (XLA C++ /
+        the remote compile service), so independent variants overlap;
+        with the persistent cache on (utils/xla_cache.py) each compiled
+        executable is serialized to disk, and warmup()'s subsequent jit
+        executions — and any serving-path call — deserialize it instead
+        of recompiling. Pure compile: nothing executes on the device, so
+        engine state (cache donation lifecycle included) is untouched."""
+        t0 = time.time()
+        plan = self.warmup_call_plan()
+
+        def lower_one(item):
+            fn, specs = item
+            fn.lower(*specs).compile()
+
+        if parallel > 1:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=parallel) as ex:
+                # surface the first failure instead of swallowing it
+                list(ex.map(lower_one, plan))
+        else:
+            for item in plan:
+                lower_one(item)
+        dt = time.time() - t0
+        logger.info("precompiled %d variants with %d threads in %.1fs",
+                    len(plan), parallel, dt)
         return dt
 
     # ------------------------------------------------------------ submission

@@ -322,3 +322,127 @@ def test_default_bucket_ladder_scales_with_max_seq():
     # auto-appended top bucket is max_seq itself (stays tile/page aligned)
     assert make(96).prefill_buckets == [16, 32, 64, 96]
     assert make(600).prefill_buckets == [64, 256, 600]
+
+
+def test_precompile_plan_matches_warmup():
+    """warmup_call_plan() must cover exactly the variants warmup()
+    executes (3 decode samplers + one prefill per bucket + one prefix
+    prefill per bucket x PP width) and every entry must AOT-lower:
+    precompile() races these through .lower().compile() threads to fill
+    the persistent XLA cache ahead of sequential warmup."""
+    from swarmdb_tpu.models.configs import TINY_DEBUG as cfg
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+    init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+
+    # dense, no prefix: 3 decode + |buckets|
+    eng = Engine(fwd, init_cache, params, max_batch=2, max_seq=64,
+                 eos_id=2, prefill_buckets=[8, 16])
+    plan = eng.warmup_call_plan()
+    assert len(plan) == 3 + len(eng.prefill_buckets)
+    assert eng.precompile(parallel=2) >= 0.0
+    eng.warmup()  # state untouched by precompile: executes cleanly
+
+    # dense + prefix cache: adds |buckets| x |PP widths|
+    peng = Engine(
+        fwd, init_cache, params, max_batch=2, max_seq=64, eos_id=2,
+        prefill_buckets=[8, 16],
+        prefix_fns=(
+            lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+                llama.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
+                                          lp, logits_at=logits_at),
+            lambda n, ps: llama.init_prefix_pool(cfg, n, ps),
+        ),
+        prefix_pages=4, prefix_page_size=8,
+    )
+    pplan = peng.warmup_call_plan()
+    expect = (3 + len(peng.prefill_buckets)
+              + len(peng.prefill_buckets) * len(peng._prefix_pp_buckets))
+    assert len(pplan) == expect
+    for fn, specs in pplan:
+        fn.lower(*specs)  # type-checks every prefix variant
+
+
+def test_precompile_cache_covers_warmup(tmp_path):
+    """End-to-end drift guard for warmup_call_plan(): with the persistent
+    XLA cache on, precompile() must leave warmup() with ZERO new cache
+    entries — any spec/shape/dtype/arg-order/donation mismatch between
+    the plan and warmup's real calls shows up as a fresh compile here.
+    Covers the paged branches the inline-lowering test cannot."""
+    from swarmdb_tpu.backend.engine import PagedKV
+    from swarmdb_tpu.ops.paged_kv import PageAllocator
+    import swarmdb_tpu.utils.xla_cache as xla_cache
+
+    cfg = TINY_DEBUG
+    cache_dir = tmp_path / "xla"
+    prev_dir = xla_cache._ENABLED_DIR
+    assert xla_cache.enable_compile_cache(str(cache_dir)) == str(cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+        init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+
+        dense = Engine(
+            fwd, init_cache, params, max_batch=2, max_seq=64, eos_id=2,
+            prefill_buckets=[8],
+            prefix_fns=(
+                lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+                    llama.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
+                                              lp, logits_at=logits_at),
+                lambda n, ps: llama.init_prefix_pool(cfg, n, ps),
+            ),
+            prefix_pages=4, prefix_page_size=8,
+        )
+        ps, num_pages = 8, 17  # 2 rows x 8 pages/row + trash
+        paged = Engine(
+            fwd, init_cache, params, max_batch=2, max_seq=64, eos_id=2,
+            prefill_buckets=[8],
+            paged=PagedKV(
+                decode_forward=lambda p, t, pos, c:
+                    llama.forward_paged(p, cfg, t, pos, c),
+                init_pool=lambda: llama.init_paged_cache(
+                    cfg, 2, 64, num_pages, ps),
+                page_size=ps, num_pages=num_pages,
+                allocator=PageAllocator(num_pages, ps, 64, 2),
+            ),
+            prefix_fns=(
+                lambda p, t, tab, pl, pk, pv, logits_at=None:
+                    llama.forward_prefix_pages(p, cfg, t, tab, pl, pk, pv,
+                                               logits_at=logits_at),
+                None,
+            ),
+        )
+        for eng in (dense, paged):
+            eng.precompile(parallel=2)
+        before = {p.name for p in cache_dir.iterdir()}
+        assert before, "precompile wrote nothing to the persistent cache"
+        for eng in (dense, paged):
+            eng.warmup()
+        after = {p.name for p in cache_dir.iterdir()}
+        assert after == before, (
+            f"warmup compiled {len(after - before)} programs precompile "
+            f"missed — warmup_call_plan() drifted from warmup()")
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        xla_cache._ENABLED_DIR = prev_dir
+
+
+def test_warmup_parallel_env_is_forgiving(monkeypatch):
+    """A malformed SWARMDB_WARMUP_PARALLEL falls back to sequential, and
+    parallel>1 without a persistent cache is refused (not run twice)."""
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine(
+        lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+        lambda b, s: llama.init_kv_cache(cfg, b, s),
+        params, max_batch=2, max_seq=32, eos_id=2, prefill_buckets=[8])
+    monkeypatch.setenv("SWARMDB_WARMUP_PARALLEL", "definitely-not-an-int")
+    assert eng.warmup() >= 0.0
+    # no persistent cache configured in this process by default: the
+    # parallel path logs-and-skips rather than compiling everything twice
+    monkeypatch.setenv("SWARMDB_WARMUP_PARALLEL", "4")
+    assert jax.config.jax_compilation_cache_dir in (None, "")
+    assert eng.warmup() >= 0.0
