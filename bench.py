@@ -670,8 +670,13 @@ def bench_longctx(seconds: float) -> dict:
     out = bench_serve(seconds)
     out["mode"] = "longctx"
     # distinct metric name: ledgers keyed on the metric field must never
-    # record the S=1024 workload as the S=256 serve headline
+    # record the S=1024 workload as the S=256 serve headline — and the
+    # regime-defining config rides the line so an ambient env override
+    # (setdefault above) can never masquerade undetectably
     out["metric"] = "longctx_completed_messages_per_sec"
+    out["max_seq"] = _env("SWARMDB_BENCH_SEQ", 1024)
+    out["paged"] = _env("SWARMDB_BENCH_PAGED", 1, int) == 1
+    out["page_size"] = _env("SWARMDB_BENCH_PAGE_SIZE", 64)
     return out
 
 

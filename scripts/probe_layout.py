@@ -73,45 +73,35 @@ def t(label, fn, *args):
 key = jax.random.PRNGKey(0)
 q = jax.random.normal(key, (B, 1, H, G, D), jnp.bfloat16)
 
-# current layout: k,v [B, S, H, D] per layer; loop L layers to match a chunk
-k_cur = jax.random.normal(key, (L, B, S, H, D), jnp.bfloat16)
-v_cur = jax.random.normal(key, (L, B, S, H, D), jnp.bfloat16)
+
+def make_attn(kv_sub):
+    """Score/out einsums parameterized by the per-layer K/V subscripts
+    (e.g. 'bskd'); softmax/accumulate scaffolding shared."""
+    def attn(q, ks, vs):
+        def one(carry, kv):
+            k, v = kv
+            s = jnp.einsum(f"btkgd,{kv_sub}->bkgts", q, k,
+                           preferred_element_type=jnp.float32)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            o = jnp.einsum(f"bkgts,{kv_sub}->btkgd", p, v,
+                           preferred_element_type=jnp.float32)
+            return carry + jnp.sum(o.astype(jnp.float32)), None
+
+        tot, _ = jax.lax.scan(one, jnp.float32(0), (ks, vs))
+        return tot
+
+    return attn
 
 
-def attn_cur(q, ks, vs):
-    def one(carry, kv):
-        k, v = kv
-        s = jnp.einsum("btkgd,bskd->bkgts", q, k,
-                       preferred_element_type=jnp.float32)
-        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bkgts,bskd->btkgd", p, v,
-                       preferred_element_type=jnp.float32)
-        return carry + jnp.sum(o.astype(jnp.float32)), None
-
-    tot, _ = jax.lax.scan(one, jnp.float32(0), (ks, vs))
-    return tot
-
-
-# proposed: k [B, H, D, S] (tile-exact), v [B, H, D, S] -> contract last dim
-k_new = jax.random.normal(key, (L, B, H, D, S), jnp.bfloat16)
-v_new = jax.random.normal(key, (L, B, H, D, S), jnp.bfloat16)
-
-
-def attn_new(q, ks, vs):
-    def one(carry, kv):
-        k, v = kv  # [B, H, D, S]
-        s = jnp.einsum("btkgd,bkds->bkgts", q, k,
-                       preferred_element_type=jnp.float32)
-        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bkgts,bkds->btkgd", p, v,
-                       preferred_element_type=jnp.float32)
-        return carry + jnp.sum(o.astype(jnp.float32)), None
-
-    tot, _ = jax.lax.scan(one, jnp.float32(0), (ks, vs))
-    return tot
-
-
-print("attention over full cache, L layers scanned, x16 steps equiv:",
+LAYOUTS = (
+    # label, full-array shape, per-layer K/V einsum subscripts
+    ("current  [B,S,H,D]", (L, B, S, H, D), "bskd"),   # engine layout
+    ("proposed [B,H,D,S]", (L, B, H, D, S), "bkds"),   # tile-exact
+    ("batched  [B,H,S,D]", (L, B, H, S, D), "bksd"),   # (b,h) batch-leading
+)
+print("attention over full cache, L layers scanned, 1 decode step:",
       flush=True)
-t("current  [B,S,H,D] (1 step, all layers)", attn_cur, q, k_cur, v_cur)
-t("proposed [B,H,D,S] (1 step, all layers)", attn_new, q, k_new, v_new)
+for label, shape, sub in LAYOUTS:
+    ks = jax.random.normal(key, shape, jnp.bfloat16)
+    vs = jax.random.normal(key, shape, jnp.bfloat16)
+    t(f"{label} (1 step, all layers)", make_attn(sub), q, ks, vs)

@@ -197,7 +197,15 @@ class ServingService:
         chunked_fns = None
         if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
             chunk_fwd = mod.forward_paged_chunked if paged else mod.forward_chunked
-            merge = mod.merge_paged_chunk if paged else mod.merge_chunk
+            if paged:
+                merge = mod.merge_paged_chunk
+            elif os.environ.get("SWARMDB_MERGE", "einsum") == "scatter":
+                # scatter-form chunk merge: numerically identical
+                # (ops/layers.merge_chunk_kv_scatter); raced against the
+                # einsum form on silicon by scripts/profile_merge.py
+                merge = mod.merge_chunk_scatter
+            else:
+                merge = mod.merge_chunk
             chunked_fns = (
                 lambda p, t, pos, c, hkv, s: chunk_fwd(p, cfg, t, pos, c,
                                                        hkv, s),

@@ -385,6 +385,20 @@ def merge_chunk(
     return merge_chunk_kv(ck, cv, hk, hv, start_positions)
 
 
+def merge_chunk_scatter(
+    cache: KVCache,
+    chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],
+    start_positions: jnp.ndarray,  # [B]
+) -> KVCache:
+    """Scatter-form merge (ops/layers.merge_chunk_kv_scatter); selected
+    by SWARMDB_MERGE=scatter — see that function for the trade."""
+    from ..ops.layers import merge_chunk_kv_scatter
+
+    ck, cv = cache
+    hk, hv = chunk_kv
+    return merge_chunk_kv_scatter(ck, cv, hk, hv, start_positions)
+
+
 def forward_paged(
     params: Params,
     cfg: ModelConfig,

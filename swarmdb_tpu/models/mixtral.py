@@ -232,6 +232,7 @@ from .llama import (  # noqa: E402, F401
     init_chunk_kv,
     init_prefix_pool,
     merge_chunk,
+    merge_chunk_scatter,
     merge_paged_chunk,
 )
 

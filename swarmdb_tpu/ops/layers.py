@@ -343,6 +343,39 @@ def merge_chunk_kv(
     return upd(cache_k, chunk_k), upd(cache_v, chunk_v)
 
 
+def merge_chunk_kv_scatter(
+    cache_k: jnp.ndarray,   # [L, B, S, Hkv, D]
+    cache_v: jnp.ndarray,
+    chunk_k: jnp.ndarray,   # [L, B, Kc, Hkv, D]
+    chunk_v: jnp.ndarray,
+    start_positions: jnp.ndarray,  # [B]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter formulation of ``merge_chunk_kv`` (numerically identical;
+    `test_merge_chunk_scatter_matches_einsum`).
+
+    One [B, Kc]-indexed `.at[].set` per cache tensor instead of the
+    one-hot einsum + select. The chunk trace showed ~27 ms/chunk of
+    merge + full-cache copies around the einsum form at B=128
+    (PROFILE.md session 2); this form writes only the Kc columns and
+    gives XLA a direct in-place-update pattern for the donated cache.
+    TPU scatters serialize per index row, which is why the PER-STEP
+    [B, 1] scatter lost badly in round 3 — per CHUNK the amortization
+    may land differently. Raced on silicon by scripts/profile_merge.py;
+    selected via SWARMDB_MERGE=scatter (backend/service.py)."""
+    Kc = chunk_k.shape[2]
+    b_idx = jnp.arange(cache_k.shape[1])[:, None]        # [B, 1]
+    cols = start_positions[:, None] + jnp.arange(Kc)[None, :]  # [B, Kc]
+    # a chunk may overshoot its lane (the engine dispatches full K-step
+    # chunks and retires on max_seq at processing time): mode="drop"
+    # discards the out-of-range columns, matching the einsum form's hit
+    # mask (kv_pos < start + Kc never fires past S there)
+    ck = cache_k.at[:, b_idx, cols].set(chunk_k.astype(cache_k.dtype),
+                                        mode="drop")
+    cv = cache_v.at[:, b_idx, cols].set(chunk_v.astype(cache_v.dtype),
+                                        mode="drop")
+    return ck, cv
+
+
 def gqa_attention(
     q: jnp.ndarray,          # [B, T, Hq, D]
     cache_k: jnp.ndarray,    # [B, S, Hkv, D]

@@ -193,3 +193,27 @@ def test_logits_at_matches_full_forward():
         rtol=1e-5, atol=1e-5,
     )
     np.testing.assert_array_equal(np.asarray(ck), np.asarray(ck2))
+
+
+def test_merge_chunk_scatter_matches_einsum():
+    """The scatter-form chunk merge (SWARMDB_MERGE=scatter) must be
+    bit-identical to the one-hot-einsum form for in-range chunks AND for
+    chunks overshooting the lane end (einsum: hit-mask drop; scatter:
+    mode='drop')."""
+    from swarmdb_tpu.ops.layers import (merge_chunk_kv,
+                                        merge_chunk_kv_scatter)
+
+    rng = np.random.default_rng(11)
+    L, B, S, Kc, H, D = 3, 5, 32, 8, 2, 4
+    ck = jnp.asarray(rng.normal(size=(L, B, S, H, D)).astype(np.float32))
+    cv = jnp.asarray(rng.normal(size=(L, B, S, H, D)).astype(np.float32))
+    hk = jnp.asarray(rng.normal(size=(L, B, Kc, H, D)).astype(np.float32))
+    hv = jnp.asarray(rng.normal(size=(L, B, Kc, H, D)).astype(np.float32))
+    # rows: interior, position 0, exactly flush with the end, overshooting
+    # by half a chunk, overshooting entirely except one column
+    starts = jnp.asarray(np.array([10, 0, S - Kc, S - Kc // 2, S - 1],
+                                  np.int32))
+    ek, ev = merge_chunk_kv(ck, cv, hk, hv, starts)
+    sk, sv = merge_chunk_kv_scatter(ck, cv, hk, hv, starts)
+    np.testing.assert_array_equal(np.asarray(ek), np.asarray(sk))
+    np.testing.assert_array_equal(np.asarray(ev), np.asarray(sv))

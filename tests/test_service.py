@@ -2,6 +2,7 @@
 backend consumer, tool-use replies, health. Tiny model on CPU."""
 
 import asyncio
+import tempfile
 import threading
 import time
 
@@ -152,3 +153,23 @@ def test_health_probe(served_db):
     assert h["status"] == "healthy"
     assert "engine" in h and h["engine"]["max_batch"] == 4
     assert h["probe_ms"] >= 0
+
+
+def test_merge_env_selects_scatter(monkeypatch):
+    """SWARMDB_MERGE=scatter wires the scatter-form chunk merge into the
+    engine's chunked decode (dense mode only; paged has its own merge)."""
+    from swarmdb_tpu.backend.service import ServingService
+    from swarmdb_tpu.models import llama
+
+    monkeypatch.setenv("SWARMDB_MERGE", "scatter")
+    monkeypatch.setenv("SWARMDB_PAGED", "0")
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        try:
+            svc = ServingService.from_model_name(
+                db, "tiny-debug", backend_id="b0", max_batch=2, max_seq=32,
+                decode_chunk=4)
+            assert svc.engine._chunked_fns is not None
+            assert svc.engine._chunked_fns[2] is llama.merge_chunk_scatter
+        finally:
+            db.close()
