@@ -42,6 +42,18 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
         # cache everything that took meaningful compile time; the tiny
         # helper jits (health probe, token scatter) stay out of the cache
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # jax pins the cache object to the dir in effect at FIRST use; a
+        # later config update alone is silently ignored. The dir may have
+        # been pinned by anyone (env var, direct config update, an earlier
+        # call here), so reset unconditionally — a no-op when nothing is
+        # pinned yet
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — private API, best effort
+            logger.warning("could not reset pinned compilation cache; "
+                           "new dir %s may not take effect", path)
         _ENABLED_DIR = path
         logger.info("persistent XLA compilation cache at %s", path)
     except Exception:  # noqa: BLE001 — cache is an optimization, not a dep

@@ -441,8 +441,13 @@ def test_warmup_parallel_env_is_forgiving(monkeypatch):
         params, max_batch=2, max_seq=32, eos_id=2, prefill_buckets=[8])
     monkeypatch.setenv("SWARMDB_WARMUP_PARALLEL", "definitely-not-an-int")
     assert eng.warmup() >= 0.0
-    # no persistent cache configured in this process by default: the
-    # parallel path logs-and-skips rather than compiling everything twice
+    # without a persistent cache the parallel path must log-and-skip
+    # rather than compile everything twice (earlier suite tests may have
+    # enabled a cache process-wide — force the condition, then restore)
     monkeypatch.setenv("SWARMDB_WARMUP_PARALLEL", "4")
-    assert jax.config.jax_compilation_cache_dir in (None, "")
-    assert eng.warmup() >= 0.0
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        assert eng.warmup() >= 0.0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
