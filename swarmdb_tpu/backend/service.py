@@ -46,6 +46,26 @@ logger = logging.getLogger("swarmdb_tpu.serving")
 _HEALTH_PROBE = jax.jit(lambda x: (x * 2).sum())
 
 
+def _env_int(name: str, default: int) -> int:
+    """Forgiving env parse (repo convention: a malformed tuning knob
+    logs and falls back, it never takes the serving path down)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        logger.warning("%s=%r is not an int; using %d", name,
+                       os.environ.get(name), default)
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        logger.warning("%s=%r is not a float; using %g", name,
+                       os.environ.get(name), default)
+        return default
+
+
 def build_prompt(db: SwarmDB, msg: Message, tokenizer: Tokenizer,
                  history_limit: Optional[int] = None) -> List[int]:
     """Chat-style prompt from the two-way conversation plus the new message.
@@ -54,15 +74,18 @@ def build_prompt(db: SwarmDB, msg: Message, tokenizer: Tokenizer,
     is embedded as JSON — the Mixtral tool-use path (BASELINE config 4).
     """
     if history_limit is None:
-        # High default: a SLIDING message window breaks prefix caching
-        # (every turn re-renders a different string, so no page-aligned
-        # prefix survives); the token-budget trim in serve_message bounds
-        # prompt length instead, in page-aligned hysteresis steps
-        history_limit = int(os.environ.get("SWARMDB_HISTORY_LIMIT", "64"))
+        # The window must be anchored in STREAM coordinates: a plain
+        # newest-N fetch slides by one message every turn once N binds,
+        # so consecutive prompts share no prefix and the prefix cache
+        # goes dark for the rest of the conversation (measured: the
+        # serve-mode hit rate cliffs to ~0 after ~N/2 turns). The
+        # token-budget trim in serve_message provides the second,
+        # token-level hysteresis.
+        history_limit = _env_int("SWARMDB_HISTORY_LIMIT", 64)
     lines: List[str] = []
     if msg.receiver_id:
-        convo = db.get_conversation(msg.sender_id, msg.receiver_id,
-                                    limit=history_limit)
+        convo = db.get_conversation_window(msg.sender_id, msg.receiver_id,
+                                           history_limit)
         for m in convo:
             if m.id == msg.id:
                 continue
@@ -405,7 +428,18 @@ class ServingService:
         if len(prompt) > budget:
             if self.engine._prefix is not None:
                 ps = self.engine._prefix_ps
-                step = max(ps, (budget // 2) // ps * ps)
+                # trim-step fraction trades history depth right after a
+                # jump against epoch length: each jump re-anchors the
+                # prompt start, and EVERY cached page of the conversation
+                # is invalidated across a jump (prompt positions restart
+                # at 0, so KV computed under the old anchor is
+                # numerically wrong under the new one). Longer epochs =
+                # fewer full-miss turns; measured on the serve mix the
+                # jump misses are the single largest loss (~37% of
+                # prompt tokens at the 0.5 default, scripts/probe_prefix)
+                frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
+                frac = min(0.9, max(0.1, frac))
+                step = max(ps, int(budget * frac) // ps * ps)
                 drop = -(-(len(prompt) - budget) // step) * step  # round UP
                 if len(prompt) - drop >= 16:
                     prompt = prompt[drop:]

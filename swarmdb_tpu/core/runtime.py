@@ -616,6 +616,45 @@ class SwarmDB:
             tail = list(tail)
         return sorted(tail, key=lambda m: m.timestamp)
 
+    def conversation_length(self, agent_a: str, agent_b: str) -> int:
+        """Total messages ever exchanged between the pair — O(1).
+
+        Consumers that window a conversation (e.g. the serving layer's
+        prompt builder) need the STREAM position to anchor their window:
+        a window computed only from the newest-N fetch slides by one
+        message per turn once N binds, which defeats any prefix reuse of
+        the rendered prompt."""
+        with self._lock:
+            return len(self._conversations.get(
+                self._pair(agent_a, agent_b), ()))
+
+    def get_conversation_window(
+        self, agent_a: str, agent_b: str, limit: int
+    ) -> List[Message]:
+        """Hysteresis-anchored conversation window, atomically.
+
+        Drops old messages in half-``limit`` steps computed from the
+        TOTAL stream length, so the window start moves once per ~limit/2
+        turns instead of every turn (a plain newest-``limit`` fetch
+        slides per message once it binds, and a prompt rendered from a
+        sliding window shares no prefix with its predecessor). Length
+        and slice are taken under ONE lock acquisition: splitting them
+        lets a concurrent send shift the window by one message for that
+        turn — exactly the one-off prefix miss the anchoring prevents."""
+        if limit <= 0:
+            return []
+        pair = self._pair(agent_a, agent_b)
+        with self._lock:
+            stream = self._conversations.get(pair, ())
+            total = len(stream)
+            keep = limit
+            if total > limit:
+                step = max(1, limit // 2)
+                start = -(-(total - limit) // step) * step  # round UP
+                keep = max(1, total - start)
+            tail = list(stream[-keep:])
+        return sorted(tail, key=lambda m: m.timestamp)
+
     # ------------------------------------------------------------- status mgmt
 
     def _set_status(self, msg: Message, status: MessageStatus) -> None:

@@ -173,3 +173,40 @@ def test_merge_env_selects_scatter(monkeypatch):
             assert svc.engine._chunked_fns[2] is llama.merge_chunk_scatter
         finally:
             db.close()
+
+
+def test_build_prompt_window_is_anchor_stable(monkeypatch):
+    """Prompts must stay prefix-stable (each turn extends the previous
+    prompt) even after the conversation exceeds SWARMDB_HISTORY_LIMIT:
+    the message window drops old turns in half-limit hysteresis steps
+    anchored at the STREAM position, not a newest-N slice that slides
+    every turn (which made the prefix cache go dark after ~limit/2
+    turns)."""
+    from swarmdb_tpu.backend.tokenizer import ByteTokenizer
+
+    monkeypatch.setenv("SWARMDB_HISTORY_LIMIT", "16")
+    tok = ByteTokenizer(vocab_size=512)
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        try:
+            db.register_agent("u")
+            db.register_agent("a")
+            prev = None
+            jumps = 0
+            turns = 60  # well past the 16-message window
+            for i in range(turns):
+                mid = db.send_message("u", "a", f"turn {i} says hello")
+                msg = db.get_message(mid)
+                prompt = tok.decode(build_prompt(db, msg, tok))
+                # drop the trailing "a:" assistant cue: the next turn
+                # continues from there
+                body = prompt.rsplit("\na:", 1)[0]
+                if prev is not None and not body.startswith(prev):
+                    jumps += 1
+                prev = body
+            # anchor may move only at hysteresis boundaries: with
+            # limit=16/step=8 that is ~once per 8 turns past the limit,
+            # not every turn (the old behavior: ~44 jumps here)
+            assert jumps <= turns // 8 + 1, jumps
+        finally:
+            db.close()
