@@ -195,9 +195,13 @@ def serving_stack(model: str, n_assistants: int, max_batch: int, max_seq: int,
         "SWARMDB_COMPILE_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     ))
-    # bench chips are dedicated: size the prefix pool at the full decode-
-    # cache footprint (the conservative library default is half that)
-    os.environ.setdefault("SWARMDB_PREFIX_TOKENS", str(max_batch * max_seq))
+    # bench chips are dedicated: size the prefix pool at 2x the decode-
+    # cache footprint (the conservative library default is half of it).
+    # The serve workload keeps ~n_users live conversation chains PLUS one
+    # stale chain generation per trim epoch; at exactly 1x the pool ran
+    # full (BENCH r4: 2046/2047 pages) and LRU evicted live chains
+    # (probe_prefix: eviction shortfall ~22% of prompt tokens)
+    os.environ.setdefault("SWARMDB_PREFIX_TOKENS", str(2 * max_batch * max_seq))
     with tempfile.TemporaryDirectory() as tmp:
         db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
                      autosave_interval=1e9, max_messages_per_file=10**9)
