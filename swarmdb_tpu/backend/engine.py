@@ -68,6 +68,23 @@ class GenRequest:
     on_done: Optional[Callable[[str, List[int], str], None]] = None
     submitted_at: float = field(default_factory=time.time)
     metadata: Dict[str, Any] = field(default_factory=dict)
+    # ---- rolling-KV conversation continuation (paged engines only) ----
+    # resume_pages: page ids already holding this conversation's KV (the
+    # CALLER keeps custody — the engine only references them; see
+    # ServingService's rolling registry). resume_len: tokens already in
+    # those pages; ``prompt`` then carries ONLY the new suffix tokens and
+    # decode continues at resume_len + len(prompt).
+    resume_pages: Optional[List[int]] = None
+    resume_len: int = 0
+    # keep_pages: at retirement, transfer the slot's fresh pages out of
+    # engine custody and fire on_pages(request_id, pages, written_len,
+    # tail_tokens) instead of freeing — the caller may resume from them
+    # next turn. tail_tokens are emitted tokens whose K/V is not yet
+    # written (host-confirmed extent is chunk-granular); prepend them to
+    # the next resume's prompt.
+    keep_pages: bool = False
+    on_pages: Optional[Callable[[str, List[int], int, List[int]],
+                                None]] = None
 
 
 @dataclass
@@ -513,6 +530,45 @@ class Engine:
             self._prefill_paged_prefix_fused = jax.jit(
                 _prefill_paged_prefix_insert, donate_argnums=(7, 8, 9, 10)
             )
+
+            # ---- rolling-KV resume: suffix prefill continuing a kept
+            # conversation MID-PAGE. Same suffix forward as the prefix
+            # path (attend kept pages + suffix, positions offset by
+            # resume_len), but the suffix K/V is written POSITIONALLY via
+            # paged_write_chunk (start = resume_len, arbitrary alignment)
+            # into the row's table instead of whole-page scatters — a
+            # conversation's length after decode is never page-aligned.
+            def _prefill_paged_resume_insert(params, tokens, lengths,
+                                             resume_lens, prefix_table,
+                                             row_tables, slot_ids, k_pool,
+                                             v_pool, last_tokens, last_lps,
+                                             base_keys, temp, topk, topp):
+                from ..ops.paged_kv import paged_write_chunk
+
+                Bp, T = tokens.shape
+                logits, sk, sv = pages_fwd(
+                    params, tokens, prefix_table, resume_lens, k_pool,
+                    v_pool, logits_at=lengths - 1,
+                )
+                last = (logits if logits.ndim == 2
+                        else logits[jnp.arange(Bp), lengths - 1])
+                next_tok = sample_tokens(
+                    last, base_keys, resume_lens + lengths - 1, temp, topk,
+                    topp,
+                )
+                lp = token_logprob(last, next_tok)
+                k_pool, v_pool = paged_write_chunk(
+                    k_pool, v_pool, sk.astype(k_pool.dtype),
+                    sv.astype(v_pool.dtype), resume_lens, row_tables,
+                )
+                last_tokens = last_tokens.at[slot_ids].set(next_tok,
+                                                           mode="drop")
+                last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+                return k_pool, v_pool, last_tokens, last_lps
+
+            self._prefill_paged_resume_fused = jax.jit(
+                _prefill_paged_resume_insert, donate_argnums=(7, 8, 9, 10)
+            )
         elif prefix_fns is not None:
             if max_seq % prefix_page_size:
                 raise ValueError("max_seq must be a page-size multiple "
@@ -814,8 +870,7 @@ class Engine:
                     self.cache["k"], self.cache["v"], self._last_tokens,
                     self._last_lps, keys, zero_f, zero_i, ones_f,
                 )
-                self.cache = {"k": k_pool, "v": v_pool,
-                              "page_table": self.cache["page_table"]}
+                self.cache = self._paged_cache_with(k_pool, v_pool)
             else:
                 drop = np.full(Bp, self.max_batch, np.int32)
                 if self._mh is not None:
@@ -848,8 +903,24 @@ class Engine:
                                 self._last_lps, keys, zero_f, zero_i,
                                 ones_f,
                             ))
-                        self.cache = {"k": pk, "v": pv,
-                                      "page_table": self.cache["page_table"]}
+                        self.cache = self._paged_cache_with(pk, pv)
+                        if self._warm_resume():
+                            # rolling-KV resume variants (gated: each is a
+                            # 30-90 s compile on the tunneled service and
+                            # only SWARMDB_ROLLING_KV deployments hit them)
+                            maxp = self.paged.allocator.maxp
+                            pk, pv = self.cache["k"], self.cache["v"]
+                            (pk, pv, self._last_tokens,
+                             self._last_lps) = self._prefill_paged_resume_fused(
+                                self.params, tokens, lengths,
+                                np.zeros(Bp, np.int32),
+                                np.zeros((Bp, ppb), np.int32),
+                                np.zeros((Bp, maxp), np.int32),
+                                drop, pk, pv, self._last_tokens,
+                                self._last_lps, keys, zero_f, zero_i,
+                                ones_f,
+                            )
+                            self.cache = self._paged_cache_with(pk, pv)
                         continue
                     lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                      self.max_seq // self._prefix_ps)
@@ -873,6 +944,26 @@ class Engine:
         logger.info("engine warmup compiled %d prefill buckets + decode "
                     "chunk in %.1fs", len(self.prefill_buckets), dt)
         return dt
+
+    def _paged_cache_with(self, k_pool, v_pool):
+        """Rebuild the paged cache dict around new k/v pools, carrying
+        every non-pool field (page_table, pos0) — ONE site instead of a
+        hand-maintained key list at each fused-dispatch return (a
+        forgotten key is a KeyError that kills the decode loop)."""
+        out = dict(self.cache)
+        out["k"] = k_pool
+        out["v"] = v_pool
+        return out
+
+    def _warm_resume(self) -> bool:
+        """Whether warmup covers the rolling-KV resume variants (paged +
+        prefix engines, SWARMDB_ROLLING_KV deployments only). ONE gate
+        shared by warmup() and warmup_call_plan() — they must agree or
+        the precompile drift test fails."""
+        return (self.paged is not None
+                and getattr(self, "_prefill_paged_resume_fused", None)
+                is not None
+                and os.environ.get("SWARMDB_ROLLING_KV") == "1")
 
     def warmup_call_plan(self) -> List[Tuple[Any, Tuple[Any, ...]]]:
         """(jitted fn, ShapeDtypeStruct args) for every variant warmup()
@@ -926,6 +1017,13 @@ class Engine:
                             sds((Bp, chunks), np.int32), i32_Bp,
                             cache_s["k"], cache_s["v"], lt_s, llp_s,
                             keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+                        if self._warm_resume():
+                            maxp = self.paged.allocator.maxp
+                            plan.append((self._prefill_paged_resume_fused, (
+                                params_s, tok, i32_Bp, i32_Bp, table,
+                                sds((Bp, maxp), np.int32), i32_Bp,
+                                cache_s["k"], cache_s["v"], lt_s, llp_s,
+                                keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
                     else:
                         lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                          self.max_seq // self._prefix_ps)
@@ -971,10 +1069,27 @@ class Engine:
 
     def submit(self, request: GenRequest) -> str:
         """Thread-safe enqueue; returns the request id."""
-        if len(request.prompt) >= self.max_seq:
+        if request.resume_len + len(request.prompt) >= self.max_seq:
             raise ValueError(
-                f"prompt length {len(request.prompt)} >= max_seq {self.max_seq}"
+                f"prompt length {request.resume_len + len(request.prompt)} "
+                f"(incl. resumed) >= max_seq {self.max_seq}"
             )
+        if request.resume_pages is not None:
+            if not self.paged or getattr(
+                    self, "_prefill_paged_resume_fused", None) is None:
+                raise ValueError("resume_pages requires a paged engine "
+                                 "with the prefix machinery enabled")
+            if not request.resume_pages or request.resume_len <= 0:
+                raise ValueError("resume needs pages and resume_len > 0")
+            ps = self.paged.page_size
+            if len(request.resume_pages) > self._prefix_pp_buckets[-1]:
+                raise ValueError(
+                    f"{len(request.resume_pages)} resume pages exceed the "
+                    f"widest prefix-gather bucket "
+                    f"{self._prefix_pp_buckets[-1]}")
+            if -(-request.resume_len // ps) != len(request.resume_pages):
+                raise ValueError("resume_pages must exactly cover "
+                                 "resume_len")
         if self.paged:
             need = self.paged.allocator.pages_needed(
                 len(request.prompt), request.sampling.max_new_tokens,
@@ -1171,17 +1286,48 @@ class Engine:
                     rows = []
                     plans: Dict[int, Tuple] = {}
                     use_pp = self._prefix is not None and self._mh is None
+                    resume_rows: Dict[int, np.ndarray] = {}
                     for slot_id in free[:take]:
                         if not self._queue:
                             break
                         req = self._queue[0][3]
+                        if req.resume_pages is not None:
+                            # rolling-KV continuation: the kept pages are
+                            # referenced (caller custody); only the part
+                            # past resume_len needs fresh pages
+                            ps_ = self.paged.page_size
+                            worst = min(
+                                self.paged.allocator.max_seq,
+                                req.resume_len + len(req.prompt)
+                                + req.sampling.max_new_tokens
+                                + self.decode_chunk,
+                            )
+                            total = -(-worst // ps_)
+                            n_fresh = max(0,
+                                          total - len(req.resume_pages))
+                            row = self.paged.allocator.allocate_with_prefix(
+                                slot_id, req.resume_pages, n_fresh)
+                            if row is None:
+                                break  # pool exhausted; retry later
+                            heapq.heappop(self._queue)
+                            self._admitting.add(req.request_id)
+                            popped.append(req)
+                            rows.append((slot_id, row))
+                            resume_rows[slot_id] = row
+                            continue
                         need = self.paged.allocator.pages_needed(
                             len(req.prompt), req.sampling.max_new_tokens,
                             self.decode_chunk,
                         )
                         hits: List[int] = []
                         chains: List[bytes] = []
-                        if use_pp and len(req.prompt) >= self._prefix_ps:
+                        # keep_pages (rolling) requests bypass the hash
+                        # prefix cache both ways: a hit would reference
+                        # cache-custody pages that retirement cannot hand
+                        # to the caller, and registration would steal the
+                        # slot's own pages INTO cache custody
+                        if (use_pp and len(req.prompt) >= self._prefix_ps
+                                and not req.keep_pages):
                             hits, chains = self._prefix_plan(req.prompt,
                                                              pin=True)
                         row = self._paged_allocate(slot_id, hits,
@@ -1193,11 +1339,13 @@ class Engine:
                         self._admitting.add(req.request_id)
                         popped.append(req)
                         rows.append((slot_id, row))
-                        if use_pp and len(req.prompt) >= self._prefix_ps:
+                        if (use_pp and len(req.prompt) >= self._prefix_ps
+                                and not req.keep_pages):
                             plans[slot_id] = (hits, chains)
                     if not popped:
                         return
                 else:
+                    resume_rows = {}
                     popped = [heapq.heappop(self._queue)[3] for _ in range(take)]
                     self._admitting.update(r.request_id for r in popped)
             if self.paged and rows:
@@ -1211,8 +1359,15 @@ class Engine:
             use_prefix = self._prefix is not None and self._mh is None
             groups: Dict[Tuple[int, int], List[Tuple]] = {}
             prefix_batch: List[Tuple] = []
+            resume_batch: List[Tuple] = []
             max_suffix = max_hits = 0
+            max_suffix_r = max_pages_r = 0
             for slot_id, req in zip(free, popped):
+                if slot_id in resume_rows:
+                    resume_batch.append((slot_id, req, resume_rows[slot_id]))
+                    max_suffix_r = max(max_suffix_r, len(req.prompt))
+                    max_pages_r = max(max_pages_r, len(req.resume_pages))
+                    continue
                 # sub-page prompts (no hit possible, nothing to register)
                 # stay on the plain path; everything else goes through the
                 # prefix path even on a full miss so its pages get
@@ -1247,9 +1402,17 @@ class Engine:
                 key = (self._bucket_for(max(1, max_suffix)),
                        self._pp_bucket_for(max(1, max_hits)))
                 groups[key] = prefix_batch
+            if resume_batch:
+                # rolling-KV continuations: same one-group-per-wave rule;
+                # the sentinel -ppb key routes to the resume prefill
+                key = (self._bucket_for(max(1, max_suffix_r)),
+                       -self._pp_bucket_for(max(1, max_pages_r)))
+                groups[key] = resume_batch
             for (bucket, ppb), batch in groups.items():
                 try:
-                    if ppb > 0 and self.paged:
+                    if ppb < 0:
+                        self._prefill_paged_resume_batch(batch, bucket, -ppb)
+                    elif ppb > 0 and self.paged:
                         self._prefill_paged_prefix_batch(batch, bucket, ppb)
                     elif ppb > 0:
                         self._prefill_prefix_batch(batch, bucket, ppb)
@@ -1285,7 +1448,11 @@ class Engine:
                             # allocate() raises "already holds pages" and the
                             # whole engine fails over (review finding)
                             self.paged.allocator.mark_retired(slot_id)
-                            if len(item) > 2 and item[2]:
+                            # prefix items carry (slot, req, hits, chains);
+                            # resume items carry (slot, req, row ndarray) —
+                            # only matched-hit LISTS are pinned
+                            if (len(item) > 2 and isinstance(item[2], list)
+                                    and item[2]):
                                 self._prefix.unpin(item[2])  # matched hits
                         if req.on_done is not None:
                             try:
@@ -1422,8 +1589,7 @@ class Engine:
                 self._topk[gather],
                 self._topp[gather],
             )
-        self.cache = {"k": pk, "v": pv,
-                      "page_table": self.cache["page_table"]}
+        self.cache = self._paged_cache_with(pk, pv)
         pins: Dict[int, List[int]] = {}
         for slot_id, chain, toks, page_id in reg_records:
             if self._prefix.register(chain, toks, page_id):
@@ -1436,6 +1602,51 @@ class Engine:
             self._slot_prefix_pins[slot_id] = hits + pins.get(slot_id, [])
         self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
         self._activate([(s, r) for s, r, _, _ in batch], t0)
+
+    def _prefill_paged_resume_batch(self, batch: List[Tuple], bucket: int,
+                                    ppb: int) -> None:
+        """One fused suffix prefill CONTINUING kept conversations
+        (rolling KV, GenRequest.resume_pages): attend the kept pages +
+        the new tokens, write the new K/V positionally from resume_len
+        (mid-page), sample. No hash registration — custody of the kept
+        pages stays with the caller's registry."""
+        t0 = time.time()
+        Bp = self.prefill_batch
+        maxp = self.paged.allocator.maxp
+        padded = np.full((Bp, bucket), self.pad_id, np.int32)
+        lengths = np.ones(Bp, np.int32)
+        rlens = np.zeros(Bp, np.int32)
+        table = np.zeros((Bp, ppb), np.int32)
+        row_tables = np.zeros((Bp, maxp), np.int32)
+        gather = np.zeros(Bp, np.int64)
+        scatter = np.full(Bp, self.max_batch, np.int32)
+        for r, (slot_id, req, row) in enumerate(batch):
+            suffix = req.prompt
+            padded[r, : len(suffix)] = suffix
+            lengths[r] = len(suffix)
+            rlens[r] = req.resume_len
+            table[r, : len(req.resume_pages)] = req.resume_pages
+            row_tables[r] = row
+            gather[r] = slot_id
+            scatter[r] = slot_id
+            s = req.sampling
+            self._temp[slot_id] = s.temperature
+            self._topk[slot_id] = s.top_k
+            self._topp[slot_id] = s.top_p
+            self._set_slot_key(slot_id, s.seed)
+        pk, pv = self.cache["k"], self.cache["v"]
+        pk, pv, self._last_tokens, self._last_lps = \
+            self._prefill_paged_resume_fused(
+                self.params, padded, lengths, rlens, table, row_tables,
+                scatter, pk, pv, self._last_tokens, self._last_lps,
+                self._base_keys_np[gather],
+                self._temp[gather],
+                self._topk[gather],
+                self._topp[gather],
+            )
+        self.cache = self._paged_cache_with(pk, pv)
+        self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
+        self._activate([(s, r) for s, r, _ in batch], t0)
 
     def _prefill_prefix_batch(self, batch: List[Tuple], bucket: int,
                               ppb: int) -> None:
@@ -1596,8 +1807,7 @@ class Engine:
                 self._topk[gather],
                 self._topp[gather],
             )
-        self.cache = {"k": k_pool, "v": v_pool,
-                      "page_table": self.cache["page_table"]}
+        self.cache = self._paged_cache_with(k_pool, v_pool)
         self._activate(batch, t0)
 
     def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:
@@ -1605,7 +1815,9 @@ class Engine:
             slot = self.slots[slot_id]
             slot.active = True
             slot.request = req
-            slot.position = len(req.prompt)  # next write position
+            # next write position; rolling-KV continuations resume past
+            # the tokens already in their kept pages
+            slot.position = req.resume_len + len(req.prompt)
             slot.dispatched_position = slot.position
             slot.generated = []
             slot.logprobs = []
@@ -1620,8 +1832,12 @@ class Engine:
             self.total_requests += 1
             # prefill work accounting (bench MFU: prompt tokens cost the
             # same per-token FLOPs as decode tokens but 10-20x the volume
-            # under chat-history prompts)
-            self.metrics.counters["prompt_tokens"].inc(len(req.prompt))
+            # under chat-history prompts). The LOGICAL prompt includes a
+            # rolling continuation's kept tokens; reuse is counted
+            # separately in prefix_reused_tokens, so computed = total -
+            # reused stays consistent across the prefix and resume paths
+            self.metrics.counters["prompt_tokens"].inc(
+                len(req.prompt) + req.resume_len)
             self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
         self.metrics.latencies["prefill_s"].observe(time.time() - t0)
 
@@ -1742,6 +1958,29 @@ class Engine:
         slot.active = False
         slot.request = None
         if self.paged:
+            if req is not None and req.keep_pages:
+                # rolling KV: hand the conversation's pages to the caller
+                # instead of freeing. written_len = host-confirmed written
+                # extent (chunk-granular); emitted tokens past it have no
+                # K/V yet and ride back as tail_tokens for the caller to
+                # prepend to the next turn's suffix (re-feeding rewrites
+                # their K/V identically — same context).
+                fresh = self.paged.allocator.pages_for(slot_id)
+                self.paged.allocator.transfer_to_cache(slot_id, fresh)
+                all_pages = list(req.resume_pages or []) + fresh
+                written = slot.position
+                start = req.resume_len + len(req.prompt)
+                tail = list(slot.generated[max(0, written - start):])
+                ps = self.paged.page_size
+                covering = -(-written // ps) if written > 0 else 0
+                kept, extras = all_pages[:covering], all_pages[covering:]
+                if extras:
+                    self.paged.allocator.add_free(extras)
+                if req.on_pages is not None:
+                    try:
+                        req.on_pages(req.request_id, kept, written, tail)
+                    except Exception:
+                        logger.exception("on_pages callback failed")
             # pages stay owned (absorbing end-of-chunk garbage writes) until
             # the next admission round zeroes the table row and frees them
             self.paged.allocator.mark_retired(slot_id)

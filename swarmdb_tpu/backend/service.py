@@ -150,6 +150,20 @@ class ServingService:
         # n>1 fan-out groups: completion-0 rid -> all member rids, so a
         # cancel reaches every alternative (popped at aggregate emission)
         self._fanout: Dict[str, List[str]] = {}
+        # rolling-KV conversation registry (SWARMDB_ROLLING_KV=1, paged):
+        # (sender, receiver) -> {pages, len, tail, msg_count, epoch,
+        # in_flight, last}. Custody of the listed pages belongs HERE
+        # between turns (the engine only references them during a resumed
+        # request). StreamingLLM-style: outputs drift from a re-prefill
+        # baseline because the reply's KV is the model's own continuation
+        # rather than a re-tokenization of its text.
+        self._rolling: Optional[Dict[Tuple[str, str], Dict[str, Any]]] = None
+        self._rolling_lock = threading.Lock()
+        if (self.engine.paged is not None
+                and getattr(self.engine, "_prefill_paged_resume_fused",
+                            None) is not None
+                and os.environ.get("SWARMDB_ROLLING_KV") == "1"):
+            self._rolling = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -402,6 +416,166 @@ class ServingService:
             if served == 0:
                 self._stop.wait(self.poll_interval)
 
+    # ------------------------------------------------------ rolling KV
+
+    def _rolling_epoch(self) -> int:
+        """Engine restarts rebuild the page pool; registry entries from
+        an older epoch hold dangling page ids and must never be resumed
+        OR add_free'd (the reset already reclaimed the pool)."""
+        return self.engine.metrics.counters["engine_restarts"].value
+
+    def _rolling_evict(self, need_free: int) -> None:
+        """LRU-evict idle conversations until the allocator can cover
+        ``need_free`` pages (caller holds _rolling_lock)."""
+        alloc = self.engine.paged.allocator
+        epoch = self._rolling_epoch()
+        idle = sorted(
+            (k for k, st in self._rolling.items()
+             if not st.get("in_flight") and st.get("pages")),
+            key=lambda k: self._rolling[k]["last"])
+        for k in idle:
+            if alloc.free_count() >= need_free:
+                break
+            st = self._rolling.pop(k)
+            if st["epoch"] == epoch:
+                alloc.add_free(st["pages"])
+            self.db.metrics.counters["rolling_evictions"].inc()
+
+    def _rolling_plan(self, key, msg: Message, sampling: SamplingParams):
+        """Decide how this turn uses the rolling registry.
+
+        Returns (mode, resume, prompt_tokens):
+          - ("resume", (pages, len), tokens): continue the kept pages.
+          - ("keep", None, None): fresh prefill, but the turn claims the
+            conversation (keep_pages set; retirement replaces the state).
+          - ("plain", None, None): fresh prefill, registry untouched — a
+            concurrent turn of the same conversation owns the claim, and
+            setting keep_pages here would hand over pages that a later
+            on_pages overwrite would leak.
+        """
+        eng = self.engine
+        ps = eng.paged.page_size
+        with self._rolling_lock:
+            epoch = self._rolling_epoch()
+            st = self._rolling.get(key)
+            if st is not None and st["epoch"] != epoch:
+                # stale epoch: pool was rebuilt, page ids are dangling
+                self._rolling.pop(key, None)
+                st = None
+            if st is not None and st.get("in_flight"):
+                return "plain", None, None
+            placeholder = {"pages": None, "len": 0, "tail": [],
+                           "msg_count": 0, "reply_ids": [],
+                           "epoch": epoch, "in_flight": True,
+                           "last": time.time()}
+            if st is None or not st.get("pages"):
+                # claim: pending_count stamped at store time from the
+                # length read below is not needed for fresh turns — the
+                # FULL window is rendered, so everything up to the
+                # store-time total is either in KV or deliberately
+                # trimmed
+                self._rolling[key] = placeholder
+                return "keep", None, None
+
+            # atomic (total, delta) — a split length+fetch pair can drop
+            # the oldest unseen message under concurrent sends
+            total, delta = self.db.get_conversation_delta(
+                key[0], key[1], st["msg_count"])
+            if not any(m.id == msg.id for m in delta):
+                # registry out of sync with the stream (e.g. snapshot
+                # restore): restart the conversation fresh
+                if st["epoch"] == epoch:
+                    eng.paged.allocator.add_free(st["pages"])
+                self._rolling[key] = placeholder
+                return "keep", None, None
+            lines = []
+            for m in delta:
+                if m.id == msg.id or m.id in st["reply_ids"]:
+                    # the current message renders last; replies are in
+                    # the KV as the model's own generated tokens
+                    continue
+                body = (m.content if isinstance(m.content, str)
+                        else json.dumps(m.content))
+                lines.append(f"{m.sender_id}: {body}")
+            body = (msg.content if isinstance(msg.content, str)
+                    else json.dumps(msg.content))
+            lines.append(f"{msg.sender_id}: {body}")
+            lines.append(f"{msg.receiver_id}:")
+            suffix = "".join("\n" + ln for ln in lines)
+            ptoks = list(st["tail"]) + self.tokenizer.encode(
+                suffix, add_bos=False)
+            fits = (
+                st["len"] + len(ptoks) + sampling.max_new_tokens
+                + eng.decode_chunk < eng.max_seq
+                and -(-st["len"] // ps) <= eng._prefix_pp_buckets[-1]
+                and len(ptoks) > 0
+            )
+            if not fits:
+                # conversation outgrew the window: restart fresh (the
+                # caller's trimmed prompt) and release the kept pages
+                if st["epoch"] == epoch:
+                    eng.paged.allocator.add_free(st["pages"])
+                self._rolling[key] = placeholder
+                self.db.metrics.counters["rolling_restarts"].inc()
+                return "keep", None, None
+            # pool headroom: only the FRESH pages beyond the kept ones
+            # are allocated at admission — evicting to the full footprint
+            # would destroy other conversations' kept KV for nothing
+            need = (-(-(st["len"] + len(ptoks) + sampling.max_new_tokens
+                        + eng.decode_chunk) // ps)
+                    - len(st["pages"]))
+            if need > 0:
+                self._rolling_evict(need)
+            st["in_flight"] = True
+            st["pending_count"] = total
+            st["last"] = time.time()
+            self.db.metrics.counters["rolling_resumes"].inc()
+            return "resume", (st["pages"], st["len"]), ptoks
+
+    def _rolling_store(self, key, pages, written, tail) -> None:
+        """on_pages (engine thread, at retirement): adopt the turn's
+        pages as the conversation's new state. A replaced predecessor's
+        pages were already released by _rolling_plan (fresh-restart) or
+        are a PREFIX of ``pages`` (resume) — never double-freed."""
+        with self._rolling_lock:
+            prev = self._rolling.get(key, {})
+            self._rolling[key] = {
+                "pages": pages, "len": written, "tail": list(tail),
+                # everything at stream index < msg_count is in the KV (or
+                # was deliberately trimmed by the fresh window); replies
+                # are excluded BY ID, so interleaved foreign messages can
+                # never be skipped by a count race
+                "msg_count": prev.get("pending_count",
+                                      self.db.conversation_length(*key)),
+                "reply_ids": list(prev.get("reply_ids", ())),
+                "epoch": self._rolling_epoch(),
+                "in_flight": True, "last": time.time(),
+            }
+
+    def _rolling_finalize(self, key, msg: Message, reason: str) -> None:
+        """After the reply message is SENT (reply worker): record the
+        reply id (excluded from future suffixes — its tokens are already
+        in the KV as the model's own continuation); non-clean finishes
+        drop the state instead."""
+        with self._rolling_lock:
+            st = self._rolling.get(key)
+            if st is None:
+                return
+            if reason in ("length", "eos") and st.get("pages"):
+                rid = (msg.metadata or {}).get("reply_id")
+                if rid:
+                    # only replies at stream index >= msg_count matter
+                    # (older ones fall below the next delta); cap the
+                    # list so a conversation never accumulates ids
+                    st["reply_ids"] = st["reply_ids"][-3:] + [rid]
+                st["in_flight"] = False
+                st["last"] = time.time()
+            else:
+                self._rolling.pop(key, None)
+                if (st.get("pages")
+                        and st["epoch"] == self._rolling_epoch()):
+                    self.engine.paged.allocator.add_free(st["pages"])
+
     # ------------------------------------------------------------- serving
 
     def serve_message(
@@ -460,6 +634,36 @@ class ServingService:
         # the reply body (and the streamed one); 1..n-1 ride metadata.
         n = min(4, max(1, int(g.get("n", 1))))
 
+        # rolling KV: plain chat turns continue the conversation's kept
+        # pages (prefill = new tokens only). Excluded: fan-out (n>1 —
+        # alternatives would fight over the pages), stop sequences (the
+        # truncated reply text would diverge from the model's KV memory),
+        # and tool calls (rendered with [tool-call] markers the resume
+        # suffix builder does not reproduce).
+        rolling_key = resume = None
+        if (self._rolling is not None and msg.receiver_id and n == 1
+                and not sampling.stop and msg.type == MessageType.CHAT):
+            key = (msg.sender_id, msg.receiver_id)
+            mode, resume, rtoks = self._rolling_plan(key, msg, sampling)
+            if mode != "plain":
+                # "plain": a concurrent turn of this conversation owns
+                # the registry claim — keep_pages here would let a later
+                # on_pages overwrite leak its pages
+                rolling_key = key
+            if resume is not None:
+                prompt = rtoks
+            if rolling_key is not None:
+                user_on_done = on_done
+
+                def on_done(rid, toks, reason, _u=user_on_done,
+                            _k=rolling_key, _m=msg):
+                    # reply worker, AFTER _emit_reply: the reply id it
+                    # stamped into msg.metadata is recorded for suffix
+                    # exclusion
+                    self._rolling_finalize(_k, _m, reason)
+                    if _u is not None:
+                        _u(rid, toks, reason)
+
         def _done(rid: str, tokens: List[int], reason: str) -> None:
             # engine thread: just hand off — emission runs on _reply_loop.
             # Logprobs travel IN the queue tuple (not via msg.metadata,
@@ -516,9 +720,26 @@ class ServingService:
             on_token=_tok, on_done=_done,
             metadata={"message_id": msg.id},
         )
+        if rolling_key is not None:
+            req.keep_pages = True
+            req.on_pages = (lambda rid, pages, written, tail,
+                            _k=rolling_key:
+                            self._rolling_store(_k, pages, written, tail))
+            if resume is not None:
+                req.resume_pages = list(resume[0])
+                req.resume_len = resume[1]
         if n > 1:
             return self._serve_n(msg, req, prompt, sampling, priority, n,
                                  want_logprobs, on_done)
+        if rolling_key is not None:
+            try:
+                return self.engine.submit(req)
+            except Exception:
+                # the in-flight claim must not leak or the conversation
+                # never rolls again (and a resumed state's pages would
+                # stay referenced by nothing)
+                self._rolling_finalize(rolling_key, msg, "submit_error")
+                raise
         return self.engine.submit(req)
 
     def _serve_n(self, msg: Message, req0: GenRequest, prompt: List[int],

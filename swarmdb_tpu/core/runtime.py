@@ -32,7 +32,7 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.base import Broker, Consumer, Producer, Record
 from ..utils.hashing import stable_partition
@@ -627,6 +627,20 @@ class SwarmDB:
         with self._lock:
             return len(self._conversations.get(
                 self._pair(agent_a, agent_b), ()))
+
+    def get_conversation_delta(
+        self, agent_a: str, agent_b: str, since: int
+    ) -> Tuple[int, List[Message]]:
+        """(total stream length, messages with stream index >= since) in
+        SEND order, under ONE lock acquisition — a split length+fetch
+        pair lets a concurrent send shift a newest-N window and silently
+        drop the oldest unseen message (rolling-KV suffix builder)."""
+        pair = self._pair(agent_a, agent_b)
+        with self._lock:
+            stream = self._conversations.get(pair, ())
+            total = len(stream)
+            tail = list(stream[max(0, since):])
+        return total, tail
 
     def get_conversation_window(
         self, agent_a: str, agent_b: str, limit: int

@@ -421,7 +421,13 @@ def forward_paged(
 
     x = params["embed"][tokens]  # [B, 1, D]
     table = cache["page_table"]
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    # rolling-KV conversations carry a per-row RoPE offset: kept pages'
+    # K were rope'd at their original absolute positions, so queries
+    # must be too (RoPE scores depend only on position differences).
+    # ``positions`` stays LOGICAL (page writes + masks)
+    pos0 = cache.get("pos0")
+    rope_pos = positions if pos0 is None else positions + pos0[:, None]
+    cos, sin = rope_cos_sin(rope_pos, cfg.head_dim, cfg.rope_theta)
 
     def layer_step(x, scanned):
         lp, kp, vp = scanned
@@ -446,7 +452,10 @@ def forward_paged(
         head = params["embed"].T
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "page_table": table}
+    out = {"k": new_k, "v": new_v, "page_table": table}
+    if pos0 is not None:
+        out["pos0"] = pos0
+    return logits, out
 
 
 def forward_paged_chunked(
@@ -470,7 +479,9 @@ def forward_paged_chunked(
     x = params["embed"][tokens]
     table = cache["page_table"]
     chunk_k, chunk_v = chunk_kv
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    pos0 = cache.get("pos0")  # rolling-KV RoPE offset (see forward_paged)
+    rope_pos = positions if pos0 is None else positions + pos0[:, None]
+    cos, sin = rope_cos_sin(rope_pos, cfg.head_dim, cfg.rope_theta)
 
     def layer_step(x, scanned):
         lp, kp, vp, hk, hv = scanned
@@ -513,7 +524,10 @@ def merge_paged_chunk(cache, chunk_kv, start_positions: jnp.ndarray):
         cache["k"], cache["v"], hk, hv, start_positions,
         cache["page_table"],
     )
-    return {"k": new_k, "v": new_v, "page_table": cache["page_table"]}
+    out = {"k": new_k, "v": new_v, "page_table": cache["page_table"]}
+    if "pos0" in cache:
+        out["pos0"] = cache["pos0"]
+    return out
 
 
 # ----------------------------------------------------- pipeline parallelism

@@ -342,7 +342,9 @@ def forward_paged_chunked(
     x = params["embed"][tokens]
     table = cache["page_table"]
     chunk_k, chunk_v = chunk_kv
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    pos0 = cache.get("pos0")  # rolling-KV RoPE offset (llama.forward_paged)
+    rope_pos = positions if pos0 is None else positions + pos0[:, None]
+    cos, sin = rope_cos_sin(rope_pos, cfg.head_dim, cfg.rope_theta)
 
     def layer_step(x, scanned):
         lp, kp, vp, hk, hv = scanned
@@ -457,7 +459,9 @@ def forward_paged(
 
     x = params["embed"][tokens]
     table = cache["page_table"]
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    pos0 = cache.get("pos0")  # rolling-KV RoPE offset (llama.forward_paged)
+    rope_pos = positions if pos0 is None else positions + pos0[:, None]
+    cos, sin = rope_cos_sin(rope_pos, cfg.head_dim, cfg.rope_theta)
 
     def layer_step(x, scanned):
         lp, kp, vp = scanned
@@ -483,4 +487,7 @@ def forward_paged(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "page_table": table}
+    out = {"k": new_k, "v": new_v, "page_table": table}
+    if pos0 is not None:
+        out["pos0"] = pos0
+    return logits, out

@@ -68,6 +68,12 @@ def init_paged_kv_cache(
         "page_table": jnp.zeros(
             (batch, pages_per_slot(max_seq, page_size)), jnp.int32
         ),
+        # per-row RoPE offset: rope position = logical lane position +
+        # pos0. Zero for ordinary requests; rolling-KV conversations
+        # (StreamingLLM-style front-page drop) advance it so kept pages'
+        # K — rope'd at their original absolute positions — stay
+        # consistent with future queries
+        "pos0": jnp.zeros((batch,), jnp.int32),
     }
 
 

@@ -472,3 +472,61 @@ def test_mixtral_paged_chunked_matches_paged():
     np.testing.assert_allclose(np.asarray(pool["k"][:, 1:]),
                                np.asarray(pool2["k"][:, 1:]),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_paged_pos0_rope_offset():
+    """cache["pos0"] offsets RoPE only: zero offset reproduces the
+    pre-pos0 behavior bit-for-bit, a nonzero offset changes logits (the
+    rope rotation moved), and the offset survives decode + chunk merge
+    so rolling-KV conversations keep their absolute phases."""
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    ps, max_seq, B = 8, 32, 2
+    num_pages = 1 + B * (max_seq // ps)
+
+    def mk_cache():
+        c = llama.init_paged_cache(cfg, B, max_seq, num_pages, ps)
+        table = np.zeros((B, max_seq // ps), np.int32)
+        table[0] = [1, 2, 3, 4]
+        table[1] = [5, 6, 7, 8]
+        return {**c, "page_table": jnp.asarray(table)}
+
+    toks = jnp.asarray(np.array([[7], [9]], np.int32))
+    pos = jnp.asarray(np.array([[0], [0]], np.int32))
+
+    base = mk_cache()
+    logits0, out0 = llama.forward_paged(params, cfg, toks, pos, base)
+    assert "pos0" in out0 and np.all(np.asarray(out0["pos0"]) == 0)
+
+    # explicit zero offset == default zeros
+    z = {**mk_cache(), "pos0": jnp.zeros((B,), jnp.int32)}
+    logits_z, _ = llama.forward_paged(params, cfg, toks, pos, z)
+    np.testing.assert_array_equal(np.asarray(logits0), np.asarray(logits_z))
+
+    # RoPE phases: K written at logical position 0 under pos0=4 must
+    # equal K written at logical position 4 under pos0=0 (same absolute
+    # rope position) — the invariant rolling-KV reuse rests on. Logits
+    # themselves are offset-invariant (RoPE is relative), so the test
+    # asserts on the written pages, not the outputs.
+    off = {**mk_cache(), "pos0": jnp.asarray(np.array([4, 0], np.int32))}
+    _, out_o = llama.forward_paged(params, cfg, toks, pos, off)
+    np.testing.assert_array_equal(np.asarray(out_o["pos0"]), [4, 0])
+    shifted = mk_cache()
+    pos4 = jnp.asarray(np.array([[4], [0]], np.int32))
+    _, out_s = llama.forward_paged(params, cfg, toks, pos4, shifted)
+    # row 0: page 1 holds the write — offset-0 write under pos0=4 vs
+    # offset-4 write under pos0=0, same absolute phase, same K values.
+    # LAYER 0 only: deeper layers see different attention context (the
+    # logical-4 case attends zeros at offsets 0..3), so their layer
+    # inputs legitimately diverge
+    k_o = np.asarray(out_o["k"])[0, 1, 0]   # [Hkv, D] at page off 0
+    k_s = np.asarray(out_s["k"])[0, 1, 4]   # [Hkv, D] at page off 4
+    np.testing.assert_array_equal(k_o, k_s)
+    # and a mismatched absolute phase differs (rope really rotated)
+    k_s0 = np.asarray(np.asarray(out0["k"]))[0, 1, 0]
+    assert not np.array_equal(k_o, k_s0)
+
+    # offset survives a chunked-decode merge
+    chunk = llama.init_chunk_kv(cfg, B, 4)
+    merged = llama.merge_paged_chunk(off, chunk, jnp.zeros((B,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(merged["pos0"]), [4, 0])

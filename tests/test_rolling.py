@@ -1,0 +1,238 @@
+"""Rolling-KV conversation continuation (paged engine resume path).
+
+A resumed turn — kept pages + suffix-only prefill via
+Engine.resume_pages — must generate exactly the tokens a fresh engine
+produces when given the full concatenated history as its prompt (the
+token stream is identical; only the compute is reused)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from swarmdb_tpu.backend.engine import Engine, GenRequest, PagedKV
+from swarmdb_tpu.backend.sampling import SamplingParams
+from swarmdb_tpu.models import llama
+from swarmdb_tpu.models.configs import TINY_DEBUG
+from swarmdb_tpu.ops.paged_kv import PageAllocator
+
+PS, MAX_SEQ, BATCH = 8, 96, 2
+
+
+def _mk_engine(params):
+    cfg = TINY_DEBUG
+    num_pages = 1 + 2 * BATCH * (MAX_SEQ // PS)
+    spec = PagedKV(
+        decode_forward=lambda p, t, pos, c: llama.forward_paged(
+            p, cfg, t, pos, c),
+        init_pool=lambda: llama.init_paged_cache(
+            cfg, BATCH, MAX_SEQ, num_pages, PS),
+        page_size=PS, num_pages=num_pages,
+        allocator=PageAllocator(num_pages, PS, MAX_SEQ, BATCH),
+    )
+    eng = Engine(
+        lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+        lambda b, s: llama.init_kv_cache(cfg, b, s),
+        params, max_batch=BATCH, max_seq=MAX_SEQ, eos_id=-1, seed=0,
+        prefill_buckets=[16, 32, 64], decode_chunk=4, paged=spec,
+        prefix_fns=(
+            lambda p, t, tab, pl, pk, pv, logits_at=None:
+                llama.forward_prefix_pages(p, cfg, t, tab, pl, pk, pv,
+                                           logits_at=logits_at),
+            None,
+        ),
+    )
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(TINY_DEBUG, jax.random.PRNGKey(9))
+
+
+def _gen_keep(eng, prompt, max_new, resume=None):
+    """generate_sync with keep_pages; returns (tokens, pages, written,
+    tail)."""
+    import threading
+
+    done = threading.Event()
+    out = {}
+
+    def on_done(rid, toks, reason):
+        out["toks"] = toks
+        out["reason"] = reason
+        done.set()
+
+    def on_pages(rid, pages, written, tail):
+        out["pages"] = pages
+        out["written"] = written
+        out["tail"] = tail
+
+    req = GenRequest(
+        prompt=list(prompt),
+        sampling=SamplingParams(max_new_tokens=max_new, temperature=0.0),
+        on_done=on_done, on_pages=on_pages, keep_pages=True,
+    )
+    if resume is not None:
+        req.resume_pages = list(resume[0])
+        req.resume_len = resume[1]
+    eng.submit(req)
+    assert done.wait(120)
+    assert out["reason"] in ("length", "eos")
+    assert "pages" in out, "on_pages never fired"
+    return out["toks"], out["pages"], out["written"], out["tail"]
+
+
+def test_resume_matches_fresh_full_prefill(params):
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(3, TINY_DEBUG.vocab_size, size=21).tolist()
+    new2 = rng.integers(3, TINY_DEBUG.vocab_size, size=9).tolist()
+    new3 = rng.integers(3, TINY_DEBUG.vocab_size, size=5).tolist()
+
+    eng = _mk_engine(params)
+    try:
+        # turn 1 (fresh, keep pages) -> turn 2 (resume) -> turn 3 (resume)
+        g1, pages, written, tail = _gen_keep(eng, p1, 7)
+        assert written + len(tail) == len(p1) + len(g1)
+        assert len(pages) == -(-written // PS)
+        g2, pages2, written2, tail2 = _gen_keep(
+            eng, tail + new2, 6, resume=(pages, written))
+        g3, *_ = _gen_keep(eng, tail2 + new3, 5, resume=(pages2, written2))
+    finally:
+        eng.stop()
+
+    # reference: fresh engines over the full concatenated streams
+    ref = _mk_engine(params)
+    try:
+        r2, _, _, _ = _gen_keep(ref, p1 + g1 + new2, 6)
+    finally:
+        ref.stop()
+    assert g2 == r2, (g2, r2)
+
+    ref3 = _mk_engine(params)
+    try:
+        r3, *_ = _gen_keep(ref3, p1 + g1 + new2 + g2 + new3, 5)
+    finally:
+        ref3.stop()
+    assert g3 == r3, (g3, r3)
+
+
+def test_resume_rejects_bad_requests(params):
+    eng = _mk_engine(params)
+    try:
+        with pytest.raises(ValueError):  # pages don't cover resume_len
+            eng.submit(GenRequest(prompt=[1, 2], resume_pages=[1],
+                                  resume_len=17))
+        with pytest.raises(ValueError):  # no pages
+            eng.submit(GenRequest(prompt=[1, 2], resume_pages=[],
+                                  resume_len=8))
+        with pytest.raises(ValueError):  # resumed total exceeds max_seq
+            eng.submit(GenRequest(prompt=list(range(3, 50)),
+                                  resume_pages=list(range(1, 8)),
+                                  resume_len=50))
+    finally:
+        eng.stop()
+
+
+def test_service_rolling_conversation(monkeypatch):
+    """End-to-end rolling serve: consecutive chat turns resume the kept
+    pages (prefill = new tokens only), the registry survives many turns,
+    and window overflow restarts the conversation without losing
+    liveness."""
+    import tempfile
+    import time as _time
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.backend.service import ServingService
+
+    monkeypatch.setenv("SWARMDB_ROLLING_KV", "1")
+    monkeypatch.setenv("SWARMDB_PAGED", "1")
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        db.register_agent("u")
+        db.register_agent("bot")
+        db.assign_llm_backend("bot", "b0")
+        svc = ServingService.from_model_name(
+            db, "tiny-debug", backend_id="b0", max_batch=2, max_seq=128,
+            decode_chunk=4, page_size=8)
+        svc.start(warmup=False)
+        try:
+            replies = 0
+            for turn in range(10):
+                db.send_message("u", "bot", f"turn {turn} hello",
+                                metadata={"generation": {
+                                    "max_new_tokens": 4,
+                                    "temperature": 0.0}})
+                deadline = _time.time() + 90
+                got = False
+                while _time.time() < deadline and not got:
+                    for m in db.receive_messages("u", timeout=0.5):
+                        if m.sender_id == "bot":
+                            got = True
+                assert got, f"no reply at turn {turn}"
+                replies += 1
+            resumes = db.metrics.counters["rolling_resumes"].value
+            restarts = db.metrics.counters["rolling_restarts"].value
+            assert replies == 10
+            # most turns resumed; at max_seq=128 the window overflows at
+            # least once across 10 growing turns
+            assert resumes >= 5, resumes
+            assert restarts >= 1, restarts
+            # registry custody is consistent: exactly one tracked
+            # conversation, not in flight, with live pages
+            assert len(svc._rolling) == 1
+            st = next(iter(svc._rolling.values()))
+            assert st["pages"] and not st["in_flight"]
+        finally:
+            svc.stop()
+            db.close()
+
+
+def test_rolling_plan_concurrent_turn_is_plain(monkeypatch):
+    """A second turn arriving while the conversation's claim is in
+    flight must serve PLAIN (no keep_pages): a keep here would let the
+    later on_pages overwrite the registry entry and leak the displaced
+    pages (review finding)."""
+    import tempfile
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.backend.service import ServingService
+    from swarmdb_tpu.backend.sampling import SamplingParams
+
+    monkeypatch.setenv("SWARMDB_ROLLING_KV", "1")
+    monkeypatch.setenv("SWARMDB_PAGED", "1")
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        db.register_agent("u")
+        db.register_agent("bot")
+        svc = ServingService.from_model_name(
+            db, "tiny-debug", backend_id="b0", max_batch=2, max_seq=64,
+            decode_chunk=4, page_size=8)
+        try:
+            mid = db.send_message("u", "bot", "first")
+            msg = db.get_message(mid)
+            sp = SamplingParams(max_new_tokens=4)
+            key = ("u", "bot")
+            mode1, res1, _ = svc._rolling_plan(key, msg, sp)
+            assert mode1 == "keep" and res1 is None
+            # second turn while the first's claim is in flight
+            mid2 = db.send_message("u", "bot", "second")
+            mode2, res2, _ = svc._rolling_plan(key, db.get_message(mid2), sp)
+            assert mode2 == "plain" and res2 is None
+            # first turn completes -> stores pages -> reply finalizes
+            svc._rolling_store(key, [1, 2], 12, [])
+            msg.metadata["reply_id"] = "r1"
+            svc._rolling_finalize(key, msg, "length")
+            st = svc._rolling[key]
+            assert not st["in_flight"] and st["reply_ids"] == ["r1"]
+            # third turn can now RESUME
+            mid3 = db.send_message("u", "bot", "third")
+            mode3, res3, toks3 = svc._rolling_plan(
+                key, db.get_message(mid3), sp)
+            assert mode3 == "resume" and res3 == ([1, 2], 12)
+            assert toks3  # non-empty suffix
+        finally:
+            db.close()
