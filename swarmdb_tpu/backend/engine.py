@@ -1079,6 +1079,13 @@ class Engine:
                     self, "_prefill_paged_resume_fused", None) is None:
                 raise ValueError("resume_pages requires a paged engine "
                                  "with the prefix machinery enabled")
+            if self._mh is not None:
+                # currently unreachable (enable_multihost refuses paged
+                # engines), but kept so future pod+paged support cannot
+                # silently desync: resume dispatches are not published
+                # to worker hosts
+                raise ValueError("rolling-KV resume is not supported in "
+                                 "multi-host (pod) mode")
             if not request.resume_pages or request.resume_len <= 0:
                 raise ValueError("resume needs pages and resume_len > 0")
             ps = self.paged.page_size

@@ -455,6 +455,11 @@ class ServingService:
         """
         eng = self.engine
         ps = eng.paged.page_size
+        if eng._mh is not None:
+            # currently unreachable (pod mode refuses paged engines);
+            # future-proofing: resume dispatches are not published to
+            # worker hosts, and engine.submit rejects them too
+            return "plain", None, None
         with self._rolling_lock:
             epoch = self._rolling_epoch()
             st = self._rolling.get(key)
