@@ -272,6 +272,14 @@ def _device_extras(service, model: str) -> dict:
         hit, miss = ps["hit_tokens"], ps["miss_tokens"]
         if hit + miss:
             extras["prefix_hit_rate"] = round(hit / (hit + miss), 4)
+    if getattr(service, "_rolling", None) is not None:
+        c = service.db.metrics.counters
+        extras["rolling"] = {
+            "resumes": c["rolling_resumes"].value,
+            "restarts": c["rolling_restarts"].value,
+            "evictions": c["rolling_evictions"].value,
+            "conversations": len(service._rolling),
+        }
     return extras
 
 

@@ -441,7 +441,8 @@ class ServingService:
                 alloc.add_free(st["pages"])
             self.db.metrics.counters["rolling_evictions"].inc()
 
-    def _rolling_plan(self, key, msg: Message, sampling: SamplingParams):
+    def _rolling_plan(self, key, msg: Message, sampling: SamplingParams,
+                      pre_count: int = 0):
         """Decide how this turn uses the rolling registry.
 
         Returns (mode, resume, prompt_tokens):
@@ -469,16 +470,21 @@ class ServingService:
                 st = None
             if st is not None and st.get("in_flight"):
                 return "plain", None, None
+            # pending_count = the caller's PRE-prompt-fetch stream
+            # length: stamping it at store/retirement time would count
+            # mid-generation arrivals as rendered (silently omitting
+            # them from every future suffix — measured: near zero
+            # resumes); stamping it after build_prompt's window fetch
+            # would drop a message landing between fetch and stamp.
+            # Before-fetch is the safe direction: late arrivals render
+            # next turn, at worst duplicated once if they also made
+            # this turn's window.
             placeholder = {"pages": None, "len": 0, "tail": [],
                            "msg_count": 0, "reply_ids": [],
+                           "pending_count": pre_count,
                            "epoch": epoch, "in_flight": True,
                            "last": time.time()}
             if st is None or not st.get("pages"):
-                # claim: pending_count stamped at store time from the
-                # length read below is not needed for fresh turns — the
-                # FULL window is rendered, so everything up to the
-                # store-time total is either in KV or deliberately
-                # trimmed
                 self._rolling[key] = placeholder
                 return "keep", None, None
 
@@ -492,6 +498,7 @@ class ServingService:
                 if st["epoch"] == epoch:
                     eng.paged.allocator.add_free(st["pages"])
                 self._rolling[key] = placeholder
+                self.db.metrics.counters["rolling_restarts"].inc()
                 return "keep", None, None
             lines = []
             for m in delta:
@@ -549,7 +556,10 @@ class ServingService:
                 # everything at stream index < msg_count is in the KV (or
                 # was deliberately trimmed by the fresh window); replies
                 # are excluded BY ID, so interleaved foreign messages can
-                # never be skipped by a count race
+                # never be skipped by a count race. pending_count was
+                # stamped at PLAN time (see _rolling_plan) — the
+                # length-read fallback only covers store calls that
+                # bypassed a plan (not a serving path)
                 "msg_count": prev.get("pending_count",
                                       self.db.conversation_length(*key)),
                 "reply_ids": list(prev.get("reply_ids", ())),
@@ -592,41 +602,18 @@ class ServingService:
         """Submit one message for generation; reply is emitted on completion.
         Returns the engine request id."""
         msg.stage_stamp("admitted")
+        # rolling-KV bookkeeping reads the stream length BEFORE the
+        # prompt-window fetch: a message landing between the two reads
+        # then has index >= pre_count (rendered next turn; at worst
+        # duplicated once if it also made this turn's window) instead of
+        # being counted as rendered while absent from the prompt —
+        # which would drop it from the conversation forever
+        pre_count = (self.db.conversation_length(msg.sender_id,
+                                                 msg.receiver_id)
+                     if self._rolling is not None and msg.receiver_id
+                     else 0)
         prompt = build_prompt(self.db, msg, self.tokenizer)
         sampling = sampling_from_message(msg)
-        # Long-running conversations grow the prompt without bound; keep the
-        # TAIL (most recent turns) so a pair's history can never exceed the
-        # engine's window and brick the conversation (engine.submit rejects
-        # len >= max_seq outright). The front is dropped in page-aligned
-        # HYSTERESIS steps (~half the budget), not token-exactly: a trim
-        # that slides every turn gives consecutive prompts no common
-        # prefix, so the prefix cache could never hit on bounded windows
-        # (measured: 13% hit rate with exact trimming vs ~anchored reuse).
-        budget = max(16, self.engine.max_seq - 1 - sampling.max_new_tokens)
-        budget = min(budget, self.engine.max_seq - 1)
-        if len(prompt) > budget:
-            if self.engine._prefix is not None:
-                ps = self.engine._prefix_ps
-                # trim-step fraction trades history depth right after a
-                # jump against epoch length: each jump re-anchors the
-                # prompt start, and EVERY cached page of the conversation
-                # is invalidated across a jump (prompt positions restart
-                # at 0, so KV computed under the old anchor is
-                # numerically wrong under the new one). Longer epochs =
-                # fewer full-miss turns; measured on the serve mix the
-                # jump misses are the single largest loss (~37% of
-                # prompt tokens at the 0.5 default, scripts/probe_prefix)
-                frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
-                frac = min(0.9, max(0.1, frac))
-                step = max(ps, int(budget * frac) // ps * ps)
-                drop = -(-(len(prompt) - budget) // step) * step  # round UP
-                if len(prompt) - drop >= 16:
-                    prompt = prompt[drop:]
-                else:
-                    prompt = prompt[-budget:]
-            else:
-                # no prefix cache -> keep the maximum recent history
-                prompt = prompt[-budget:]
         priority = int(msg.priority.value if hasattr(msg.priority, "value")
                        else msg.priority)
 
@@ -646,11 +633,13 @@ class ServingService:
         # and tool calls (rendered with [tool-call] markers the resume
         # suffix builder does not reproduce).
         rolling_key = resume = None
+        rolling_mode = "plain"
         if (self._rolling is not None and msg.receiver_id and n == 1
                 and not sampling.stop and msg.type == MessageType.CHAT):
             key = (msg.sender_id, msg.receiver_id)
-            mode, resume, rtoks = self._rolling_plan(key, msg, sampling)
-            if mode != "plain":
+            rolling_mode, resume, rtoks = self._rolling_plan(
+                key, msg, sampling, pre_count)
+            if rolling_mode != "plain":
                 # "plain": a concurrent turn of this conversation owns
                 # the registry claim — keep_pages here would let a later
                 # on_pages overwrite leak its pages
@@ -668,6 +657,55 @@ class ServingService:
                     self._rolling_finalize(_k, _m, reason)
                     if _u is not None:
                         _u(rid, toks, reason)
+
+        if resume is None:
+            # Long-running conversations grow the prompt without bound;
+            # keep the TAIL (most recent turns) so a pair's history can
+            # never exceed the engine's window (engine.submit rejects
+            # len >= max_seq outright). The front is dropped in
+            # page-aligned HYSTERESIS steps (~half the budget), not
+            # token-exactly: a trim that slides every turn gives
+            # consecutive prompts no common prefix, so the prefix cache
+            # could never hit on bounded windows (measured: 13% hit rate
+            # with exact trimming vs ~anchored reuse).
+            budget = max(16,
+                         self.engine.max_seq - 1 - sampling.max_new_tokens)
+            budget = min(budget, self.engine.max_seq - 1)
+            if rolling_mode == "keep":
+                # rolling restart: leave HEADROOM or the very next turn
+                # overflows max_seq and the conversation restarts every
+                # turn instead of rolling (measured: restarts 3:1 over
+                # resumes with a full-budget restart). StreamingLLM-style
+                # half-window restart; anchor-stable trimming is moot —
+                # subsequent turns resume by identity, not hash match
+                frac = _env_float("SWARMDB_ROLL_RESTART", 0.5)
+                budget = max(16, int(budget * min(0.9, max(0.1, frac))))
+                if len(prompt) > budget:
+                    prompt = prompt[-budget:]
+            elif len(prompt) > budget:
+                if self.engine._prefix is not None:
+                    ps = self.engine._prefix_ps
+                    # trim-step fraction trades history depth right after
+                    # a jump against epoch length: each jump re-anchors
+                    # the prompt start, and EVERY cached page of the
+                    # conversation is invalidated across a jump (prompt
+                    # positions restart at 0, so KV computed under the
+                    # old anchor is numerically wrong under the new one).
+                    # Longer epochs = fewer full-miss turns; measured on
+                    # the serve mix the jump misses are the single
+                    # largest loss (~37% of prompt tokens at the 0.5
+                    # default, scripts/probe_prefix)
+                    frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
+                    frac = min(0.9, max(0.1, frac))
+                    step = max(ps, int(budget * frac) // ps * ps)
+                    drop = -(-(len(prompt) - budget) // step) * step
+                    if len(prompt) - drop >= 16:
+                        prompt = prompt[drop:]
+                    else:
+                        prompt = prompt[-budget:]
+                else:
+                    # no prefix cache -> keep the maximum recent history
+                    prompt = prompt[-budget:]
 
         def _done(rid: str, tokens: List[int], reason: str) -> None:
             # engine thread: just hand off — emission runs on _reply_loop.
