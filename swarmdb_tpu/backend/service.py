@@ -66,6 +66,26 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _history_line(m: Message) -> str:
+    """One already-exchanged message as a prompt line — shared by
+    build_prompt and the rolling-KV suffix builder (same no-drift rule
+    as _current_lines)."""
+    body = m.content if isinstance(m.content, str) else json.dumps(m.content)
+    return f"{m.sender_id}: {body}"
+
+
+def _current_lines(msg: Message) -> List[str]:
+    """The served message's own prompt lines (+ the assistant cue) —
+    shared by build_prompt and the rolling-KV suffix builder so the two
+    renderings can never drift."""
+    body = (msg.content if isinstance(msg.content, str)
+            else json.dumps(msg.content))
+    if msg.type == MessageType.FUNCTION_CALL:
+        return [f"{msg.sender_id} [tool-call]: {body}",
+                f"{msg.receiver_id} [tool-result]:"]
+    return [f"{msg.sender_id}: {body}", f"{msg.receiver_id}:"]
+
+
 def build_prompt(db: SwarmDB, msg: Message, tokenizer: Tokenizer,
                  history_limit: Optional[int] = None) -> List[int]:
     """Chat-style prompt from the two-way conversation plus the new message.
@@ -89,15 +109,8 @@ def build_prompt(db: SwarmDB, msg: Message, tokenizer: Tokenizer,
         for m in convo:
             if m.id == msg.id:
                 continue
-            body = m.content if isinstance(m.content, str) else json.dumps(m.content)
-            lines.append(f"{m.sender_id}: {body}")
-    body = msg.content if isinstance(msg.content, str) else json.dumps(msg.content)
-    if msg.type == MessageType.FUNCTION_CALL:
-        lines.append(f"{msg.sender_id} [tool-call]: {body}")
-        lines.append(f"{msg.receiver_id} [tool-result]:")
-    else:
-        lines.append(f"{msg.sender_id}: {body}")
-        lines.append(f"{msg.receiver_id}:")
+            lines.append(_history_line(m))
+    lines.extend(_current_lines(msg))
     return tokenizer.encode("\n".join(lines))
 
 
@@ -506,13 +519,8 @@ class ServingService:
                     # the current message renders last; replies are in
                     # the KV as the model's own generated tokens
                     continue
-                body = (m.content if isinstance(m.content, str)
-                        else json.dumps(m.content))
-                lines.append(f"{m.sender_id}: {body}")
-            body = (msg.content if isinstance(msg.content, str)
-                    else json.dumps(msg.content))
-            lines.append(f"{msg.sender_id}: {body}")
-            lines.append(f"{msg.receiver_id}:")
+                lines.append(_history_line(m))
+            lines.extend(_current_lines(msg))
             suffix = "".join("\n" + ln for ln in lines)
             ptoks = list(st["tail"]) + self.tokenizer.encode(
                 suffix, add_bos=False)
@@ -626,16 +634,19 @@ class ServingService:
         # the reply body (and the streamed one); 1..n-1 ride metadata.
         n = min(4, max(1, int(g.get("n", 1))))
 
-        # rolling KV: plain chat turns continue the conversation's kept
-        # pages (prefill = new tokens only). Excluded: fan-out (n>1 —
-        # alternatives would fight over the pages), stop sequences (the
-        # truncated reply text would diverge from the model's KV memory),
-        # and tool calls (rendered with [tool-call] markers the resume
-        # suffix builder does not reproduce).
+        # rolling KV: chat and tool-call turns continue the
+        # conversation's kept pages (prefill = new tokens only; the
+        # current message renders via the same _current_lines in both
+        # the fresh and resume builders). Excluded: fan-out (n>1 —
+        # alternatives would fight over the pages) and stop sequences
+        # (the truncated reply text would diverge from the model's KV
+        # memory).
         rolling_key = resume = None
         rolling_mode = "plain"
         if (self._rolling is not None and msg.receiver_id and n == 1
-                and not sampling.stop and msg.type == MessageType.CHAT):
+                and not sampling.stop
+                and msg.type in (MessageType.CHAT,
+                                 MessageType.FUNCTION_CALL)):
             key = (msg.sender_id, msg.receiver_id)
             rolling_mode, resume, rtoks = self._rolling_plan(
                 key, msg, sampling, pre_count)

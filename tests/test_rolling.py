@@ -310,3 +310,56 @@ def test_rolling_soak_page_custody_balances(monkeypatch):
         finally:
             svc.stop()
             db.close()
+
+
+def test_service_rolling_tool_call_turns(monkeypatch):
+    """Tool-call turns roll too: a FUNCTION_CALL mid-conversation resumes
+    the kept pages ([tool-call]/[tool-result] lines enter the KV via the
+    shared _current_lines renderer) and its FUNCTION_RESULT reply id is
+    excluded from the next suffix like any reply."""
+    import tempfile
+    import time as _time
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.backend.service import ServingService
+    from swarmdb_tpu.core.messages import MessageType
+
+    monkeypatch.setenv("SWARMDB_ROLLING_KV", "1")
+    monkeypatch.setenv("SWARMDB_PAGED", "1")
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        db.register_agent("u")
+        db.register_agent("bot")
+        db.assign_llm_backend("bot", "b0")
+        svc = ServingService.from_model_name(
+            db, "tiny-debug", backend_id="b0", max_batch=2, max_seq=256,
+            decode_chunk=4, page_size=8)
+        svc.start(warmup=False)
+        try:
+            for turn in range(5):
+                if turn % 2:
+                    db.send_message(
+                        "u", "bot", {"tool": "t", "args": {"i": turn}},
+                        message_type=MessageType.FUNCTION_CALL,
+                        metadata={"generation": {"max_new_tokens": 3}})
+                    want = MessageType.FUNCTION_RESULT
+                else:
+                    db.send_message("u", "bot", f"chat {turn}",
+                                    metadata={"generation": {
+                                        "max_new_tokens": 3}})
+                    want = MessageType.CHAT
+                deadline = _time.time() + 90
+                while _time.time() < deadline:
+                    if any(m.type == want
+                           for m in db.receive_messages("u", timeout=0.5)):
+                        break
+                else:
+                    raise AssertionError(f"no reply at turn {turn}")
+            assert db.metrics.counters["rolling_resumes"].value >= 3
+            # every reply id so far was recorded for suffix exclusion
+            st = next(iter(svc._rolling.values()))
+            assert st["reply_ids"], "reply ids not recorded"
+        finally:
+            svc.stop()
+            db.close()
