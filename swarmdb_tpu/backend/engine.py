@@ -85,6 +85,12 @@ class GenRequest:
     keep_pages: bool = False
     on_pages: Optional[Callable[[str, List[int], int, List[int]],
                                 None]] = None
+    # resume_epoch: the allocator pool generation the resume_pages were
+    # handed out in (Engine.pool_epoch() at plan time). submit() AND
+    # admission re-validate it: a pool reset between plan and admission
+    # reclaims every page, so resuming stale ids would alias another
+    # slot's pages — cross-conversation KV corruption (ADVICE r4 #2).
+    resume_epoch: Optional[int] = None
 
 
 @dataclass
@@ -253,6 +259,14 @@ class Engine:
         self._cv = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # low-memory hook (ADVICE r4 medium #1): invoked (need_pages) from
+        # the engine thread, OUTSIDE the engine lock, when paged admission
+        # cannot allocate and nothing was admitted this round. The serving
+        # layer evicts idle rolling conversations here; without it, idle
+        # conversations could hold the pool while a queued request
+        # break-retries forever (admission only retried after retirements,
+        # and a fully-idle engine has none).
+        self.on_pool_pressure: Optional[Callable[[int], None]] = None
 
         donate = (4,) if donate_cache else ()
         K = self.decode_chunk
@@ -771,6 +785,14 @@ class Engine:
             self._thread = None
         with self._cv:
             self._stop = False
+        # counter first (ADVICE r4 #2): epoch checks racing this restart
+        # must fail CLOSED — observing the new epoch with the old pool
+        # merely drops reusable state, while the old epoch with a rebuilt
+        # pool would bless dangling page ids. (The allocator's own
+        # generation stamp — bumped inside reset(), re-validated at
+        # submit AND admission — is the authoritative guard; this
+        # ordering just keeps the metric-derived view consistent too.)
+        self.metrics.counters["engine_restarts"].inc()
         self._fail_all("engine_restart")
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
         self._last_lps = jnp.zeros((self.max_batch,), jnp.float32)
@@ -784,8 +806,17 @@ class Engine:
                     self._prefix.num_pages, self._prefix_ps)
             self._prefix.reset()
             self._slot_prefix_pins.clear()
-        self.metrics.counters["engine_restarts"].inc()
         self.start()
+
+    def pool_epoch(self) -> int:
+        """Epoch stamp for externally-held page ids (rolling-KV registry):
+        the paged allocator's pool generation, bumped by every reset —
+        both restart() and the in-loop error recovery rebuild the pool
+        through it, so holders can't miss an epoch either way. Dense
+        engines key on the restart counter (no page pool to alias)."""
+        if self.paged:
+            return self.paged.allocator.generation
+        return self.metrics.counters["engine_restarts"].value
 
     def _fresh_cache(self):
         if self.paged:
@@ -1097,15 +1128,27 @@ class Engine:
             if -(-request.resume_len // ps) != len(request.resume_pages):
                 raise ValueError("resume_pages must exactly cover "
                                  "resume_len")
+            if (request.resume_epoch is not None
+                    and request.resume_epoch != self.pool_epoch()):
+                raise ValueError(
+                    "stale resume epoch: the page pool was rebuilt since "
+                    "these pages were planned (engine restart); the "
+                    "conversation must restart fresh"
+                )
         if self.paged:
             need = self.paged.allocator.pages_needed(
                 len(request.prompt), request.sampling.max_new_tokens,
                 self.decode_chunk,
             )
-            if need > self.paged.num_pages - 1:
+            # per-SLOT capacity, not the global pool: a DP-sharded slot can
+            # only draw from its own shard's sub-pool, and an uncoverable
+            # request at the queue head wedges the no-skip-ahead admission
+            # forever (review finding)
+            cap = self.paged.allocator.slot_capacity()
+            if need > cap:
                 raise ValueError(
-                    f"request needs {need} KV pages but the pool only has "
-                    f"{self.paged.num_pages - 1}; raise num_pages or shorten"
+                    f"request needs {need} KV pages but a slot can hold at "
+                    f"most {cap}; raise num_pages or shorten"
                 )
         with self._cv:
             heapq.heappush(
@@ -1274,7 +1317,10 @@ class Engine:
             self.cache["page_table"] = self.paged.allocator.flush_frees(
                 self.cache["page_table"]
             )
+        pressure_called = False
         while True:
+            stale_resumes: List[GenRequest] = []
+            pressure_need = 0
             with self._cv:
                 free = self._free_slot_ids()
                 take = min(len(free), len(self._queue), self.prefill_batch)
@@ -1299,6 +1345,16 @@ class Engine:
                             break
                         req = self._queue[0][3]
                         if req.resume_pages is not None:
+                            # re-validate the resume epoch at ADMISSION,
+                            # not just submit (ADVICE r4 #2): a pool
+                            # reset while the request sat queued makes
+                            # its page ids dangling aliases
+                            if (req.resume_epoch is not None
+                                    and req.resume_epoch
+                                    != self.paged.allocator.generation):
+                                heapq.heappop(self._queue)
+                                stale_resumes.append(req)
+                                continue
                             # rolling-KV continuation: the kept pages are
                             # referenced (caller custody); only the part
                             # past resume_len needs fresh pages
@@ -1315,6 +1371,7 @@ class Engine:
                             row = self.paged.allocator.allocate_with_prefix(
                                 slot_id, req.resume_pages, n_fresh)
                             if row is None:
+                                pressure_need = n_fresh
                                 break  # pool exhausted; retry later
                             heapq.heappop(self._queue)
                             self._admitting.add(req.request_id)
@@ -1337,10 +1394,20 @@ class Engine:
                                 and not req.keep_pages):
                             hits, chains = self._prefix_plan(req.prompt,
                                                              pin=True)
+                            # DP-sharded pool: a slot can only reference
+                            # pages of its own shard (the shard_map'd
+                            # decode addresses its local sub-pool);
+                            # truncate foreign-shard hits and unpin them
+                            keep = self.paged.allocator.usable_prefix(
+                                slot_id, hits)
+                            if keep < len(hits):
+                                self._prefix.unpin(hits[keep:])
+                                hits = hits[:keep]
                         row = self._paged_allocate(slot_id, hits,
                                                    max(0, need - len(hits)))
                         if row is None:
                             self._prefix.unpin(hits) if hits else None
+                            pressure_need = max(0, need - len(hits))
                             break  # pool exhausted; retry after retirements
                         heapq.heappop(self._queue)
                         self._admitting.add(req.request_id)
@@ -1349,12 +1416,35 @@ class Engine:
                         if (use_pp and len(req.prompt) >= self._prefix_ps
                                 and not req.keep_pages):
                             plans[slot_id] = (hits, chains)
-                    if not popped:
-                        return
                 else:
                     resume_rows = {}
                     popped = [heapq.heappop(self._queue)[3] for _ in range(take)]
                     self._admitting.update(r.request_id for r in popped)
+            # outside the lock: fire callbacks / the pressure hook (either
+            # may re-enter submit() or take the serving layer's locks)
+            for req in stale_resumes:
+                self.metrics.counters["engine_stale_resumes"].inc()
+                if req.on_done is not None:
+                    try:
+                        req.on_done(req.request_id, [], "stale_resume")
+                    except Exception:
+                        logger.exception("on_done callback failed")
+            if self.paged and not popped:
+                if (pressure_need > 0 and not pressure_called
+                        and self.on_pool_pressure is not None):
+                    # ONE eviction attempt per admission round: the hook
+                    # frees idle rolling conversations' pages; if even
+                    # that can't cover the head request, fall back to
+                    # waiting for retirements as before
+                    pressure_called = True
+                    try:
+                        self.on_pool_pressure(pressure_need)
+                    except Exception:
+                        logger.exception("pool-pressure callback failed")
+                    continue
+                if stale_resumes:
+                    continue  # stale pops may have unblocked the queue head
+                return
             if self.paged and rows:
                 from ..ops.paged_kv import set_page_table_rows
 
@@ -1369,7 +1459,12 @@ class Engine:
             resume_batch: List[Tuple] = []
             max_suffix = max_hits = 0
             max_suffix_r = max_pages_r = 0
-            for slot_id, req in zip(free, popped):
+            # paged pops can SKIP a slot (stale resume popped without
+            # consuming it), so pair each request with the slot recorded
+            # at its allocation, not positionally with `free`
+            slot_ids = ([r[0] for r in rows] if self.paged
+                        else free[:len(popped)])
+            for slot_id, req in zip(slot_ids, popped):
                 if slot_id in resume_rows:
                     resume_batch.append((slot_id, req, resume_rows[slot_id]))
                     max_suffix_r = max(max_suffix_r, len(req.prompt))
@@ -1531,9 +1626,14 @@ class Engine:
         when the pool runs short. None if still uncoverable."""
         alloc = self.paged.allocator
         if self._prefix is not None:
-            shortfall = n_fresh - alloc.free_count()
+            # sharded pool: only this slot's shard's free pages count, and
+            # only same-shard cache pages are worth evicting (a foreign-
+            # shard eviction frees pages this slot can never use — review
+            # finding: unfiltered rounds drained the whole cache)
+            shortfall = n_fresh - alloc.free_count(slot_id)
             if shortfall > 0:
-                evicted = self._prefix.evict_lru(shortfall)
+                evicted = self._prefix.evict_lru(
+                    shortfall, want=alloc.evictable(slot_id))
                 if evicted:
                     alloc.add_free(evicted)
             return alloc.allocate_with_prefix(slot_id, hits, n_fresh)

@@ -172,11 +172,26 @@ class ServingService:
         # rather than a re-tokenization of its text.
         self._rolling: Optional[Dict[Tuple[str, str], Dict[str, Any]]] = None
         self._rolling_lock = threading.Lock()
-        if (self.engine.paged is not None
+        rolling_wanted = os.environ.get("SWARMDB_ROLLING_KV") == "1"
+        if (rolling_wanted and self.engine.paged is not None
+                and getattr(self.engine.paged.allocator,
+                            "n_shards", 1) > 1):
+            # DP-sharded pool: a kept conversation's pages pin it to ONE
+            # shard, but admission assigns any free slot — resume would
+            # need shard-affine slot routing that isn't wired yet
+            # (parallel/serving.build_sharded_paged docstring)
+            logger.warning("SWARMDB_ROLLING_KV=1 ignored: rolling resume "
+                           "is not supported on a DP-sharded page pool")
+            rolling_wanted = False
+        if (rolling_wanted and self.engine.paged is not None
                 and getattr(self.engine, "_prefill_paged_resume_fused",
-                            None) is not None
-                and os.environ.get("SWARMDB_ROLLING_KV") == "1"):
+                            None) is not None):
             self._rolling = {}
+            # low-memory hook (ADVICE r4 #1): when paged admission cannot
+            # allocate and the engine is otherwise idle, evict idle
+            # conversations' kept pages instead of stalling forever —
+            # non-rolling traffic must never starve behind parked KV
+            self.engine.on_pool_pressure = self._on_pool_pressure
 
     # ------------------------------------------------------------ lifecycle
 
@@ -434,8 +449,18 @@ class ServingService:
     def _rolling_epoch(self) -> int:
         """Engine restarts rebuild the page pool; registry entries from
         an older epoch hold dangling page ids and must never be resumed
-        OR add_free'd (the reset already reclaimed the pool)."""
-        return self.engine.metrics.counters["engine_restarts"].value
+        OR add_free'd (the reset already reclaimed the pool). Keyed on the
+        allocator's own pool generation (bumped inside reset(), ADVICE r4
+        #2): the restart counter incremented on a different schedule than
+        the pool rebuild, leaving a race window, and the in-loop error
+        recovery rebuilt the pool without touching it at all."""
+        return self.engine.pool_epoch()
+
+    def _on_pool_pressure(self, need: int) -> None:
+        """Engine thread, paged admission failed to allocate ``need``
+        pages: LRU-evict idle conversations' kept KV to unblock it."""
+        with self._rolling_lock:
+            self._rolling_evict(need)
 
     def _rolling_evict(self, need_free: int) -> None:
         """LRU-evict idle conversations until the allocator can cover
@@ -550,7 +575,11 @@ class ServingService:
             st["pending_count"] = total
             st["last"] = time.time()
             self.db.metrics.counters["rolling_resumes"].inc()
-            return "resume", (st["pages"], st["len"]), ptoks
+            # the observed epoch travels WITH the plan: submit/admission
+            # re-validate it against the live pool generation, so a pool
+            # reset in the plan->admit window fails the request instead
+            # of resuming dangling page ids (ADVICE r4 #2)
+            return "resume", (st["pages"], st["len"], epoch), ptoks
 
     def _rolling_store(self, key, pages, written, tail) -> None:
         """on_pages (engine thread, at retirement): adopt the turn's
@@ -669,132 +698,134 @@ class ServingService:
                     if _u is not None:
                         _u(rid, toks, reason)
 
-        if resume is None:
-            # Long-running conversations grow the prompt without bound;
-            # keep the TAIL (most recent turns) so a pair's history can
-            # never exceed the engine's window (engine.submit rejects
-            # len >= max_seq outright). The front is dropped in
-            # page-aligned HYSTERESIS steps (~half the budget), not
-            # token-exactly: a trim that slides every turn gives
-            # consecutive prompts no common prefix, so the prefix cache
-            # could never hit on bounded windows (measured: 13% hit rate
-            # with exact trimming vs ~anchored reuse).
-            budget = max(16,
-                         self.engine.max_seq - 1 - sampling.max_new_tokens)
-            budget = min(budget, self.engine.max_seq - 1)
-            if rolling_mode == "keep":
-                # rolling restart: leave HEADROOM or the very next turn
-                # overflows max_seq and the conversation restarts every
-                # turn instead of rolling (measured: restarts 3:1 over
-                # resumes with a full-budget restart). StreamingLLM-style
-                # half-window restart; anchor-stable trimming is moot —
-                # subsequent turns resume by identity, not hash match
-                frac = _env_float("SWARMDB_ROLL_RESTART", 0.5)
-                budget = max(16, int(budget * min(0.9, max(0.1, frac))))
-                if len(prompt) > budget:
-                    prompt = prompt[-budget:]
-            elif len(prompt) > budget:
-                if self.engine._prefix is not None:
-                    ps = self.engine._prefix_ps
-                    # trim-step fraction trades history depth right after
-                    # a jump against epoch length: each jump re-anchors
-                    # the prompt start, and EVERY cached page of the
-                    # conversation is invalidated across a jump (prompt
-                    # positions restart at 0, so KV computed under the
-                    # old anchor is numerically wrong under the new one).
-                    # Longer epochs = fewer full-miss turns; measured on
-                    # the serve mix the jump misses are the single
-                    # largest loss (~37% of prompt tokens at the 0.5
-                    # default, scripts/probe_prefix)
-                    frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
-                    frac = min(0.9, max(0.1, frac))
-                    step = max(ps, int(budget * frac) // ps * ps)
-                    drop = -(-(len(prompt) - budget) // step) * step
-                    if len(prompt) - drop >= 16:
-                        prompt = prompt[drop:]
-                    else:
+        try:
+            if resume is None:
+                # Long-running conversations grow the prompt without bound;
+                # keep the TAIL (most recent turns) so a pair's history can
+                # never exceed the engine's window (engine.submit rejects
+                # len >= max_seq outright). The front is dropped in
+                # page-aligned HYSTERESIS steps (~half the budget), not
+                # token-exactly: a trim that slides every turn gives
+                # consecutive prompts no common prefix, so the prefix cache
+                # could never hit on bounded windows (measured: 13% hit rate
+                # with exact trimming vs ~anchored reuse).
+                budget = max(16,
+                             self.engine.max_seq - 1 - sampling.max_new_tokens)
+                budget = min(budget, self.engine.max_seq - 1)
+                if rolling_mode == "keep":
+                    # rolling restart: leave HEADROOM or the very next turn
+                    # overflows max_seq and the conversation restarts every
+                    # turn instead of rolling (measured: restarts 3:1 over
+                    # resumes with a full-budget restart). StreamingLLM-style
+                    # half-window restart; anchor-stable trimming is moot —
+                    # subsequent turns resume by identity, not hash match
+                    frac = _env_float("SWARMDB_ROLL_RESTART", 0.5)
+                    budget = max(16, int(budget * min(0.9, max(0.1, frac))))
+                    if len(prompt) > budget:
                         prompt = prompt[-budget:]
-                else:
-                    # no prefix cache -> keep the maximum recent history
-                    prompt = prompt[-budget:]
+                elif len(prompt) > budget:
+                    if self.engine._prefix is not None:
+                        ps = self.engine._prefix_ps
+                        # trim-step fraction trades history depth right after
+                        # a jump against epoch length: each jump re-anchors
+                        # the prompt start, and EVERY cached page of the
+                        # conversation is invalidated across a jump (prompt
+                        # positions restart at 0, so KV computed under the
+                        # old anchor is numerically wrong under the new one).
+                        # Longer epochs = fewer full-miss turns; measured on
+                        # the serve mix the jump misses are the single
+                        # largest loss (~37% of prompt tokens at the 0.5
+                        # default, scripts/probe_prefix)
+                        frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
+                        frac = min(0.9, max(0.1, frac))
+                        step = max(ps, int(budget * frac) // ps * ps)
+                        drop = -(-(len(prompt) - budget) // step) * step
+                        if len(prompt) - drop >= 16:
+                            prompt = prompt[drop:]
+                        else:
+                            prompt = prompt[-budget:]
+                    else:
+                        # no prefix cache -> keep the maximum recent history
+                        prompt = prompt[-budget:]
 
-        def _done(rid: str, tokens: List[int], reason: str) -> None:
-            # engine thread: just hand off — emission runs on _reply_loop.
-            # Logprobs travel IN the queue tuple (not via msg.metadata,
-            # which a client could pre-populate — review finding)
-            msg.stage_stamp("done")
-            lps = (list(req.metadata.get("logprobs", []))
-                   if want_logprobs else None)
-            self._reply_queue.put((msg, rid, tokens, reason, sampling.stop,
-                                   lps, None, on_done))
+            def _done(rid: str, tokens: List[int], reason: str) -> None:
+                # engine thread: just hand off — emission runs on _reply_loop.
+                # Logprobs travel IN the queue tuple (not via msg.metadata,
+                # which a client could pre-populate — review finding)
+                msg.stage_stamp("done")
+                lps = (list(req.metadata.get("logprobs", []))
+                       if want_logprobs else None)
+                self._reply_queue.put((msg, rid, tokens, reason, sampling.stop,
+                                       lps, None, on_done))
 
-        # stop-sequence watch (host-side): keep a bounded tail of decoded
-        # text and CANCEL the engine request at the first match — the
-        # remaining lane work is at most one chunk of discarded garbage.
-        # Final truncation happens at reply emission regardless, so a
-        # match straddling a chunk boundary still yields a clean reply.
-        stop_tail: List[int] = []
-        stop_chars = max((len(s) for s in sampling.stop), default=0)
-        # window in TOKENS: a char is up to 4 UTF-8 bytes and the byte
-        # tokenizer is one token per byte, so a char-sized window could
-        # never match multi-byte stop strings (review finding)
-        stop_window = 4 * stop_chars + 8
-        stop_hit = False
+            # stop-sequence watch (host-side): keep a bounded tail of decoded
+            # text and CANCEL the engine request at the first match — the
+            # remaining lane work is at most one chunk of discarded garbage.
+            # Final truncation happens at reply emission regardless, so a
+            # match straddling a chunk boundary still yields a clean reply.
+            stop_tail: List[int] = []
+            stop_chars = max((len(s) for s in sampling.stop), default=0)
+            # window in TOKENS: a char is up to 4 UTF-8 bytes and the byte
+            # tokenizer is one token per byte, so a char-sized window could
+            # never match multi-byte stop strings (review finding)
+            stop_window = 4 * stop_chars + 8
+            stop_hit = False
 
-        def _watch_stop(rid: str, token: int) -> None:
-            nonlocal stop_hit
-            if stop_hit:
-                return
-            stop_tail.append(token)
-            if len(stop_tail) > stop_window:
-                del stop_tail[0]
-            text = self.tokenizer.decode(stop_tail)
-            if any(s in text for s in sampling.stop):
-                stop_hit = True
-                self.engine.cancel(rid)
+            def _watch_stop(rid: str, token: int) -> None:
+                nonlocal stop_hit
+                if stop_hit:
+                    return
+                stop_tail.append(token)
+                if len(stop_tail) > stop_window:
+                    del stop_tail[0]
+                text = self.tokenizer.decode(stop_tail)
+                if any(s in text for s in sampling.stop):
+                    stop_hit = True
+                    self.engine.cancel(rid)
 
-        def _tok(rid: str, token: int) -> None:
-            if "first_token" not in msg.metadata.get("stages", {}):
-                msg.stage_stamp("first_token")
-                stages = msg.metadata["stages"]
-                if "enqueued" in stages:
-                    ttft = stages["first_token"] - stages["enqueued"]
-                    self.db.metrics.latencies["send_to_first_token_s"].observe(ttft)
-                    # per-priority evidence that CRITICAL beats LOW under
-                    # load (the engine's priority admission, bench swarm100)
-                    self.db.metrics.latencies[
-                        f"send_to_first_token_prio{priority}_s"].observe(ttft)
-            if sampling.stop:
-                _watch_stop(rid, token)
-            if on_token is not None:
-                on_token(rid, token)
+            def _tok(rid: str, token: int) -> None:
+                if "first_token" not in msg.metadata.get("stages", {}):
+                    msg.stage_stamp("first_token")
+                    stages = msg.metadata["stages"]
+                    if "enqueued" in stages:
+                        ttft = stages["first_token"] - stages["enqueued"]
+                        self.db.metrics.latencies["send_to_first_token_s"].observe(ttft)
+                        # per-priority evidence that CRITICAL beats LOW under
+                        # load (the engine's priority admission, bench swarm100)
+                        self.db.metrics.latencies[
+                            f"send_to_first_token_prio{priority}_s"].observe(ttft)
+                if sampling.stop:
+                    _watch_stop(rid, token)
+                if on_token is not None:
+                    on_token(rid, token)
 
-        req = GenRequest(
-            prompt=prompt, sampling=sampling, priority=priority,
-            on_token=_tok, on_done=_done,
-            metadata={"message_id": msg.id},
-        )
-        if rolling_key is not None:
-            req.keep_pages = True
-            req.on_pages = (lambda rid, pages, written, tail,
-                            _k=rolling_key:
-                            self._rolling_store(_k, pages, written, tail))
-            if resume is not None:
-                req.resume_pages = list(resume[0])
-                req.resume_len = resume[1]
-        if n > 1:
-            return self._serve_n(msg, req, prompt, sampling, priority, n,
-                                 want_logprobs, on_done)
-        if rolling_key is not None:
-            try:
-                return self.engine.submit(req)
-            except Exception:
-                # the in-flight claim must not leak or the conversation
-                # never rolls again (and a resumed state's pages would
-                # stay referenced by nothing)
+            req = GenRequest(
+                prompt=prompt, sampling=sampling, priority=priority,
+                on_token=_tok, on_done=_done,
+                metadata={"message_id": msg.id},
+            )
+            if rolling_key is not None:
+                req.keep_pages = True
+                req.on_pages = (lambda rid, pages, written, tail,
+                                _k=rolling_key:
+                                self._rolling_store(_k, pages, written, tail))
+                if resume is not None:
+                    req.resume_pages = list(resume[0])
+                    req.resume_len = resume[1]
+                    req.resume_epoch = resume[2]
+            if n > 1:
+                return self._serve_n(msg, req, prompt, sampling, priority, n,
+                                     want_logprobs, on_done)
+            return self.engine.submit(req)
+        except Exception:
+            # the in-flight claim taken by _rolling_plan must not leak on
+            # ANY failure between the plan and the submit (ADVICE r4 low
+            # #3: trim arithmetic, GenRequest construction, closure setup)
+            # or the conversation never rolls again and its resumed pages
+            # stay referenced by nothing
+            if rolling_key is not None:
                 self._rolling_finalize(rolling_key, msg, "submit_error")
-                raise
-        return self.engine.submit(req)
+            raise
 
     def _serve_n(self, msg: Message, req0: GenRequest, prompt: List[int],
                  sampling: SamplingParams, priority: int, n: int,

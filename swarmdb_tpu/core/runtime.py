@@ -667,7 +667,13 @@ class SwarmDB:
                 start = -(-(total - limit) // step) * step  # round UP
                 keep = max(1, total - start)
             tail = list(stream[-keep:])
-        return sorted(tail, key=lambda m: m.timestamp)
+        # STREAM order, not timestamp order (ADVICE r4 low #4): the
+        # rolling-KV suffix builder renders get_conversation_delta in
+        # send order, and the two renderings must agree or a resumed
+        # conversation's history ordering diverges from what a fresh
+        # restart would render whenever timestamps disagree with stream
+        # order (clock skew, imported history)
+        return tail
 
     # ------------------------------------------------------------- status mgmt
 

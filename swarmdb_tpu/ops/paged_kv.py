@@ -232,11 +232,37 @@ class PageAllocator:
         self.max_seq = max_seq
         self.maxp = pages_per_slot(max_seq, page_size)
         self.num_pages = num_pages
-        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # stack
         self._by_slot: Dict[int, _SlotPages] = {}
         self._pending_free: List[int] = []  # slot ids retired, not yet flushed
         self._lock = threading.Lock()
         self.batch = batch
+        # pool generation: bumped by every reset(). Page ids held OUTSIDE
+        # the allocator (the serving layer's rolling-KV registry) are only
+        # valid within the generation they were handed out in — a reset
+        # reclaims the whole pool, so a stale holder resuming or freeing
+        # them would alias another slot's pages (ADVICE r4 medium #2).
+        self.generation = 0
+        self._rebuild_free()
+
+    # -- free-list geometry (the ONLY pieces the sharded subclass swaps) -----
+
+    def _rebuild_free(self) -> None:
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+
+    def _take(self, slot_id: int, n: int) -> Optional[List[int]]:
+        """Pop ``n`` pages usable by ``slot_id``; None if uncoverable.
+        Caller holds the lock."""
+        if len(self._free) < n:
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def _give(self, page_ids: List[int]) -> None:
+        """Return pages to the free list. Caller holds the lock."""
+        self._free.extend(page_ids)
+
+    def _check_prefix(self, slot_id: int, prefix_pages: List[int]) -> None:
+        """Engine-bug guard hook: referenced (not owned) pages must be
+        addressable by this slot. No constraint on the single pool."""
 
     # -- admission -----------------------------------------------------------
 
@@ -255,11 +281,11 @@ class PageAllocator:
         """Take n pages for a slot; None if the pool can't cover it.
         Returns the slot's FULL page-table row (maxp wide, trash-padded)."""
         with self._lock:
-            if len(self._free) < n:
-                return None
             if slot_id in self._by_slot:
                 raise RuntimeError(f"slot {slot_id} already holds pages")
-            pages = [self._free.pop() for _ in range(n)]
+            pages = self._take(slot_id, n)
+            if pages is None:
+                return None
             self._by_slot[slot_id] = _SlotPages(pages)
             row = np.zeros(self.maxp, np.int32)
             row[: len(pages)] = pages
@@ -273,11 +299,12 @@ class PageAllocator:
         ``n_fresh`` newly owned pages. None if the pool can't cover the
         fresh part."""
         with self._lock:
-            if len(self._free) < n_fresh:
-                return None
             if slot_id in self._by_slot:
                 raise RuntimeError(f"slot {slot_id} already holds pages")
-            fresh = [self._free.pop() for _ in range(n_fresh)]
+            self._check_prefix(slot_id, prefix_pages)
+            fresh = self._take(slot_id, n_fresh)
+            if fresh is None:
+                return None
             self._by_slot[slot_id] = _SlotPages(fresh)
             row = np.zeros(self.maxp, np.int32)
             pages = list(prefix_pages) + fresh
@@ -297,9 +324,11 @@ class PageAllocator:
         """Return cache-evicted pages to the pool (prefix-cache eviction
         path; the caller guarantees no live slot references them)."""
         with self._lock:
-            self._free.extend(page_ids)
+            self._give(page_ids)
 
-    def free_count(self) -> int:
+    def free_count(self, slot_id: Optional[int] = None) -> int:
+        """Free pages available — to ``slot_id`` if given (the sharded
+        allocator restricts each slot to its shard's sub-pool)."""
         with self._lock:
             return len(self._free)
 
@@ -334,8 +363,31 @@ class PageAllocator:
             for slot_id in pending:
                 sp = self._by_slot.pop(slot_id, None)
                 if sp is not None:
-                    self._free.extend(reversed(sp.pages))
+                    self._give(list(reversed(sp.pages)))
         return page_table
+
+    # -- DP-sharding hooks (no-ops for the single-pool allocator) ------------
+
+    def usable_prefix(self, slot_id: int, hits: List[int]) -> int:
+        """How many of ``hits`` (a prefix-cache chain, in order) this slot
+        may reference. The single pool has no locality constraint."""
+        return len(hits)
+
+    def shard_of(self, slot_id: int) -> int:
+        return 0
+
+    def slot_capacity(self) -> int:
+        """Most pages any single request can ever be granted — the
+        admission-feasibility bound Engine.submit checks (a request
+        needing more would wedge the no-skip-ahead admission queue
+        forever)."""
+        return self.num_pages - 1
+
+    def evictable(self, slot_id: int):
+        """Predicate for prefix-cache eviction on behalf of ``slot_id``:
+        only pages that could actually cover its shortfall qualify. The
+        single pool accepts any page (None = no filter)."""
+        return None
 
     # -- introspection -------------------------------------------------------
 
@@ -350,6 +402,129 @@ class PageAllocator:
 
     def reset(self) -> None:
         with self._lock:
-            self._free = list(range(self.num_pages - 1, 0, -1))
+            # bump BEFORE rebuilding the free list: a racing epoch check
+            # must never observe (old generation, rebuilt pool)
+            self.generation += 1
+            self._rebuild_free()
             self._by_slot.clear()
             self._pending_free.clear()
+
+
+class ShardedPageAllocator(PageAllocator):
+    """Slot→shard-affine page pool for DP-sharded paged serving
+    (parallel/serving.py ``build_serving_engine(paged=True)``).
+
+    Global page ids are STRIPED per data shard: shard ``k`` owns
+    ``[k*Pl, (k+1)*Pl)`` (``Pl = pages_per_shard``), and slot ``s``
+    belongs to shard ``s // (batch / n_shards)``. Every page a slot's
+    table row references therefore lives in that slot's shard of the
+    device pool (the pool array shards its PAGE axis over ``data``), so
+    the shard_map'd decode step's gathers and scatters are purely
+    shard-local — the SPMD decode program contains zero collectives and
+    scales linearly over the data axis.
+
+    Id ``k*Pl`` is shard-``k``'s TRASH page, never handed out: inside the
+    shard_map the table is localized as ``clip(table - k*Pl, 0, Pl-1)``,
+    which maps this shard's ids to ``[1, Pl)``, the global trash 0 (and
+    any zeroed/retired row) to local 0, and can never alias a foreign
+    shard's pages because foreign ids are simply not reachable from this
+    shard's table rows.
+
+    Inherits all retirement/custody bookkeeping (``_by_slot``,
+    ``flush_frees``) from the base class — only the free-list geometry
+    and the prefix-locality check change.
+    """
+
+    def __init__(self, pages_per_shard: int, n_shards: int, page_size: int,
+                 max_seq: int, batch: int) -> None:
+        if n_shards < 1 or batch % n_shards:
+            raise ValueError(
+                f"batch {batch} must divide over n_shards {n_shards}")
+        if pages_per_shard < 2:
+            raise ValueError("need >= 2 pages per shard (one is trash)")
+        # geometry attrs BEFORE super().__init__ — it calls the overridden
+        # _rebuild_free, which needs them
+        self.n_shards = n_shards
+        self.pages_per_shard = pages_per_shard
+        self.slots_per_shard = batch // n_shards
+        super().__init__(pages_per_shard * n_shards, page_size, max_seq,
+                         batch)
+
+    # -- free-list geometry (everything else is inherited) -------------------
+
+    def _rebuild_free(self) -> None:
+        # per-shard stacks; ids k*Pl (per-shard trash) are never free
+        pl = self.pages_per_shard
+        self._free_by_shard: List[List[int]] = [
+            list(range((k + 1) * pl - 1, k * pl, -1))
+            for k in range(self.n_shards)
+        ]
+
+    def _take(self, slot_id: int, n: int) -> Optional[List[int]]:
+        free = self._free_by_shard[self.shard_of(slot_id)]
+        if len(free) < n:
+            return None
+        return [free.pop() for _ in range(n)]
+
+    def _give(self, page_ids: List[int]) -> None:
+        for p in page_ids:
+            self._free_by_shard[self.shard_of_page(p)].append(p)
+
+    def _check_prefix(self, slot_id: int, prefix_pages: List[int]) -> None:
+        shard = self.shard_of(slot_id)
+        if any(self.shard_of_page(p) != shard for p in prefix_pages):
+            # engine bug guard: usable_prefix() must have trimmed these
+            raise RuntimeError(
+                f"slot {slot_id} (shard {shard}) referencing foreign-"
+                f"shard prefix pages {prefix_pages}")
+
+    # -- shard geometry ------------------------------------------------------
+
+    def shard_of(self, slot_id: int) -> int:
+        return min(self.n_shards - 1, slot_id // self.slots_per_shard)
+
+    def shard_of_page(self, page_id: int) -> int:
+        return min(self.n_shards - 1, page_id // self.pages_per_shard)
+
+    def slot_capacity(self) -> int:
+        # a slot can only ever draw from its own shard's sub-pool
+        return self.pages_per_shard - 1
+
+    def evictable(self, slot_id: int):
+        shard = self.shard_of(slot_id)
+        return lambda p: self.shard_of_page(p) == shard
+
+    def can_allocate(self, n: int) -> bool:
+        with self._lock:
+            return any(len(f) >= n for f in self._free_by_shard)
+
+    def free_count(self, slot_id: Optional[int] = None) -> int:
+        with self._lock:
+            if slot_id is None:
+                return sum(len(f) for f in self._free_by_shard)
+            return len(self._free_by_shard[self.shard_of(slot_id)])
+
+    def usable_prefix(self, slot_id: int, hits: List[int]) -> int:
+        """Truncate a prefix-chain match at the first page outside the
+        slot's shard: the shard_map'd decode can only address its own
+        sub-pool, so a cross-shard reference would localize to a wrong
+        page. (Chains register whole per-shard, so in practice a chain
+        is either fully usable or fully foreign.)"""
+        shard = self.shard_of(slot_id)
+        n = 0
+        for p in hits:
+            if self.shard_of_page(p) != shard:
+                break
+            n += 1
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_pages": self.num_pages,
+                "free_pages": sum(len(f) for f in self._free_by_shard),
+                "free_by_shard": [len(f) for f in self._free_by_shard],
+                "live_slots": len(self._by_slot),
+                "page_size": self.page_size,
+                "n_shards": self.n_shards,
+            }

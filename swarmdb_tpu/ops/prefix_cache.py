@@ -137,14 +137,18 @@ class PrefixLRU:
                     take.append(page_id)
             return take
 
-    def evict_lru(self, n: int) -> List[int]:
+    def evict_lru(self, n: int, want=None) -> List[int]:
         """Evict up to ``n`` LRU unpinned entries, returning their page
         ids for the caller's free list (paged-engine mode — the returned
-        pages are NOT retained here)."""
+        pages are NOT retained here). ``want(page_id)`` filters the
+        candidates: on a DP-sharded pool only same-shard pages can cover
+        a slot's shortfall, and evicting foreign-shard entries would
+        drain the whole cache without unblocking anything."""
         with self._lock:
             out: List[int] = []
             for chain in [c for c, (p, _) in self._entries.items()
-                          if not self._pins.get(p)]:
+                          if not self._pins.get(p)
+                          and (want is None or want(p))]:
                 if len(out) >= n:
                     break
                 page_id, _ = self._entries.pop(chain)

@@ -19,7 +19,7 @@ from swarmdb_tpu.ops.paged_kv import PageAllocator
 PS, MAX_SEQ, BATCH = 8, 96, 2
 
 
-def _mk_engine(params):
+def _mk_engine(params, start=True):
     cfg = TINY_DEBUG
     num_pages = 1 + 2 * BATCH * (MAX_SEQ // PS)
     spec = PagedKV(
@@ -42,7 +42,8 @@ def _mk_engine(params):
             None,
         ),
     )
-    eng.start()
+    if start:
+        eng.start()
     return eng
 
 
@@ -232,7 +233,9 @@ def test_rolling_plan_concurrent_turn_is_plain(monkeypatch):
             mid3 = db.send_message("u", "bot", "third")
             mode3, res3, toks3 = svc._rolling_plan(
                 key, db.get_message(mid3), sp)
-            assert mode3 == "resume" and res3 == ([1, 2], 12)
+            assert mode3 == "resume" and res3[:2] == ([1, 2], 12)
+            # the plan carries the pool epoch it observed (ADVICE r4 #2)
+            assert res3[2] == svc._rolling_epoch()
             assert toks3  # non-empty suffix
         finally:
             db.close()
@@ -360,6 +363,127 @@ def test_service_rolling_tool_call_turns(monkeypatch):
             # every reply id so far was recorded for suffix exclusion
             st = next(iter(svc._rolling.values()))
             assert st["reply_ids"], "reply ids not recorded"
+        finally:
+            svc.stop()
+            db.close()
+
+
+# --------------------------------------------------------- ADVICE r4 fixes
+
+
+def test_stale_resume_epoch_rejected_at_submit(params):
+    """A resume planned against an older pool generation must be refused
+    at submit: the reset reclaimed those page ids, so resuming them would
+    alias another slot's pages (ADVICE r4 medium #2)."""
+    eng = _mk_engine(params)
+    try:
+        _, pages, written, _ = _gen_keep(eng, list(range(3, 20)), 4)
+        req = GenRequest(
+            prompt=[5, 6, 7],
+            sampling=SamplingParams(max_new_tokens=2, temperature=0.0),
+            keep_pages=True,
+        )
+        req.resume_pages = list(pages)
+        req.resume_len = written
+        req.resume_epoch = eng.pool_epoch() - 1  # stale by one reset
+        with pytest.raises(ValueError, match="stale resume epoch"):
+            eng.submit(req)
+    finally:
+        eng.stop()
+
+
+def test_stale_resume_epoch_failed_at_admission(params):
+    """Epoch is re-validated at ADMISSION too: a pool reset while the
+    request sat queued (restart racing a plan) must fail the request
+    instead of resuming dangling page ids."""
+    import threading
+
+    eng = _mk_engine(params, start=False)
+    done = threading.Event()
+    out = {}
+
+    def on_done(rid, toks, reason):
+        out["reason"] = reason
+        done.set()
+
+    req = GenRequest(
+        prompt=[5, 6, 7],
+        sampling=SamplingParams(max_new_tokens=2, temperature=0.0),
+        on_done=on_done, keep_pages=True,
+    )
+    req.resume_pages = [1, 2]
+    req.resume_len = 12
+    req.resume_epoch = eng.pool_epoch()  # valid NOW
+    eng.submit(req)  # engine not running: stays queued
+    eng.paged.allocator.reset()  # pool rebuilt while queued
+    eng.start()
+    try:
+        assert done.wait(60)
+        assert out["reason"] == "stale_resume"
+        assert eng.metrics.counters["engine_stale_resumes"].value == 1
+    finally:
+        eng.stop()
+
+
+def test_pool_pressure_evicts_idle_rolling(monkeypatch):
+    """ADVICE r4 medium #1: idle conversations' kept pages must not
+    starve new traffic. With the pool sized so a second conversation
+    cannot allocate while the first's (idle) pages are parked, admission
+    must invoke the pressure hook, evict the idle state, and admit."""
+    import tempfile
+    import time as _time
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.backend.service import ServingService
+
+    monkeypatch.setenv("SWARMDB_ROLLING_KV", "1")
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        for a in ("u1", "u2", "bot"):
+            db.register_agent(a)
+        db.assign_llm_backend("bot", "b0")
+        db.set_llm_load_balancing(True)
+        svc = ServingService.from_model_name(
+            db, "tiny-debug", backend_id="b0", max_batch=1, max_seq=64,
+            decode_chunk=4, paged=True, page_size=8,
+            kv_pool_tokens=64)  # 8 usable pages + trash
+        svc.start(warmup=False)
+        try:
+            db.send_message(
+                "u1", "bot", "hello " * 12,
+                metadata={"generation": {"max_new_tokens": 4,
+                                         "temperature": 0.0}})
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                st = svc._rolling.get(("u1", "bot"))
+                if (st is not None and st.get("pages")
+                        and not st.get("in_flight")):
+                    break
+                _time.sleep(0.05)
+            else:
+                raise AssertionError("turn 1 never parked pages")
+            held = len(st["pages"])
+            free = svc.engine.paged.allocator.free_count()
+            # the second request's worst-case footprint must exceed the
+            # free pool but fit once the idle pages are reclaimed
+            need = svc.engine.paged.allocator.pages_needed(23, 16, 4)
+            assert need > free, (need, free)
+            assert need <= free + held, (need, free, held)
+            db.send_message(
+                "u2", "bot", "world " * 12,
+                metadata={"generation": {"max_new_tokens": 16,
+                                         "temperature": 0.0}})
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                if db.metrics.counters["completed_messages"].value >= 2:
+                    break
+                _time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "second conversation never completed (pool stalled)")
+            assert db.metrics.counters["rolling_evictions"].value >= 1
+            assert ("u1", "bot") not in svc._rolling
         finally:
             svc.stop()
             db.close()

@@ -133,6 +133,23 @@ def test_conversation(tmp_swarm):
     assert convo == sorted(convo, key=lambda m: m.timestamp)
 
 
+def test_window_and_delta_agree_on_order(tmp_swarm):
+    """ADVICE r4 low #4: get_conversation_window (fresh-prompt builder)
+    and get_conversation_delta (rolling suffix builder) must render the
+    SAME order even when timestamps disagree with stream order — a
+    timestamp sort in one but not the other makes a resumed
+    conversation's history ordering diverge from a fresh restart's."""
+    db = tmp_swarm
+    ids = [db.send_message("a", "b", f"m{i}") for i in range(6)]
+    # skew the clocks: swap two messages' timestamps
+    db.messages[ids[2]].timestamp, db.messages[ids[4]].timestamp = (
+        db.messages[ids[4]].timestamp, db.messages[ids[2]].timestamp)
+    window = db.get_conversation_window("a", "b", limit=10)
+    _, delta = db.get_conversation_delta("a", "b", 0)
+    assert [m.id for m in window] == ids  # stream order, not timestamp
+    assert [m.id for m in delta] == ids
+
+
 def test_status_management_and_resend(tmp_swarm):
     db = tmp_swarm
     mid = db.send_message("a", "b", "x")
