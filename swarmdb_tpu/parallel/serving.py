@@ -35,6 +35,22 @@ from .mesh import make_mesh, tree_shardings
 TOKEN_SPEC = P("data", None)
 CACHE_SPEC = P(None, "data", None, "model", None)
 
+# DP-sharded PAGED serving (VERDICT r4 #2): the page pool shards its PAGE
+# axis over ``data`` and the page table its SLOT axis, with slot→shard
+# affinity enforced host-side by ops.paged_kv.ShardedPageAllocator — every
+# page a slot references lives in that slot's shard of the pool, so the
+# shard_map'd decode below is collective-free (dp independent single-chip
+# decode programs; linear scaling over ICI-connected chips).
+PAGED_POOL_SPEC = P(None, "data", None, None, None)   # [L, P, ps, Hkv, D]
+PAGED_TABLE_SPEC = P("data", None)                    # [B, maxp]
+PAGED_CACHE_SPECS = {
+    "k": PAGED_POOL_SPEC,
+    "v": PAGED_POOL_SPEC,
+    "page_table": PAGED_TABLE_SPEC,
+    "pos0": P("data"),
+}
+CHUNK_KV_SPEC = P(None, "data", None, None, None)     # [L, B, Kc, Hkv, D]
+
 
 @dataclass
 class ShardedModel:
@@ -161,6 +177,179 @@ def build_sharded_model(
     )
 
 
+def build_sharded_paged(
+    sm: ShardedModel,
+    *,
+    max_batch: int,
+    max_seq: int,
+    page_size: int = 16,
+    kv_pool_tokens: Optional[int] = None,
+    prefix: bool = True,
+):
+    """DP-sharded paged-KV wiring for a :class:`ShardedModel`.
+
+    Returns ``(paged_spec, prefix_fns)`` ready for ``Engine(paged=...,
+    prefix_fns=..., chunked_fns=paged_spec.chunked_fns)``. Design
+    (VERDICT r4 #2 — the fast path must be constructible multi-chip):
+
+    - The pool's PAGE axis and the table's SLOT axis shard over ``data``;
+      ``ShardedPageAllocator`` stripes the global id space per shard and
+      binds slot ``s`` to shard ``s // (B/dp)``, so every table entry is
+      shard-local by construction.
+    - The decode chunk runs under ``shard_map``: each device localizes
+      its table block (``clip(table - shard*Pl, 0, Pl-1)`` — own ids map
+      to [1, Pl), zeroed/trash entries to the shard's local trash 0) and
+      gathers/scatters ONLY its own sub-pool. No collectives in the
+      decode hot loop: DP decode is dp independent single-chip programs.
+    - Prefill (admission-time, amortized) stays on GSPMD with GLOBAL page
+      ids against the sharded pool; the dense forward inside it is
+      data-sharded by the ShardedModel's constraints.
+    - Requires a pure-DP mesh for the pool (``model`` axis size 1): TP
+      inside shard_map would need manual collectives the model fns don't
+      emit. TP+paged is a deliberate non-goal this round — the v5e-8
+      500-msgs/sec target config is DP over 8 chips of an 8B-class model.
+
+    Rolling-KV resume is not wired for sharded pools yet (a resumed
+    conversation's pages pin it to one shard; the serving layer disables
+    rolling when it sees a sharded allocator).
+    """
+    try:
+        # jax >= 0.8: check_vma replaces the old check_rep knob (off: the
+        # bodies are intentionally per-shard — nothing is replicated)
+        from jax import shard_map as _smap
+
+        def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+            return _smap(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_rep)
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..ops.layers import pallas_disabled
+    from ..ops.paged_kv import (ShardedPageAllocator, init_paged_kv_cache,
+                                pages_per_slot)
+
+    cfg, mesh, fam = sm.cfg, sm.mesh, _family(sm.cfg)
+    if any(mesh.shape.get(ax, 1) > 1 for ax in ("model", "expert", "pipe")):
+        raise ValueError(
+            "sharded paged serving requires a pure-DP mesh (model/expert/"
+            "pipe axes of size 1); TP/EP shard KV heads across devices, "
+            "which the slot-affine page pool does not support"
+        )
+    dp = mesh.shape["data"]
+    if max_batch % dp:
+        raise ValueError(f"max_batch {max_batch} must divide the data "
+                         f"axis {dp} (slot→shard affinity)")
+    if max_seq % page_size:
+        raise ValueError("max_seq must be a page-size multiple")
+    maxp = pages_per_slot(max_seq, page_size)
+    if kv_pool_tokens is None:
+        kv_pool_tokens = max_batch * maxp * page_size
+        if prefix:
+            # cached pages compete with slot footprints (same rationale
+            # as ServingService.from_model_name)
+            import os as _os
+
+            kv_pool_tokens += int(_os.environ.get(
+                "SWARMDB_PREFIX_TOKENS", max_batch * max_seq // 2))
+    # per-shard pool block: local trash page + this shard's share
+    per_shard = 1 + -(-kv_pool_tokens // (page_size * dp))
+    num_pages = per_shard * dp
+    allocator = ShardedPageAllocator(per_shard, dp, page_size, max_seq,
+                                     max_batch)
+
+    params_specs = jax.tree.map(lambda _: P(), sm.params)
+
+    def _localize(table):
+        base = jax.lax.axis_index("data").astype(jnp.int32) * per_shard
+        return jnp.clip(table - base, 0, per_shard - 1)
+
+    def _decode_body(p, t, pos, c):
+        local = dict(c, page_table=_localize(c["page_table"]))
+        with pallas_disabled():
+            logits, out = fam.forward_paged(p, cfg, t, pos, local)
+        out["page_table"] = c["page_table"]  # keep GLOBAL ids outside
+        return logits, out
+
+    decode_forward = shard_map(
+        _decode_body, mesh=mesh,
+        in_specs=(params_specs, TOKEN_SPEC, TOKEN_SPEC, PAGED_CACHE_SPECS),
+        out_specs=(P("data", None, None), PAGED_CACHE_SPECS),
+        check_rep=False,
+    )
+
+    def _chunk_body(p, t, pos, c, chunk_kv, step):
+        local = dict(c, page_table=_localize(c["page_table"]))
+        with pallas_disabled():
+            logits, out_ck = fam.forward_paged_chunked(
+                p, cfg, t, pos, local, chunk_kv, step)
+        return logits, out_ck
+
+    chunk_forward = shard_map(
+        _chunk_body, mesh=mesh,
+        in_specs=(params_specs, TOKEN_SPEC, TOKEN_SPEC, PAGED_CACHE_SPECS,
+                  (CHUNK_KV_SPEC, CHUNK_KV_SPEC), P()),
+        out_specs=(P("data", None, None), (CHUNK_KV_SPEC, CHUNK_KV_SPEC)),
+        check_rep=False,
+    )
+
+    def _merge_body(c, chunk_kv, starts):
+        local = dict(c, page_table=_localize(c["page_table"]))
+        out = fam.merge_paged_chunk(local, chunk_kv, starts)
+        out["page_table"] = c["page_table"]
+        return out
+
+    merge = shard_map(
+        _merge_body, mesh=mesh,
+        in_specs=(PAGED_CACHE_SPECS, (CHUNK_KV_SPEC, CHUNK_KV_SPEC),
+                  P("data")),
+        out_specs=PAGED_CACHE_SPECS,
+        check_rep=False,
+    )
+
+    chunk_sharding = NamedSharding(mesh, CHUNK_KV_SPEC)
+
+    def init_chunk_fn(batch: int, k: int):
+        shape_fn = partial(fam.init_chunk_kv, cfg, batch, k)
+        out_sh = jax.tree.map(lambda _: chunk_sharding,
+                              jax.eval_shape(shape_fn))
+        return jax.jit(shape_fn, out_shardings=out_sh)()
+
+    def init_pool():
+        shape_fn = partial(
+            init_paged_kv_cache, cfg.n_layers, num_pages, page_size,
+            cfg.n_kv_heads, cfg.head_dim, max_batch, max_seq,
+        )
+        out_sh = {
+            k: NamedSharding(mesh, PAGED_CACHE_SPECS[k])
+            for k in jax.eval_shape(shape_fn)
+        }
+        return jax.jit(shape_fn, out_shardings=out_sh)()
+
+    from ..backend.engine import PagedKV
+
+    paged_spec = PagedKV(
+        decode_forward=decode_forward,
+        init_pool=init_pool,
+        page_size=page_size,
+        num_pages=num_pages,
+        allocator=allocator,
+    )
+
+    prefix_fns = None
+    if prefix and hasattr(fam, "forward_prefix_pages"):
+        # prefill path: GSPMD over GLOBAL ids (gathers from the sharded
+        # pool; admission-time only, so the collectives amortize)
+        def pages_fwd(p, t, tab, pl, pk, pv, logits_at=None):
+            with pallas_disabled():
+                return fam.forward_prefix_pages(p, cfg, t, tab, pl, pk, pv,
+                                                logits_at=logits_at)
+
+        prefix_fns = (pages_fwd, None)
+
+    chunked_fns = (chunk_forward, init_chunk_fn, merge)
+    return paged_spec, prefix_fns, chunked_fns
+
+
 def build_serving_engine(
     model_name_or_cfg: Any,
     mesh: Optional[Mesh] = None,
@@ -168,12 +357,18 @@ def build_serving_engine(
     max_batch: Optional[int] = None,
     max_seq: int = 1024,
     seed: int = 0,
+    paged: Optional[bool] = None,
+    page_size: int = 16,
+    kv_pool_tokens: Optional[int] = None,
     **engine_kwargs: Any,
 ):
     """One-call multi-chip engine: sharded model + continuous batching.
 
     ``max_batch`` defaults to 8 slots per data shard so every decode step
-    is a full data-parallel batch over ICI (SURVEY §3.4).
+    is a full data-parallel batch over ICI (SURVEY §3.4). ``paged=True``
+    (or SWARMDB_PAGED=1) builds the DP-sharded paged fast path — pool and
+    table sharded over ``data``, prefix caching on — via
+    :func:`build_sharded_paged`; requires a pure-DP mesh.
     """
     from ..backend.engine import Engine
 
@@ -182,11 +377,24 @@ def build_serving_engine(
     sm = build_sharded_model(model_name_or_cfg, mesh, seed=seed)
     if max_batch is None:
         max_batch = 8 * sm.data_size
+    if paged is None:
+        paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
+    if paged and engine_kwargs.get("paged") is None:
+        prefix_on = os.environ.get("SWARMDB_PREFIX", "1") != "0"
+        paged_spec, prefix_fns, paged_chunked = build_sharded_paged(
+            sm, max_batch=max_batch, max_seq=max_seq, page_size=page_size,
+            kv_pool_tokens=kv_pool_tokens, prefix=prefix_on,
+        )
+        engine_kwargs["paged"] = paged_spec
+        if prefix_fns is not None:
+            engine_kwargs.setdefault("prefix_fns", prefix_fns)
+        if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
+            engine_kwargs.setdefault("chunked_fns", paged_chunked)
     # same escape hatch the single-chip path honors (backend/service.py).
     # Never inject the DENSE sharded triple alongside a paged cache: the
     # chunked forward must match the cache layout (a caller wiring paged
     # here supplies its own triple or gets the per-step paged fallback).
-    if (os.environ.get("SWARMDB_CHUNKED", "1") != "0"
+    elif (os.environ.get("SWARMDB_CHUNKED", "1") != "0"
             and engine_kwargs.get("paged") is None):
         engine_kwargs.setdefault("chunked_fns", sm.chunked_fns)
     engine = Engine(
