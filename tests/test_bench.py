@@ -169,6 +169,16 @@ def test_run_all_emits_detail_lines_then_compact_summary(monkeypatch, capsys):
     assert len(json.dumps(summary)) < 1500
 
 
+def test_dpserve_registered_in_all():
+    """dpserve (the DP-scaling A/B) runs in mode=all but never probes the
+    TPU — it is a virtual-CPU-device measurement by design."""
+    assert "dpserve" in bench._MODES
+    assert "dpserve" in bench._ALL_MODES
+    assert "dpserve" not in bench._NEEDS_BACKEND
+    # its scaling ratio surfaces in the compact summary
+    assert ("dpx", "dp_scaling_x") in bench._SUMMARY_KEYS
+
+
 def test_serve_mode_end_to_end_cpu(monkeypatch):
     """The full serve-mode harness (prewarm -> closed window -> open-loop
     latency window) over the tiny model on CPU: contract fields present,
