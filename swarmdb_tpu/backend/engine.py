@@ -652,6 +652,35 @@ class Engine:
                 _prefill_prefix_insert, donate_argnums=(8, 9, 10, 11, 12)
             )
 
+            # ---- dense rolling-KV retirement extraction: copy a retired
+            # slot's lane KV (positions 0..written, page-chunked) into
+            # prefix-pool pages whose custody moves to the caller's
+            # registry. The dense lane is slot-private (unlike the paged
+            # pool, where custody transfer is pure host bookkeeping), so
+            # keeping a conversation's KV across turns costs ONE
+            # bandwidth-bound copy here and one gather at resume — far
+            # cheaper than the full-history prefill it replaces. Padding
+            # rows of target_pages are 0: the trash page absorbs them.
+            lane_maxp = max_seq // prefix_page_size
+
+            def _extract_lane(cache, pool_k, pool_v, slot_id, target_pages):
+                ck, cv = cache
+                L = ck.shape[0]
+                tail_shape = ck.shape[3:]
+                lk = jnp.take(ck, slot_id, axis=1)  # [L, S, Hkv, D]
+                lv = jnp.take(cv, slot_id, axis=1)
+                lk = lk.reshape((L, lane_maxp, prefix_page_size) + tail_shape)
+                lv = lv.reshape((L, lane_maxp, prefix_page_size) + tail_shape)
+                pool_k = pool_k.at[:, target_pages].set(
+                    lk.astype(pool_k.dtype))
+                pool_v = pool_v.at[:, target_pages].set(
+                    lv.astype(pool_v.dtype))
+                return pool_k, pool_v
+
+            self._extract_lane_fused = jax.jit(
+                _extract_lane, donate_argnums=(1, 2)
+            )
+
         self.total_generated = 0
         self.total_requests = 0
 
@@ -810,13 +839,47 @@ class Engine:
 
     def pool_epoch(self) -> int:
         """Epoch stamp for externally-held page ids (rolling-KV registry):
-        the paged allocator's pool generation, bumped by every reset —
-        both restart() and the in-loop error recovery rebuild the pool
-        through it, so holders can't miss an epoch either way. Dense
-        engines key on the restart counter (no page pool to alias)."""
+        the pool's own generation, bumped by every reset — both restart()
+        and the in-loop error recovery rebuild the pool through reset, so
+        holders can't miss an epoch either way. Paged engines stamp the
+        page allocator; dense engines stamp the prefix side pool (its
+        acquire() is where dense rolling custody comes from); engines
+        with neither have no externally-holdable pages."""
         if self.paged:
             return self.paged.allocator.generation
+        if self._prefix is not None:
+            return self._prefix.generation
         return self.metrics.counters["engine_restarts"].value
+
+    # ------------------------------------------------------ rolling-KV hooks
+    # The serving layer's rolling registry holds page custody between
+    # turns; these helpers hide which pool the pages came from (paged main
+    # pool vs the dense prefix side pool).
+
+    def supports_rolling(self) -> bool:
+        if self.paged is not None:
+            return (getattr(self, "_prefill_paged_resume_fused", None)
+                    is not None
+                    and getattr(self.paged.allocator, "n_shards", 1) <= 1)
+        return (self._prefix is not None
+                and getattr(self, "_prefill_prefix_fused", None) is not None)
+
+    def rolling_page_size(self) -> int:
+        return self.paged.page_size if self.paged else self._prefix_ps
+
+    def rolling_free(self, pages) -> None:
+        """Return registry-custody pages to their pool (same-epoch only —
+        the caller checks pool_epoch before calling)."""
+        if self.paged:
+            self.paged.allocator.add_free(list(pages))
+        else:
+            for p in pages:
+                self._prefix.release(p)
+
+    def rolling_free_count(self) -> int:
+        if self.paged:
+            return self.paged.allocator.free_count()
+        return self._prefix.free_count()
 
     def _fresh_cache(self):
         if self.paged:
@@ -1106,20 +1169,20 @@ class Engine:
                 f"(incl. resumed) >= max_seq {self.max_seq}"
             )
         if request.resume_pages is not None:
-            if not self.paged or getattr(
-                    self, "_prefill_paged_resume_fused", None) is None:
-                raise ValueError("resume_pages requires a paged engine "
-                                 "with the prefix machinery enabled")
+            if not self.supports_rolling():
+                raise ValueError("resume_pages requires the rolling-KV "
+                                 "machinery (paged+resume prefill, or a "
+                                 "dense engine with the prefix cache)")
             if self._mh is not None:
                 # currently unreachable (enable_multihost refuses paged
-                # engines), but kept so future pod+paged support cannot
-                # silently desync: resume dispatches are not published
-                # to worker hosts
+                # engines and prefix caching), but kept so future pod
+                # support cannot silently desync: resume dispatches are
+                # not published to worker hosts
                 raise ValueError("rolling-KV resume is not supported in "
                                  "multi-host (pod) mode")
             if not request.resume_pages or request.resume_len <= 0:
                 raise ValueError("resume needs pages and resume_len > 0")
-            ps = self.paged.page_size
+            ps = self.rolling_page_size()
             if len(request.resume_pages) > self._prefix_pp_buckets[-1]:
                 raise ValueError(
                     f"{len(request.resume_pages)} resume pages exceed the "
@@ -1418,7 +1481,22 @@ class Engine:
                             plans[slot_id] = (hits, chains)
                 else:
                     resume_rows = {}
-                    popped = [heapq.heappop(self._queue)[3] for _ in range(take)]
+                    popped = []
+                    for _ in range(take):
+                        if not self._queue:
+                            break
+                        req = self._queue[0][3]
+                        if (req.resume_pages is not None
+                                and req.resume_epoch is not None
+                                and req.resume_epoch != self.pool_epoch()):
+                            # dense rolling resume planned against a pool
+                            # that has since been rebuilt (same race as
+                            # the paged branch above)
+                            heapq.heappop(self._queue)
+                            stale_resumes.append(req)
+                            continue
+                        heapq.heappop(self._queue)
+                        popped.append(req)
                     self._admitting.update(r.request_id for r in popped)
             # outside the lock: fire callbacks / the pressure hook (either
             # may re-enter submit() or take the serving layer's locks)
@@ -1470,6 +1548,13 @@ class Engine:
                     max_suffix_r = max(max_suffix_r, len(req.prompt))
                     max_pages_r = max(max_pages_r, len(req.resume_pages))
                     continue
+                if not self.paged and req.resume_pages is not None:
+                    # dense rolling resume: kept prefix-pool pages compose
+                    # into the lane (no row-table — the lane IS the slot)
+                    resume_batch.append((slot_id, req, None))
+                    max_suffix_r = max(max_suffix_r, len(req.prompt))
+                    max_pages_r = max(max_pages_r, len(req.resume_pages))
+                    continue
                 # sub-page prompts (no hit possible, nothing to register)
                 # stay on the plain path; everything else goes through the
                 # prefix path even on a full miss so its pages get
@@ -1512,7 +1597,9 @@ class Engine:
                 groups[key] = resume_batch
             for (bucket, ppb), batch in groups.items():
                 try:
-                    if ppb < 0:
+                    if ppb < 0 and not self.paged:
+                        self._prefill_dense_resume_batch(batch, bucket, -ppb)
+                    elif ppb < 0:
                         self._prefill_paged_resume_batch(batch, bucket, -ppb)
                     elif ppb > 0 and self.paged:
                         self._prefill_paged_prefix_batch(batch, bucket, ppb)
@@ -1755,15 +1842,16 @@ class Engine:
         self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
         self._activate([(s, r) for s, r, _ in batch], t0)
 
-    def _prefill_prefix_batch(self, batch: List[Tuple], bucket: int,
-                              ppb: int) -> None:
-        """One fused suffix prefill for a group of admissions sharing a
-        (suffix bucket, prefix width) shape: gather reused prefix pages +
-        forward ONLY the suffix + compose/insert each row's KV lane +
-        register the prompt's fresh full pages — one dispatch, pool- and
-        cache-donating. Mirrors ``_prefill_batch``; see
-        ``_prefill_prefix_insert`` in ``__init__``."""
-        t0 = time.time()
+    def _prefix_fused_dispatch(self, rows, bucket: int, ppb: int,
+                               t0: float) -> None:
+        """Shared array build + dispatch for the dense prefix-path
+        prefills (_prefill_prefix_batch and _prefill_dense_resume_batch —
+        the resume path is the registration-free special case: same
+        shapes, same executable, no new compile variants).
+
+        ``rows``: (slot_id, req, suffix_tokens, prefix_len, table_pages,
+        reg_pairs) per admission; ``reg_pairs`` = [(lane_col, pool_page)]
+        to register (empty for resume)."""
         ps = self._prefix_ps
         Bp = self.prefill_batch
         lane_pages = min(ppb + -(-bucket // ps), self.max_seq // ps)
@@ -1776,16 +1864,12 @@ class Engine:
         reg_pages = np.zeros((Bp, RC), np.int32)
         gather = np.zeros(Bp, np.int64)
         scatter = np.full(Bp, self.max_batch, np.int32)
-        reg_records = []
-        acquired: List[int] = []
-        for row, (slot_id, req, hits, chains) in enumerate(batch):
-            prompt = req.prompt
-            p0 = len(hits) * ps
-            suffix = prompt[p0:]
+        for row, (slot_id, req, suffix, plen, tpages, reg_pairs) in \
+                enumerate(rows):
             padded[row, : len(suffix)] = suffix
             lengths[row] = len(suffix)
-            plens[row] = p0
-            table[row, : len(hits)] = hits
+            plens[row] = plen
+            table[row, : len(tpages)] = tpages
             gather[row] = slot_id
             scatter[row] = slot_id
             s = req.sampling
@@ -1793,39 +1877,76 @@ class Engine:
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
             self._set_slot_key(slot_id, s.seed)
-            # register the prompt's fresh FULL pages (their lane content is
-            # final — decode writes start at len(prompt), past them)
+            for r, (page_idx, pid) in enumerate(reg_pairs):
+                reg_cols[row, r] = page_idx
+                reg_pages[row, r] = pid
+        pk, pv = self._prefix_pool
+        (self.cache, self._last_tokens, self._last_lps, pk, pv) = (
+            self._prefill_prefix_fused(
+                self.params, padded, lengths, plens, table, reg_cols,
+                reg_pages, scatter, self.cache, self._last_tokens,
+                self._last_lps, pk, pv,
+                self._base_keys_np[gather],
+                self._temp[gather],
+                self._topk[gather],
+                self._topp[gather],
+            ))
+        self._prefix_pool = (pk, pv)
+        self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
+        self._activate([(r[0], r[1]) for r in rows], t0)
+
+    def _prefill_dense_resume_batch(self, batch, bucket: int,
+                                    ppb: int) -> None:
+        """Dense rolling resume: gather each row's KEPT prefix-pool pages,
+        compose them into the slot lane with a MID-PAGE boundary
+        (compose_prefix_lane / gqa_attention_prefix are token-granular in
+        prefix_lens — no page alignment needed), forward only the suffix,
+        and register NOTHING (reg_cols = -1 routes the registration
+        einsum's writes to the trash page; page custody stays with the
+        caller's registry)."""
+        self._prefix_fused_dispatch(
+            [(slot_id, req, req.prompt, req.resume_len,
+              req.resume_pages, [])
+             for slot_id, req, _none in batch],
+            bucket, ppb, time.time(),
+        )
+
+    def _prefill_prefix_batch(self, batch, bucket: int,
+                              ppb: int) -> None:
+        """One fused suffix prefill for a group of admissions sharing a
+        (suffix bucket, prefix width) shape: gather reused prefix pages +
+        forward ONLY the suffix + compose/insert each row's KV lane +
+        register the prompt's fresh full pages — one dispatch, pool- and
+        cache-donating. Mirrors ``_prefill_batch``; see
+        ``_prefill_prefix_insert`` in ``__init__``."""
+        t0 = time.time()
+        ps = self._prefix_ps
+        rows = []
+        reg_records = []
+        acquired = []
+        for slot_id, req, hits, chains in batch:
+            prompt = req.prompt
+            p0 = len(hits) * ps
+            # register the prompt's fresh FULL pages (their lane content
+            # is final — decode writes start at len(prompt), past them)
             n_full = len(prompt) // ps
             new_idx = list(range(len(hits), n_full))
             ids = self._prefix.acquire(len(new_idx)) if new_idx else []
             acquired.extend(ids)
-            for r, (page_idx, pid) in enumerate(zip(new_idx, ids)):
-                reg_cols[row, r] = page_idx
-                reg_pages[row, r] = pid
+            reg_pairs = list(zip(new_idx, ids))
+            for page_idx, pid in reg_pairs:
                 reg_records.append(
                     (chains[page_idx],
                      tuple(prompt[page_idx * ps:(page_idx + 1) * ps]), pid))
-        pk, pv = self._prefix_pool
+            rows.append((slot_id, req, prompt[p0:], p0, hits, reg_pairs))
         try:
-            (self.cache, self._last_tokens, self._last_lps, pk, pv) = (
-                self._prefill_prefix_fused(
-                    self.params, padded, lengths, plens, table, reg_cols,
-                    reg_pages, scatter, self.cache, self._last_tokens,
-                    self._last_lps, pk, pv,
-                    self._base_keys_np[gather],
-                    self._temp[gather],
-                    self._topk[gather],
-                    self._topp[gather],
-                ))
+            self._prefix_fused_dispatch(rows, bucket, ppb, t0)
         except Exception:
             for pid in acquired:
                 self._prefix.release(pid)
             raise
-        self._prefix_pool = (pk, pv)
         for rec in reg_records:
             self._prefix.register(*rec)
-        self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
-        self._activate([(s, r) for s, r, _, _ in batch], t0)
 
     def _prefill_batch(self, batch: List[Tuple[int, GenRequest]]) -> None:
         """One compiled prefill for up to ``prefill_batch`` admissions.
@@ -2097,6 +2218,17 @@ class Engine:
                 # after this point, so any in-flight chunk's reads (issued
                 # earlier) complete first — device program order
                 self._prefix.unpin(pins)
+        elif (req is not None and req.keep_pages
+              and reason in ("length", "eos")
+              and getattr(self, "_extract_lane_fused", None) is not None):
+            # clean finishes only: failure retirements (_fail_all during
+            # error recovery) run BEFORE the donated cache/pool buffers
+            # are rebuilt, and a device dispatch here would raise on the
+            # deleted arrays and kill the recovery itself
+            try:
+                self._dense_keep_extract(slot_id, slot, req)
+            except Exception:
+                logger.exception("dense keep extraction failed")
         self.metrics.counters["engine_completed"].inc()
         self.metrics.rates["requests_completed"].mark()
         if req is not None:
@@ -2108,6 +2240,59 @@ class Engine:
                 req.on_done(req.request_id, list(slot.generated), reason)
             except Exception:
                 logger.exception("on_done callback failed")
+
+    def _dense_keep_extract(self, slot_id: int, slot: _Slot,
+                            req: GenRequest) -> None:
+        """Dense rolling-KV retirement (see _extract_lane in __init__):
+        copy the lane's written KV into acquired prefix-pool pages and
+        hand custody to on_pages. The last page may be PARTIAL (written
+        is mid-page); its tail bytes are stale lane garbage, masked at
+        resume by prefix_lens=written. On pool shortage the turn simply
+        doesn't roll: no on_pages, the caller's registry keeps its
+        previous state (whose pages we then must NOT release)."""
+        ps = self._prefix_ps
+        written = slot.position
+        start = req.resume_len + len(req.prompt)
+        tail = list(slot.generated[max(0, written - start):])
+        n = -(-written // ps) if written > 0 else 0
+        if not (0 < n <= self._prefix_pp_buckets[-1]):
+            return
+        pages: List[int] = self._prefix.acquire(n)
+        if len(pages) != n and self.on_pool_pressure is not None:
+            # pool full of parked conversations: let the serving layer
+            # LRU-evict idle rolling state, then retry once (the dense
+            # counterpart of the paged admission pressure hook)
+            for p in pages:
+                self._prefix.release(p)
+            try:
+                self.on_pool_pressure(n)
+            except Exception:
+                logger.exception("pool-pressure callback failed")
+            pages = self._prefix.acquire(n)
+        if len(pages) != n:
+            for p in pages:
+                self._prefix.release(p)
+            return
+        target = np.zeros(self.max_seq // ps, np.int32)
+        target[: n] = pages
+        pk, pv = self._prefix_pool
+        pk, pv = self._extract_lane_fused(
+            self.cache, pk, pv, np.int32(slot_id), target)
+        self._prefix_pool = (pk, pv)
+        if req.resume_pages:
+            # the resumed turn's SOURCE pages are superseded by this
+            # fresh extraction (dense copies — unlike paged, the new set
+            # does not include them); their last reads (resume prefill +
+            # this extraction's gather... which reads the LANE, not them)
+            # were dispatched earlier, so re-acquisition can only be
+            # written after those reads in device program order
+            for p in req.resume_pages:
+                self._prefix.release(p)
+        if req.on_pages is not None:
+            try:
+                req.on_pages(req.request_id, pages, written, tail)
+            except Exception:
+                logger.exception("on_pages callback failed")
 
     def _fail_all(self, reason: str) -> None:
         for i, s in enumerate(self.slots):

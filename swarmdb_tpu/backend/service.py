@@ -172,6 +172,11 @@ class ServingService:
         # rather than a re-tokenization of its text.
         self._rolling: Optional[Dict[Tuple[str, str], Dict[str, Any]]] = None
         self._rolling_lock = threading.Lock()
+        # EMA of per-turn suffix length (tokens), sizing the restart
+        # reserve (see _rolling_plan / serve_message keep-trim). Seeded
+        # relative to the window: an absolute seed larger than a small
+        # window's budget would size the reserve before any evidence
+        self._rolling_delta_ema = min(64.0, engine.max_seq / 8.0)
         rolling_wanted = os.environ.get("SWARMDB_ROLLING_KV") == "1"
         if (rolling_wanted and self.engine.paged is not None
                 and getattr(self.engine.paged.allocator,
@@ -183,13 +188,15 @@ class ServingService:
             logger.warning("SWARMDB_ROLLING_KV=1 ignored: rolling resume "
                            "is not supported on a DP-sharded page pool")
             rolling_wanted = False
-        if (rolling_wanted and self.engine.paged is not None
-                and getattr(self.engine, "_prefill_paged_resume_fused",
-                            None) is not None):
+        if rolling_wanted and self.engine.supports_rolling():
+            # paged engines resume by page-custody transfer; DENSE engines
+            # roll too (round 5): retirement copies the lane KV into
+            # prefix-pool pages (Engine._dense_keep_extract), resume
+            # composes them back mid-page (_prefill_dense_resume_batch)
             self._rolling = {}
-            # low-memory hook (ADVICE r4 #1): when paged admission cannot
-            # allocate and the engine is otherwise idle, evict idle
-            # conversations' kept pages instead of stalling forever —
+            # low-memory hook (ADVICE r4 #1): when paged admission (or a
+            # dense retirement extraction) cannot allocate, evict idle
+            # conversations' kept pages instead of stalling/not rolling —
             # non-rolling traffic must never starve behind parked KV
             self.engine.on_pool_pressure = self._on_pool_pressure
 
@@ -463,20 +470,20 @@ class ServingService:
             self._rolling_evict(need)
 
     def _rolling_evict(self, need_free: int) -> None:
-        """LRU-evict idle conversations until the allocator can cover
+        """LRU-evict idle conversations until the pool can cover
         ``need_free`` pages (caller holds _rolling_lock)."""
-        alloc = self.engine.paged.allocator
+        eng = self.engine
         epoch = self._rolling_epoch()
         idle = sorted(
             (k for k, st in self._rolling.items()
              if not st.get("in_flight") and st.get("pages")),
             key=lambda k: self._rolling[k]["last"])
         for k in idle:
-            if alloc.free_count() >= need_free:
+            if eng.rolling_free_count() >= need_free:
                 break
             st = self._rolling.pop(k)
             if st["epoch"] == epoch:
-                alloc.add_free(st["pages"])
+                eng.rolling_free(st["pages"])
             self.db.metrics.counters["rolling_evictions"].inc()
 
     def _rolling_plan(self, key, msg: Message, sampling: SamplingParams,
@@ -493,7 +500,7 @@ class ServingService:
             on_pages overwrite would leak.
         """
         eng = self.engine
-        ps = eng.paged.page_size
+        ps = eng.rolling_page_size()
         if eng._mh is not None:
             # currently unreachable (pod mode refuses paged engines);
             # future-proofing: resume dispatches are not published to
@@ -521,6 +528,13 @@ class ServingService:
                            "msg_count": 0, "reply_ids": [],
                            "pending_count": pre_count,
                            "epoch": epoch, "in_flight": True,
+                           # cleared by _rolling_store; if still set at
+                           # finalize, the turn's KV was never adopted
+                           # (dense extraction bailed) and the state must
+                           # restart — keeping it would exclude the reply
+                           # BY ID from future suffixes while its tokens
+                           # exist in neither the KV nor the prompt
+                           "await_store": True,
                            "last": time.time()}
             if st is None or not st.get("pages"):
                 self._rolling[key] = placeholder
@@ -533,8 +547,11 @@ class ServingService:
             if not any(m.id == msg.id for m in delta):
                 # registry out of sync with the stream (e.g. snapshot
                 # restore): restart the conversation fresh
+                logger.debug("rolling restart %s: msg %s not in delta "
+                             "(msg_count=%d total=%d)", key, msg.id,
+                             st["msg_count"], total)
                 if st["epoch"] == epoch:
-                    eng.paged.allocator.add_free(st["pages"])
+                    eng.rolling_free(st["pages"])
                 self._rolling[key] = placeholder
                 self.db.metrics.counters["rolling_restarts"].inc()
                 return "keep", None, None
@@ -557,24 +574,52 @@ class ServingService:
             )
             if not fits:
                 # conversation outgrew the window: restart fresh (the
-                # caller's trimmed prompt) and release the kept pages
+                # caller's trimmed prompt) and release the kept pages.
+                # The delta EMA must update HERE too: in a restart-locked
+                # regime resumes never happen, so an EMA fed only by
+                # resumes could never grow the reserve that breaks the
+                # lock
+                self._rolling_delta_ema = (0.8 * self._rolling_delta_ema
+                                           + 0.2 * len(ptoks))
+                logger.debug("rolling restart %s: doesn't fit (len=%d "
+                             "ptoks=%d max_new=%d max_seq=%d)", key,
+                             st["len"], len(ptoks),
+                             sampling.max_new_tokens, eng.max_seq)
                 if st["epoch"] == epoch:
-                    eng.paged.allocator.add_free(st["pages"])
+                    eng.rolling_free(st["pages"])
                 self._rolling[key] = placeholder
                 self.db.metrics.counters["rolling_restarts"].inc()
                 return "keep", None, None
-            # pool headroom: only the FRESH pages beyond the kept ones
-            # are allocated at admission — evicting to the full footprint
-            # would destroy other conversations' kept KV for nothing
-            need = (-(-(st["len"] + len(ptoks) + sampling.max_new_tokens
-                        + eng.decode_chunk) // ps)
-                    - len(st["pages"]))
+            # pool headroom. Paged: only the FRESH pages beyond the kept
+            # ones are allocated at admission (kept pages are referenced
+            # in place) — evicting to the full footprint would destroy
+            # other conversations' kept KV for nothing. DENSE: retirement
+            # extraction acquires the FULL new page set while the kept
+            # pages are still held (they are released only after the
+            # copy), so the full footprint must be provisioned or the
+            # extraction bails at retirement — and the pressure hook
+            # cannot evict THIS conversation (in_flight) to cover it
+            total_pages = -(-(st["len"] + len(ptoks)
+                              + sampling.max_new_tokens
+                              + eng.decode_chunk) // ps)
+            need = (total_pages - len(st["pages"]) if eng.paged
+                    else total_pages)
             if need > 0:
                 self._rolling_evict(need)
             st["in_flight"] = True
             st["pending_count"] = total
+            st["await_store"] = True  # see placeholder comment
             st["last"] = time.time()
             self.db.metrics.counters["rolling_resumes"].inc()
+            # typical per-turn suffix size (EMA): sizes the restart
+            # reserve in serve_message so a restarted conversation always
+            # has room for a few turns before the next overflow — a fixed
+            # restart fraction can land the kept length EXACTLY at
+            # max_seq minus one turn, locking the conversation into a
+            # restart-every-turn loop (measured: 12:1 restarts:resumes on
+            # the serve mix at S=256 with ~105-token turn deltas)
+            self._rolling_delta_ema = (0.8 * self._rolling_delta_ema
+                                       + 0.2 * len(ptoks))
             # the observed epoch travels WITH the plan: submit/admission
             # re-validate it against the live pool generation, so a pool
             # reset in the plan->admit window fails the request instead
@@ -613,7 +658,8 @@ class ServingService:
             st = self._rolling.get(key)
             if st is None:
                 return
-            if reason in ("length", "eos") and st.get("pages"):
+            if (reason in ("length", "eos") and st.get("pages")
+                    and not st.get("await_store")):
                 rid = (msg.metadata or {}).get("reply_id")
                 if rid:
                     # only replies at stream index >= msg_count matter
@@ -623,10 +669,17 @@ class ServingService:
                 st["in_flight"] = False
                 st["last"] = time.time()
             else:
+                # non-clean finish, or a clean finish whose KV was never
+                # adopted (await_store still set: dense extraction
+                # bailed) — drop the state so the next turn rebuilds the
+                # prompt from the full window instead of excluding a
+                # reply that exists in neither the KV nor the suffix
+                if st.get("await_store") and reason in ("length", "eos"):
+                    self.db.metrics.counters["rolling_restarts"].inc()
                 self._rolling.pop(key, None)
                 if (st.get("pages")
                         and st["epoch"] == self._rolling_epoch()):
-                    self.engine.paged.allocator.add_free(st["pages"])
+                    self.engine.rolling_free(st["pages"])
 
     # ------------------------------------------------------------- serving
 
@@ -718,9 +771,23 @@ class ServingService:
                     # turn instead of rolling (measured: restarts 3:1 over
                     # resumes with a full-budget restart). StreamingLLM-style
                     # half-window restart; anchor-stable trimming is moot —
-                    # subsequent turns resume by identity, not hash match
+                    # subsequent turns resume by identity, not hash match.
+                    # The fixed fraction is additionally capped by an
+                    # ADAPTIVE reserve of ~2.5 typical turn deltas: at
+                    # small windows / large turns, half the window can sit
+                    # within one delta of max_seq and lock the
+                    # conversation into restarting every turn (measured:
+                    # 12:1 restarts:resumes at S=256 with ~105-token
+                    # deltas). The fraction stays the UPPER bound; a
+                    # quarter-window floor keeps some history even when
+                    # the measured deltas say the window fits barely one
+                    # turn
                     frac = _env_float("SWARMDB_ROLL_RESTART", 0.5)
-                    budget = max(16, int(budget * min(0.9, max(0.1, frac))))
+                    reserve = (int(2.5 * self._rolling_delta_ema)
+                               + self.engine.decode_chunk)
+                    budget = max(16, min(
+                        int(budget * min(0.9, max(0.1, frac))),
+                        max(budget // 4, budget - reserve)))
                     if len(prompt) > budget:
                         prompt = prompt[-budget:]
                 elif len(prompt) > budget:

@@ -93,6 +93,14 @@ class PrefixLRU:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # pool generation (managed-free mode): bumped by reset(). Pages
+        # held OUTSIDE the table (the serving layer's dense rolling-KV
+        # registry acquires custody via acquire()) are only valid within
+        # the generation they were taken in — reset() rebuilds the free
+        # list, so a stale holder releasing or resuming them would alias
+        # a later occupant's pages (same contract as
+        # ops.paged_kv.PageAllocator.generation).
+        self.generation = 0
 
     # ---------------------------------------------------------------- lookup
 
@@ -168,10 +176,19 @@ class PrefixLRU:
         """Forget everything (engine restart rebuilds the pool buffers, so
         every cached entry would point at zeroed pages)."""
         with self._lock:
+            # bump BEFORE rebuilding the free list: a racing epoch check
+            # must never observe (old generation, rebuilt pool)
+            self.generation += 1
             self._free = (list(range(self.num_pages - 1, 0, -1))
                           if self._manage_free else [])
             self._entries.clear()
             self._pins.clear()
+
+    def free_count(self) -> int:
+        """Managed-free mode: pages immediately takeable without eviction
+        (the dense rolling registry's headroom probe)."""
+        with self._lock:
+            return len(self._free)
 
     def register(self, chain: bytes, tokens: Tuple[int, ...],
                  page_id: int) -> bool:

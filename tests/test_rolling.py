@@ -487,3 +487,198 @@ def test_pool_pressure_evicts_idle_rolling(monkeypatch):
         finally:
             svc.stop()
             db.close()
+
+
+# ------------------------------------------------- dense rolling KV (r5)
+
+
+def _mk_dense_engine(params, pool_pages=64, start=True):
+    """DENSE engine (no paged pool) with the prefix machinery — the dense
+    rolling path: retirement extracts the lane into prefix-pool pages,
+    resume composes them back mid-page."""
+    cfg = TINY_DEBUG
+    eng = Engine(
+        lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+        lambda b, s: llama.init_kv_cache(cfg, b, s),
+        params, max_batch=BATCH, max_seq=MAX_SEQ, eos_id=-1, seed=0,
+        prefill_buckets=[16, 32, 64], decode_chunk=4,
+        chunked_fns=(
+            lambda p, t, pos, c, hkv, s: llama.forward_chunked(
+                p, cfg, t, pos, c, hkv, s),
+            lambda b, k: llama.init_chunk_kv(cfg, b, k),
+            llama.merge_chunk,
+        ),
+        prefix_fns=(
+            lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+                llama.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
+                                          lp, logits_at=logits_at),
+            lambda n, ps: llama.init_prefix_pool(cfg, n, ps),
+        ),
+        prefix_pages=pool_pages,
+        prefix_page_size=PS,
+    )
+    if start:
+        eng.start()
+    return eng
+
+
+def test_dense_resume_matches_fresh_full_prefill(params):
+    """Dense rolling parity: a resumed turn (kept pool pages + suffix-only
+    prefill, mid-page boundary) generates exactly the tokens a fresh
+    dense engine produces over the full concatenated history."""
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(3, TINY_DEBUG.vocab_size, size=21).tolist()
+    new2 = rng.integers(3, TINY_DEBUG.vocab_size, size=9).tolist()
+    new3 = rng.integers(3, TINY_DEBUG.vocab_size, size=5).tolist()
+
+    eng = _mk_dense_engine(params)
+    try:
+        assert eng.supports_rolling() and not eng.paged
+        g1, pages, written, tail = _gen_keep(eng, p1, 7)
+        assert written + len(tail) == len(p1) + len(g1)
+        assert len(pages) == -(-written // PS)
+        # written is mid-page in general — the boundary under test
+        g2, pages2, written2, tail2 = _gen_keep(
+            eng, tail + new2, 6, resume=(pages, written))
+        g3, *_ = _gen_keep(eng, tail2 + new3, 5, resume=(pages2, written2))
+    finally:
+        eng.stop()
+
+    ref = _mk_dense_engine(params)
+    try:
+        r2, *_ = _gen_keep(ref, p1 + g1 + new2, 6)
+    finally:
+        ref.stop()
+    assert g2 == r2, (g2, r2)
+
+    ref3 = _mk_dense_engine(params)
+    try:
+        r3, *_ = _gen_keep(ref3, p1 + g1 + new2 + g2 + new3, 5)
+    finally:
+        ref3.stop()
+    assert g3 == r3, (g3, r3)
+
+
+def test_dense_resume_frees_superseded_pages(params):
+    """Dense retirement extracts a FRESH page set; the resumed turn's
+    source pages must return to the pool (custody balance)."""
+    rng = np.random.default_rng(11)
+    p1 = rng.integers(3, TINY_DEBUG.vocab_size, size=17).tolist()
+    eng = _mk_dense_engine(params)
+    try:
+        free0 = eng._prefix.free_count()
+        g1, pages, written, tail = _gen_keep(eng, p1, 5)
+        # unlike paged, a dense keep turn ALSO hash-registers its prompt
+        # pages (copies — no custody conflict); account for them
+        cached1 = eng._prefix.stats()["cached_pages"]
+        assert eng._prefix.free_count() == free0 - len(pages) - cached1
+        g2, pages2, written2, _ = _gen_keep(
+            eng, tail + [9, 9, 9], 5, resume=(pages, written))
+        # old kept pages released at retirement, new extraction held
+        cached2 = eng._prefix.stats()["cached_pages"]
+        assert eng._prefix.free_count() == free0 - len(pages2) - cached2
+        eng.rolling_free(pages2)
+        assert eng._prefix.free_count() == free0 - cached2
+    finally:
+        eng.stop()
+
+
+def test_dense_service_rolling_conversation(monkeypatch):
+    """End-to-end dense rolling serve: consecutive turns resume the
+    extracted pages on the DEFAULT (non-paged) engine."""
+    import tempfile
+    import time as _time
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.backend.service import ServingService
+
+    monkeypatch.setenv("SWARMDB_ROLLING_KV", "1")
+    monkeypatch.delenv("SWARMDB_PAGED", raising=False)
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        db.register_agent("u")
+        db.register_agent("bot")
+        db.assign_llm_backend("bot", "b0")
+        svc = ServingService.from_model_name(
+            db, "tiny-debug", backend_id="b0", max_batch=2, max_seq=128,
+            decode_chunk=4, paged=False, page_size=8)
+        assert svc.engine.paged is None
+        assert svc._rolling is not None, "dense rolling must enable"
+        svc.start(warmup=False)
+        try:
+            for turn in range(8):
+                db.send_message("u", "bot", f"turn {turn} hello",
+                                metadata={"generation": {
+                                    "max_new_tokens": 4,
+                                    "temperature": 0.0}})
+                deadline = _time.time() + 90
+                got = False
+                while _time.time() < deadline and not got:
+                    for m in db.receive_messages("u", timeout=0.5):
+                        got = got or m.sender_id == "bot"
+                assert got, f"no reply at turn {turn}"
+            resumes = db.metrics.counters["rolling_resumes"].value
+            assert resumes >= 4, resumes
+            st = next(iter(svc._rolling.values()))
+            assert st["pages"] and not st["in_flight"]
+        finally:
+            svc.stop()
+            db.close()
+
+
+def test_dense_pool_pressure_evicts_idle_rolling(monkeypatch):
+    """Dense counterpart of the paged pressure test: when retirement
+    extraction cannot acquire pages because idle conversations hold the
+    pool, the pressure hook evicts them and the extraction retries."""
+    import tempfile
+    import time as _time
+
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.backend.service import ServingService
+
+    monkeypatch.setenv("SWARMDB_ROLLING_KV", "1")
+    monkeypatch.delenv("SWARMDB_PAGED", raising=False)
+    # pool of 8 usable pages (SWARMDB_PREFIX_TOKENS = 64, ps 8): one
+    # conversation's kept state (~5 pages) + a second's extraction
+    # cannot both fit
+    monkeypatch.setenv("SWARMDB_PREFIX_TOKENS", "64")
+    with tempfile.TemporaryDirectory() as d:
+        db = SwarmDB(broker=LocalBroker(), save_dir=d)
+        for a in ("u1", "u2", "bot"):
+            db.register_agent(a)
+        db.assign_llm_backend("bot", "b0")
+        svc = ServingService.from_model_name(
+            db, "tiny-debug", backend_id="b0", max_batch=1, max_seq=64,
+            decode_chunk=4, paged=False, page_size=8)
+        svc.start(warmup=False)
+        try:
+            meta = {"generation": {"max_new_tokens": 4, "temperature": 0.0}}
+            db.send_message("u1", "bot", "hello " * 12, metadata=dict(meta))
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                st = svc._rolling.get(("u1", "bot"))
+                if (st is not None and st.get("pages")
+                        and not st.get("in_flight")):
+                    break
+                _time.sleep(0.05)
+            else:
+                raise AssertionError("turn 1 never parked pages")
+            db.send_message("u2", "bot", "world " * 14,
+                            metadata={"generation": {"max_new_tokens": 16,
+                                                     "temperature": 0.0}})
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                st2 = svc._rolling.get(("u2", "bot"))
+                if (st2 is not None and st2.get("pages")
+                        and not st2.get("in_flight")):
+                    break
+                _time.sleep(0.05)
+            else:
+                raise AssertionError("second conversation never rolled")
+            assert db.metrics.counters["rolling_evictions"].value >= 1
+            assert ("u1", "bot") not in svc._rolling
+        finally:
+            svc.stop()
+            db.close()
