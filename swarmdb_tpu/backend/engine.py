@@ -321,6 +321,7 @@ class Engine:
                 new_cache = merge_chunk(cache, chunk_kv, positions)
                 all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
                 all_lps = jnp.concatenate([last_lps[None], lps], axis=0)
+                all_toks, all_lps = self._replicate_block(all_toks, all_lps)
                 return all_toks, all_lps, last, lps[-1], new_cache
 
             def body(carry, _):
@@ -341,6 +342,7 @@ class Engine:
             # never seen); rows 1..K = this chunk's samples
             all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
             all_lps = jnp.concatenate([last_lps[None], lps], axis=0)
+            all_toks, all_lps = self._replicate_block(all_toks, all_lps)
             return all_toks, all_lps, last, lps[-1], cache
 
         self._decode = jax.jit(
@@ -726,6 +728,9 @@ class Engine:
         from jax.sharding import NamedSharding, PartitionSpec
 
         rep = NamedSharding(mesh, PartitionSpec())
+        # decode-chunk token blocks must come back replicated (see
+        # _replicate_block) — set BEFORE the first decode call traces
+        self._out_rep = rep
         B = self.max_batch
         self._last_tokens = jax.jit(
             lambda: jnp.zeros((B,), jnp.int32), out_shardings=rep)()
@@ -736,26 +741,30 @@ class Engine:
         self._base_keys_np = np.array(
             jax.device_get(self.base_keys))
         self._default_keys_np = self._base_keys_np.copy()
+        if self._prefix is not None and not self.paged:
+            # the dense prefix side pool was built process-local in
+            # __init__; a jit over a global mesh cannot mix it with the
+            # global cache — rematerialize it (zeros) on the mesh,
+            # replicated (every shard reads any page via the lane gather)
+            self._prefix_pool = jax.jit(
+                lambda: self._prefix_init_pool(self._prefix.num_pages,
+                                               self._prefix_ps),
+                out_shardings=rep)()
 
     def enable_multihost(self) -> None:
         """Publish every device call to worker hosts (coordinator side).
 
         Requires ``jax.distributed.initialize`` to have run and the
         engine's params/cache to live on a global mesh; see
-        ``parallel/multihost.py`` and ``Engine.worker_loop``. The paged
-        cache path has host-side allocator state that is not mirrored yet
-        and is refused."""
-        if self.paged:
-            raise NotImplementedError(
-                "multi-host serving currently supports the dense cache "
-                "path only (the page allocator is coordinator-local)"
-            )
-        if self._prefix is not None:
-            raise NotImplementedError(
-                "multi-host serving does not support prefix caching yet "
-                "(the prefix pool/table is coordinator-local); build the "
-                "engine with prefix_fns=None"
-            )
+        ``parallel/multihost.py`` and ``Engine.worker_loop``. Paged and
+        prefix-cached engines are supported (VERDICT r4 #6): their
+        allocator / prefix-table state stays coordinator-local — it only
+        COMPUTES the numpy arguments (page rows, gather tables,
+        registration columns) of device calls, and every device call is
+        published through the generic mirrored-call channel, so worker
+        pool state evolves identically. Rolling-KV resume remains refused
+        in pod mode at the serving layer (page custody cannot survive a
+        pod restart)."""
         from ..parallel.multihost import ControlPlane
 
         self._mh = ControlPlane(self.max_batch, self.prefill_batch)
@@ -795,6 +804,101 @@ class Engine:
                         self._last_tokens, self._last_lps, keys, temp, topk,
                         topp,
                     )
+            elif op == mh.OP_CALL:
+                call_id, call_args = args[0], args[1:]
+                self._MH_CALLS[call_id](self, *call_args)
+
+    # Generic mirrored device calls (paged / prefix paths). Each handler
+    # consumes ONLY numpy arguments + device state (params, cache, fed
+    # tokens, prefix pool) — never the coordinator-local allocator or
+    # prefix table — so replaying it on a worker host with the published
+    # arguments reproduces the coordinator's device state exactly.
+    CALL_PAGED_PREFILL = 0
+    CALL_PAGED_PREFIX_PREFILL = 1
+    CALL_PAGED_RESUME_PREFILL = 2
+    CALL_SET_PT_ROWS = 3
+    CALL_DENSE_PREFIX_PREFILL = 4
+
+    def _replicate_block(self, all_toks, all_lps):
+        """Constrain the chunk's sampled-token block to REPLICATED when the
+        engine lives on a mesh (``place_state`` sets ``_out_rep``): the
+        shard_map'd paged decode leaves it data-sharded, which a pod
+        coordinator cannot device_get (the shards span other processes).
+        The all-gather this inserts moves [K+1, B] ints — bytes, not
+        bandwidth. Traced at first call, AFTER place_state; single-chip
+        engines (no mesh) see None and compile unchanged."""
+        rep = getattr(self, "_out_rep", None)
+        if rep is None:
+            return all_toks, all_lps
+        return (jax.lax.with_sharding_constraint(all_toks, rep),
+                jax.lax.with_sharding_constraint(all_lps, rep))
+
+    def _mirrored(self, call_id: int, *args) -> None:
+        """Publish (pod mode) then execute one mirrored device call.
+        Publish FIRST, matching the decode/prefill pattern: if the local
+        execution raises, the pod is already failing loudly through the
+        decode loop's fatal-stop path."""
+        if self._mh is not None:
+            self._mh.publish_call(call_id, args)
+        self._MH_CALLS[call_id](self, *args)
+
+    def _call_paged_prefill(self, tokens, lengths, target, scatter, keys,
+                            temp, topk, topp) -> None:
+        k_pool, v_pool, self._last_tokens, self._last_lps = \
+            self._prefill_paged_fused(
+                self.params, tokens, lengths, target, scatter,
+                self.cache["k"], self.cache["v"], self._last_tokens,
+                self._last_lps, keys, temp, topk, topp,
+            )
+        self.cache = self._paged_cache_with(k_pool, v_pool)
+
+    def _call_paged_prefix_prefill(self, tokens, lengths, plens, table,
+                                   target, scatter, keys, temp, topk,
+                                   topp) -> None:
+        pk, pv, self._last_tokens, self._last_lps = \
+            self._prefill_paged_prefix_fused(
+                self.params, tokens, lengths, plens, table, target, scatter,
+                self.cache["k"], self.cache["v"], self._last_tokens,
+                self._last_lps, keys, temp, topk, topp,
+            )
+        self.cache = self._paged_cache_with(pk, pv)
+
+    def _call_paged_resume_prefill(self, tokens, lengths, rlens, table,
+                                   row_tables, scatter, keys, temp, topk,
+                                   topp) -> None:
+        pk, pv, self._last_tokens, self._last_lps = \
+            self._prefill_paged_resume_fused(
+                self.params, tokens, lengths, rlens, table, row_tables,
+                scatter, self.cache["k"], self.cache["v"],
+                self._last_tokens, self._last_lps, keys, temp, topk, topp,
+            )
+        self.cache = self._paged_cache_with(pk, pv)
+
+    def _call_set_pt_rows(self, rows, vals) -> None:
+        from ..ops.paged_kv import set_page_table_rows
+
+        self.cache["page_table"] = set_page_table_rows(
+            self.cache["page_table"], rows, vals)
+
+    def _call_dense_prefix_prefill(self, tokens, lengths, plens, table,
+                                   reg_cols, reg_pages, scatter, keys,
+                                   temp, topk, topp) -> None:
+        pk, pv = self._prefix_pool
+        (self.cache, self._last_tokens, self._last_lps, pk, pv) = (
+            self._prefill_prefix_fused(
+                self.params, tokens, lengths, plens, table, reg_cols,
+                reg_pages, scatter, self.cache, self._last_tokens,
+                self._last_lps, pk, pv, keys, temp, topk, topp,
+            ))
+        self._prefix_pool = (pk, pv)
+
+    _MH_CALLS = {
+        CALL_PAGED_PREFILL: _call_paged_prefill,
+        CALL_PAGED_PREFIX_PREFILL: _call_paged_prefix_prefill,
+        CALL_PAGED_RESUME_PREFILL: _call_paged_resume_prefill,
+        CALL_SET_PT_ROWS: _call_set_pt_rows,
+        CALL_DENSE_PREFIX_PREFILL: _call_dense_prefix_prefill,
+    }
 
     def restart(self) -> None:
         """Recover from a fatal engine death (SURVEY §5.3 failure
@@ -957,14 +1061,11 @@ class Engine:
                 # fed-token rows scatter to max_batch (dropped)
                 chunks = -(-bucket // self.paged.page_size)
                 drop = np.full(Bp, self.max_batch, np.int32)
-                (k_pool, v_pool, self._last_tokens,
-                 self._last_lps) = self._prefill_paged_fused(
-                    self.params, tokens, lengths,
-                    np.zeros((Bp, chunks), np.int32), drop,
-                    self.cache["k"], self.cache["v"], self._last_tokens,
-                    self._last_lps, keys, zero_f, zero_i, ones_f,
+                self._mirrored(
+                    self.CALL_PAGED_PREFILL, tokens, lengths,
+                    np.zeros((Bp, chunks), np.int32), drop, keys, zero_f,
+                    zero_i, ones_f,
                 )
-                self.cache = self._paged_cache_with(k_pool, v_pool)
             else:
                 drop = np.full(Bp, self.max_batch, np.int32)
                 if self._mh is not None:
@@ -986,52 +1087,36 @@ class Engine:
                     tokens = np.full((Bp, bucket), self.pad_id, np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        pk, pv = self.cache["k"], self.cache["v"]
-                        pk, pv, self._last_tokens, self._last_lps = (
-                            self._prefill_paged_prefix_fused(
-                                self.params, tokens, lengths,
-                                np.zeros(Bp, np.int32),
-                                np.zeros((Bp, ppb), np.int32),
-                                np.zeros((Bp, chunks), np.int32),
-                                drop, pk, pv, self._last_tokens,
-                                self._last_lps, keys, zero_f, zero_i,
-                                ones_f,
-                            ))
-                        self.cache = self._paged_cache_with(pk, pv)
+                        self._mirrored(
+                            self.CALL_PAGED_PREFIX_PREFILL, tokens,
+                            lengths, np.zeros(Bp, np.int32),
+                            np.zeros((Bp, ppb), np.int32),
+                            np.zeros((Bp, chunks), np.int32), drop, keys,
+                            zero_f, zero_i, ones_f,
+                        )
                         if self._warm_resume():
                             # rolling-KV resume variants (gated: each is a
                             # 30-90 s compile on the tunneled service and
                             # only SWARMDB_ROLLING_KV deployments hit them)
                             maxp = self.paged.allocator.maxp
-                            pk, pv = self.cache["k"], self.cache["v"]
-                            (pk, pv, self._last_tokens,
-                             self._last_lps) = self._prefill_paged_resume_fused(
-                                self.params, tokens, lengths,
-                                np.zeros(Bp, np.int32),
+                            self._mirrored(
+                                self.CALL_PAGED_RESUME_PREFILL, tokens,
+                                lengths, np.zeros(Bp, np.int32),
                                 np.zeros((Bp, ppb), np.int32),
-                                np.zeros((Bp, maxp), np.int32),
-                                drop, pk, pv, self._last_tokens,
-                                self._last_lps, keys, zero_f, zero_i,
-                                ones_f,
+                                np.zeros((Bp, maxp), np.int32), drop,
+                                keys, zero_f, zero_i, ones_f,
                             )
-                            self.cache = self._paged_cache_with(pk, pv)
                         continue
                     lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                      self.max_seq // self._prefix_ps)
-                    pk, pv = self._prefix_pool
-                    (self.cache, self._last_tokens, self._last_lps,
-                     pk, pv) = (
-                        self._prefill_prefix_fused(
-                            self.params, tokens, lengths,
-                            np.zeros(Bp, np.int32),
-                            np.zeros((Bp, ppb), np.int32),
-                            np.full((Bp, lane_pages), -1, np.int32),
-                            np.zeros((Bp, lane_pages), np.int32),
-                            drop, self.cache, self._last_tokens,
-                            self._last_lps, pk, pv,
-                            keys, zero_f, zero_i, ones_f,
-                        ))
-                    self._prefix_pool = (pk, pv)
+                    self._mirrored(
+                        self.CALL_DENSE_PREFIX_PREFILL, tokens, lengths,
+                        np.zeros(Bp, np.int32),
+                        np.zeros((Bp, ppb), np.int32),
+                        np.full((Bp, lane_pages), -1, np.int32),
+                        np.zeros((Bp, lane_pages), np.int32),
+                        drop, keys, zero_f, zero_i, ones_f,
+                    )
         jax.block_until_ready(self._last_tokens)
         dt = time.time() - t0
         self.metrics.latencies["warmup_s"].observe(dt)
@@ -1174,10 +1259,11 @@ class Engine:
                                  "machinery (paged+resume prefill, or a "
                                  "dense engine with the prefix cache)")
             if self._mh is not None:
-                # currently unreachable (enable_multihost refuses paged
-                # engines and prefix caching), but kept so future pod
-                # support cannot silently desync: resume dispatches are
-                # not published to worker hosts
+                # pod mode mirrors the resume DISPATCH fine (CALL_PAGED_
+                # RESUME_PREFILL), but page custody lives in the serving
+                # layer's registry, and a pod failure recovers by process
+                # restart — which silently orphans/aliases every resumed
+                # page id. Refuse until registry state is pod-durable.
                 raise ValueError("rolling-KV resume is not supported in "
                                  "multi-host (pod) mode")
             if not request.resume_pages or request.resume_len <= 0:
@@ -1376,10 +1462,17 @@ class Engine:
         """
         if self.paged:
             # reclaim retired slots' pages first: zero their table rows on
-            # device, THEN return pages to the pool (stale-table/reuse race)
-            self.cache["page_table"] = self.paged.allocator.flush_frees(
-                self.cache["page_table"]
-            )
+            # device (mirrored to pod workers), THEN return pages to the
+            # pool (stale-table/reuse race)
+            pending = self.paged.allocator.take_pending_frees()
+            if pending:
+                self._mirrored(
+                    self.CALL_SET_PT_ROWS,
+                    np.asarray(pending, np.int32),
+                    np.zeros((len(pending), self.paged.allocator.maxp),
+                             np.int32),
+                )
+                self.paged.allocator.release_taken(pending)
         pressure_called = False
         while True:
             stale_resumes: List[GenRequest] = []
@@ -1401,7 +1494,7 @@ class Engine:
                     popped = []
                     rows = []
                     plans: Dict[int, Tuple] = {}
-                    use_pp = self._prefix is not None and self._mh is None
+                    use_pp = self._prefix is not None
                     resume_rows: Dict[int, np.ndarray] = {}
                     for slot_id in free[:take]:
                         if not self._queue:
@@ -1524,14 +1617,12 @@ class Engine:
                     continue  # stale pops may have unblocked the queue head
                 return
             if self.paged and rows:
-                from ..ops.paged_kv import set_page_table_rows
-
-                self.cache["page_table"] = set_page_table_rows(
-                    self.cache["page_table"],
+                self._mirrored(
+                    self.CALL_SET_PT_ROWS,
                     np.asarray([r[0] for r in rows], np.int32),
-                    np.stack([r[1] for r in rows]),
+                    np.stack([r[1] for r in rows]).astype(np.int32),
                 )
-            use_prefix = self._prefix is not None and self._mh is None
+            use_prefix = self._prefix is not None
             groups: Dict[Tuple[int, int], List[Tuple]] = {}
             prefix_batch: List[Tuple] = []
             resume_batch: List[Tuple] = []
@@ -1773,17 +1864,11 @@ class Engine:
                     (slot_id, chains[page_idx],
                      tuple(prompt[page_idx * ps:(page_idx + 1) * ps]),
                      fresh[f]))
-        pk, pv = self.cache["k"], self.cache["v"]
-        pk, pv, self._last_tokens, self._last_lps = \
-            self._prefill_paged_prefix_fused(
-                self.params, padded, lengths, plens, table, target, scatter,
-                pk, pv, self._last_tokens, self._last_lps,
-                self._base_keys_np[gather],
-                self._temp[gather],
-                self._topk[gather],
-                self._topp[gather],
-            )
-        self.cache = self._paged_cache_with(pk, pv)
+        self._mirrored(
+            self.CALL_PAGED_PREFIX_PREFILL, padded, lengths, plens, table,
+            target, scatter, self._base_keys_np[gather],
+            self._temp[gather], self._topk[gather], self._topp[gather],
+        )
         pins: Dict[int, List[int]] = {}
         for slot_id, chain, toks, page_id in reg_records:
             if self._prefix.register(chain, toks, page_id):
@@ -1828,17 +1913,11 @@ class Engine:
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
             self._set_slot_key(slot_id, s.seed)
-        pk, pv = self.cache["k"], self.cache["v"]
-        pk, pv, self._last_tokens, self._last_lps = \
-            self._prefill_paged_resume_fused(
-                self.params, padded, lengths, rlens, table, row_tables,
-                scatter, pk, pv, self._last_tokens, self._last_lps,
-                self._base_keys_np[gather],
-                self._temp[gather],
-                self._topk[gather],
-                self._topp[gather],
-            )
-        self.cache = self._paged_cache_with(pk, pv)
+        self._mirrored(
+            self.CALL_PAGED_RESUME_PREFILL, padded, lengths, rlens, table,
+            row_tables, scatter, self._base_keys_np[gather],
+            self._temp[gather], self._topk[gather], self._topp[gather],
+        )
         self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
         self._activate([(s, r) for s, r, _ in batch], t0)
 
@@ -1880,18 +1959,11 @@ class Engine:
             for r, (page_idx, pid) in enumerate(reg_pairs):
                 reg_cols[row, r] = page_idx
                 reg_pages[row, r] = pid
-        pk, pv = self._prefix_pool
-        (self.cache, self._last_tokens, self._last_lps, pk, pv) = (
-            self._prefill_prefix_fused(
-                self.params, padded, lengths, plens, table, reg_cols,
-                reg_pages, scatter, self.cache, self._last_tokens,
-                self._last_lps, pk, pv,
-                self._base_keys_np[gather],
-                self._temp[gather],
-                self._topk[gather],
-                self._topp[gather],
-            ))
-        self._prefix_pool = (pk, pv)
+        self._mirrored(
+            self.CALL_DENSE_PREFIX_PREFILL, padded, lengths, plens, table,
+            reg_cols, reg_pages, scatter, self._base_keys_np[gather],
+            self._temp[gather], self._topk[gather], self._topp[gather],
+        )
         self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
         self._activate([(r[0], r[1]) for r in rows], t0)
 
@@ -2019,23 +2091,13 @@ class Engine:
             pages = self.paged.allocator.pages_for(int(gather[row]))
             m = min(len(pages), chunks)
             target[row, :m] = pages[:m]
-        k_pool, v_pool, self._last_tokens, self._last_lps = \
-            self._prefill_paged_fused(
-                self.params,
-                padded,                  # raw np: transfer rides the dispatch
-                lengths,
-                target,
-                scatter,                 # padding rows -> max_batch, dropped
-                self.cache["k"],
-                self.cache["v"],
-                self._last_tokens,
-                self._last_lps,
-                self._base_keys_np[gather],
-                self._temp[gather],
-                self._topk[gather],
-                self._topp[gather],
-            )
-        self.cache = self._paged_cache_with(k_pool, v_pool)
+        # padding rows -> max_batch, dropped; raw np args: the transfer
+        # rides the dispatch (and, pod mode, the publish to workers)
+        self._mirrored(
+            self.CALL_PAGED_PREFILL, padded, lengths, target, scatter,
+            self._base_keys_np[gather], self._temp[gather],
+            self._topk[gather], self._topp[gather],
+        )
         self._activate(batch, t0)
 
     def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:

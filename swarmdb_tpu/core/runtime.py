@@ -94,18 +94,36 @@ class SwarmDB:
         self.token_counter = token_counter
         self.metrics = metrics or MetricsRegistry()
 
-        # Single-node broker: replication happens at the fsync group-commit
-        # level, not across broker replicas. Accepting replication_factor > 1
-        # and silently ignoring it would misrepresent the durability class a
-        # DELIVERED report implies, so reject it loudly.
+        # replication_factor > 1 = the reference's Kafka acks=all durability
+        # class (` main.py:118,196-197`): a DELIVERED report survives the
+        # loss of a broker node. The in-tree equivalent is segment-log
+        # replication to follower hosts (broker/replica.py): factor N needs
+        # N-1 follower endpoints in SWARMDB_REPLICA_TARGETS ("host:port,
+        # host:port", each running `python -m swarmdb_tpu.broker.replica`).
+        # Accepting the factor WITHOUT the followers and silently running
+        # single-node would misrepresent what DELIVERED implies — reject.
+        replica_targets: List[str] = []
         if self.config.replication_factor > 1:
-            raise ValueError(
-                "replication_factor > 1 is not supported by the in-tree "
-                "single-node broker (durability = group-commit fsync; see "
-                "broker/cpp/broker.cpp). Use replication_factor=1."
-            )
+            replica_targets = [
+                t.strip()
+                for t in os.environ.get("SWARMDB_REPLICA_TARGETS", "").split(",")
+                if t.strip()
+            ]
+            if len(replica_targets) < self.config.replication_factor - 1:
+                raise ValueError(
+                    f"replication_factor={self.config.replication_factor} "
+                    f"needs {self.config.replication_factor - 1} follower "
+                    "endpoints in SWARMDB_REPLICA_TARGETS (found "
+                    f"{len(replica_targets)}); run followers with `python "
+                    "-m swarmdb_tpu.broker.replica` or use "
+                    "replication_factor=1 (single-node group-commit fsync)."
+                )
 
         self.broker: Broker = broker if broker is not None else _default_broker(self.config)
+        if replica_targets:
+            from ..broker.replica import ReplicatedBroker
+
+            self.broker = ReplicatedBroker(self.broker, replica_targets)
         self.producer = Producer(self.broker)
         self._ensure_topics_exist()
 

@@ -347,23 +347,36 @@ class PageAllocator:
             if slot_id in self._by_slot:
                 self._pending_free.append(slot_id)
 
-    def flush_frees(self, page_table: jnp.ndarray) -> jnp.ndarray:
-        """Zero retired slots' table rows on device, then free their pages.
-        Call at the START of each admission round."""
+    def take_pending_frees(self) -> List[int]:
+        """Drain the retired-slot queue WITHOUT freeing pages yet — the
+        caller zeroes the slots' table rows on device first (possibly
+        mirroring that update to pod workers), then calls
+        :meth:`release_taken`. Split out of flush_frees so the engine can
+        route the device update through its multihost mirror."""
         with self._lock:
             pending, self._pending_free = self._pending_free, []
-        if not pending:
-            return page_table
-        rows = np.asarray(pending, np.int32)
-        zeros = np.zeros((len(pending), self.maxp), np.int32)
-        page_table = set_page_table_rows(page_table, rows, zeros)
-        # free only after the zeroing update is enqueued: the device order
-        # (zero row -> later writes by a new owner) is program order
+        return pending
+
+    def release_taken(self, pending: List[int]) -> None:
+        """Free the pages of slots drained by take_pending_frees — only
+        AFTER their table-row zeroing is enqueued on device: the device
+        order (zero row -> later writes by a new owner) is program order."""
         with self._lock:
             for slot_id in pending:
                 sp = self._by_slot.pop(slot_id, None)
                 if sp is not None:
                     self._give(list(reversed(sp.pages)))
+
+    def flush_frees(self, page_table: jnp.ndarray) -> jnp.ndarray:
+        """Zero retired slots' table rows on device, then free their pages.
+        Call at the START of each admission round."""
+        pending = self.take_pending_frees()
+        if not pending:
+            return page_table
+        rows = np.asarray(pending, np.int32)
+        zeros = np.zeros((len(pending), self.maxp), np.int32)
+        page_table = set_page_table_rows(page_table, rows, zeros)
+        self.release_taken(pending)
         return page_table
 
     # -- DP-sharding hooks (no-ops for the single-pool allocator) ------------

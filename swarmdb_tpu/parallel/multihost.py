@@ -43,6 +43,7 @@ logger = logging.getLogger("swarmdb_tpu.multihost")
 OP_STOP = 0
 OP_DECODE = 1
 OP_PREFILL = 2
+OP_CALL = 3  # generic mirrored device call (paged/prefix paths)
 
 # decode variant codes (header slot 1): index into Engine's variant table
 VARIANT_FULL = 0
@@ -50,6 +51,17 @@ VARIANT_FAST = 1
 VARIANT_GREEDY = 2
 
 _HEADER_LEN = 4  # [op, a, b, c] — fixed shape so workers can always recv
+
+# OP_CALL argument wire format: broadcast_one_to_all needs every process
+# to supply a matching pytree of matching shapes/dtypes, but the generic
+# calls (paged prefill target tables, page-table row updates, prefix
+# registration columns) have shapes that vary per wave. So OP_CALL ships a
+# fixed-width descriptor matrix first — [nargs, 2 + _MAX_NDIM] of
+# (dtype code, ndim, dims...) — from which the workers build the zero
+# pytree for the payload broadcast.
+_MAX_NDIM = 4
+_DTYPE_BY_CODE = [np.int32, np.int64, np.float32, np.uint32]
+_CODE_BY_DTYPE = {np.dtype(d): i for i, d in enumerate(_DTYPE_BY_CODE)}
 
 
 def _broadcast(payload):
@@ -86,6 +98,28 @@ class ControlPlane:
                     temp.astype(np.float32), topk.astype(np.int32),
                     topp.astype(np.float32)))
 
+    def publish_call(self, call_id: int, args) -> None:
+        """Publish a generic mirrored device call: the worker looks up
+        ``call_id`` in the Engine's call table and applies it to its own
+        (identically evolved) device state. Arguments must be numpy
+        arrays of the dtypes in ``_DTYPE_BY_CODE``."""
+        arrs = [np.asarray(a) for a in args]
+        for a in arrs:
+            if a.ndim > _MAX_NDIM:
+                raise ValueError(f"mirrored call arg ndim {a.ndim} > "
+                                 f"{_MAX_NDIM}")
+            if a.dtype not in _CODE_BY_DTYPE:
+                raise ValueError(f"mirrored call arg dtype {a.dtype} "
+                                 "not wire-encodable")
+        _broadcast(np.asarray([OP_CALL, call_id, len(arrs), 0], np.int64))
+        desc = np.zeros((len(arrs), 2 + _MAX_NDIM), np.int64)
+        for i, a in enumerate(arrs):
+            desc[i, 0] = _CODE_BY_DTYPE[a.dtype]
+            desc[i, 1] = a.ndim
+            desc[i, 2:2 + a.ndim] = a.shape
+        _broadcast(desc)
+        _broadcast(tuple(arrs))
+
     def publish_stop(self) -> None:
         _broadcast(np.asarray([OP_STOP, 0, 0, 0], np.int64))
 
@@ -114,4 +148,15 @@ class ControlPlane:
                 np.zeros(Bp, np.float32),
             ))
             return op, [np.asarray(a) for a in args]
+        if op == OP_CALL:
+            call_id, nargs = int(header[1]), int(header[2])
+            desc = np.asarray(_broadcast(
+                np.zeros((nargs, 2 + _MAX_NDIM), np.int64)))
+            zeros = tuple(
+                np.zeros(tuple(int(x) for x in d[2:2 + int(d[1])]),
+                         _DTYPE_BY_CODE[int(d[0])])
+                for d in desc
+            )
+            args = _broadcast(zeros)
+            return op, [call_id, *[np.asarray(a) for a in args]]
         raise ValueError(f"unknown control op {op}")

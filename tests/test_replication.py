@@ -1,0 +1,253 @@
+"""Cross-host segment-log replication (VERDICT r4 missing #1 / next-step
+#8): acks=all over a follower connection — a DELIVERED report must imply
+the record survives the loss of a broker node.
+
+Reference durability class: Kafka replication_factor
+(`/root/reference/swarmdb/ main.py:118`) + acks=all (` main.py:196-197`).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from swarmdb_tpu.broker.native import NativeBroker, native_available
+from swarmdb_tpu.broker.replica import (ReplicatedBroker, ReplicaServer,
+                                        Replicator)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native broker lib not built")
+
+
+def _mk_pair(tmp_path):
+    leader_raw = NativeBroker(log_dir=str(tmp_path / "leader"),
+                              sync_interval_ms=1)
+    follower = NativeBroker(log_dir=str(tmp_path / "follower"),
+                            sync_interval_ms=1)
+    server = ReplicaServer(follower).start()
+    leader = ReplicatedBroker(leader_raw, [f"127.0.0.1:{server.port}"])
+    return leader, follower, server
+
+
+def test_replicates_log_and_gates_delivery(tmp_path):
+    leader, follower, server = _mk_pair(tmp_path)
+    try:
+        leader.create_topic("t", 2)
+        offs = [leader.append("t", i % 2, f"m{i}".encode(),
+                              key=f"k{i}".encode()) for i in range(40)]
+        for part in (0, 1):
+            end = leader.end_offset("t", part)
+            assert leader.wait_durable("t", part, end - 1, timeout_s=10), \
+                "replicated durability did not advance"
+            # the follower's log is record-identical
+            mine = leader.fetch("t", part, 0, 100)
+            theirs = follower.fetch("t", part, 0, 100)
+            assert [(r.offset, r.key, r.value) for r in mine] == \
+                   [(r.offset, r.key, r.value) for r in theirs]
+            assert leader.durable_offset("t", part) == end
+        assert len(offs) == 40
+    finally:
+        leader.close()
+        server.stop()
+        follower.close()
+
+
+def test_delivery_stalls_without_follower(tmp_path):
+    """acks=all back-pressure: an unreachable follower freezes the
+    replicated watermark even though the local fsync advanced."""
+    raw = NativeBroker(log_dir=str(tmp_path / "leader"), sync_interval_ms=1)
+    leader = ReplicatedBroker(raw, ["127.0.0.1:1"])  # nothing listens
+    try:
+        leader.create_topic("t", 1)
+        leader.append("t", 0, b"v")
+        assert raw.wait_durable("t", 0, 0, timeout_s=5)  # local fsync fine
+        assert not leader.wait_durable("t", 0, 0, timeout_s=0.3)
+        assert leader.durable_offset("t", 0) == 0
+    finally:
+        leader.close()
+
+
+def test_follower_catches_up_after_late_start(tmp_path):
+    """Records appended before the follower exists (or while it is down)
+    replicate on (re)connect — the leader streams from the follower's
+    reported end offset."""
+    raw = NativeBroker(log_dir=str(tmp_path / "leader"), sync_interval_ms=1)
+    follower = NativeBroker(log_dir=str(tmp_path / "follower"),
+                            sync_interval_ms=1)
+    server = ReplicaServer(follower)  # NOT started yet
+    leader = ReplicatedBroker(raw, [f"127.0.0.1:{server.port}"])
+    try:
+        leader.create_topic("t", 1)
+        for i in range(10):
+            leader.append("t", 0, f"early{i}".encode())
+        assert not leader.wait_durable("t", 0, 9, timeout_s=0.3)
+        server.start()
+        assert leader.wait_durable("t", 0, 9, timeout_s=10)
+        assert [r.value for r in follower.fetch("t", 0, 0, 100)] == \
+               [f"early{i}".encode() for i in range(10)]
+    finally:
+        leader.close()
+        server.stop()
+        follower.close()
+
+
+def test_delivered_survives_leader_loss(tmp_path):
+    """THE durability claim: after wait_durable returns, destroying the
+    leader's entire log directory loses nothing — a fresh broker over the
+    follower's directory serves every acked record. Follower runs as a
+    real `python -m swarmdb_tpu.broker.replica` subprocess (the
+    deployment shape)."""
+    fdir = str(tmp_path / "follower")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "swarmdb_tpu.broker.replica",
+         "--log-dir", fdir, "--listen", "127.0.0.1:0",
+         "--sync-interval-ms", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("REPLICA_READY "), line
+        addr = line.split()[1].strip()
+        ldir = tmp_path / "leader"
+        raw = NativeBroker(log_dir=str(ldir), sync_interval_ms=1)
+        leader = ReplicatedBroker(raw, [addr])
+        leader.create_topic("t", 1)
+        for i in range(25):
+            leader.append("t", 0, f"precious{i}".encode())
+        assert leader.wait_durable("t", 0, 24, timeout_s=15)
+        leader.close()
+        shutil.rmtree(ldir)  # the node is gone
+    finally:
+        proc.kill()
+        proc.wait()
+    recovered = NativeBroker(log_dir=fdir)
+    try:
+        vals = [r.value for r in recovered.fetch("t", 0, 0, 100)]
+        assert vals == [f"precious{i}".encode() for i in range(25)]
+    finally:
+        recovered.close()
+
+
+def test_runtime_wiring(tmp_path, monkeypatch):
+    """SwarmDB accepts replication_factor > 1 iff follower endpoints are
+    configured; DELIVERED then rides the replicated watermark."""
+    from swarmdb_tpu.core.messages import BrokerConfig, MessageStatus
+    from swarmdb_tpu.core.runtime import SwarmDB
+
+    cfg = BrokerConfig(replication_factor=2)
+
+    monkeypatch.delenv("SWARMDB_REPLICA_TARGETS", raising=False)
+    with pytest.raises(ValueError, match="SWARMDB_REPLICA_TARGETS"):
+        SwarmDB(config=cfg, broker=NativeBroker(
+            log_dir=str(tmp_path / "refused"), sync_interval_ms=1),
+            save_dir=str(tmp_path / "h0"))
+
+    follower = NativeBroker(log_dir=str(tmp_path / "follower"),
+                            sync_interval_ms=1)
+    server = ReplicaServer(follower).start()
+    monkeypatch.setenv("SWARMDB_REPLICA_TARGETS",
+                       f"127.0.0.1:{server.port}")
+    db = SwarmDB(config=cfg, broker=NativeBroker(
+        log_dir=str(tmp_path / "leader"), sync_interval_ms=1),
+        save_dir=str(tmp_path / "h1"))
+    try:
+        db.register_agent("a")
+        db.register_agent("b")
+        mid = db.send_message("a", "b", "replicated hello")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if db.messages[mid].status == MessageStatus.DELIVERED:
+                break
+            time.sleep(0.02)
+        assert db.messages[mid].status == MessageStatus.DELIVERED
+        # the payload is on the follower's disk
+        found = []
+        for name, meta in follower.list_topics().items():
+            for p in range(meta.num_partitions):
+                found += [r.value for r in follower.fetch(name, p, 0, 1000)]
+        assert any(b"replicated hello" in v for v in found)
+    finally:
+        db.close()
+        server.stop()
+        follower.close()
+
+
+def test_leader_restart_acks_idle_partitions(tmp_path):
+    """After a leader restart the new Replicator starts with an empty
+    acked map; the follower must ack its full local fsync watermark even
+    for partitions receiving no new records, or DELIVERED stalls on
+    already-mirrored data (review r5 #2)."""
+    follower = NativeBroker(log_dir=str(tmp_path / "follower"),
+                            sync_interval_ms=1)
+    server = ReplicaServer(follower).start()
+    target = f"127.0.0.1:{server.port}"
+    leader1 = ReplicatedBroker(
+        NativeBroker(log_dir=str(tmp_path / "leader"), sync_interval_ms=1),
+        [target])
+    try:
+        leader1.create_topic("t", 1)
+        leader1.append("t", 0, b"old")
+        assert leader1.wait_durable("t", 0, 0, timeout_s=10)
+    finally:
+        leader1.close()
+    # leader process "restarts": fresh wrapper over the same log dir
+    leader2 = ReplicatedBroker(
+        NativeBroker(log_dir=str(tmp_path / "leader"), sync_interval_ms=1),
+        [target])
+    try:
+        # no new records — the old one must still report durable
+        assert leader2.wait_durable("t", 0, 0, timeout_s=10), \
+            "idle mirrored partition never re-acked after leader restart"
+    finally:
+        leader2.close()
+        server.stop()
+        follower.close()
+
+
+def test_wiped_follower_clamps_watermark_and_resyncs(tmp_path):
+    """A follower that lost its disk reports end 0 on reconnect; the
+    leader must clamp its stale acked watermark (no false DELIVERED) and
+    re-stream from 0 (review r5 #3)."""
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    f1 = NativeBroker(log_dir=str(tmp_path / "f"), sync_interval_ms=1)
+    srv1 = ReplicaServer(f1, port=port).start()
+    leader = ReplicatedBroker(
+        NativeBroker(log_dir=str(tmp_path / "leader"), sync_interval_ms=1),
+        [f"127.0.0.1:{port}"])
+    try:
+        leader.create_topic("t", 1)
+        for i in range(10):
+            leader.append("t", 0, f"m{i}".encode())
+        assert leader.wait_durable("t", 0, 9, timeout_s=10)
+        # follower dies and loses its disk
+        srv1.stop()
+        f1.close()
+        shutil.rmtree(tmp_path / "f")
+        f2 = NativeBroker(log_dir=str(tmp_path / "f"), sync_interval_ms=1)
+        srv2 = ReplicaServer(f2, port=port).start()
+        try:
+            # the idle leader must DETECT the drop (recv_acks EOF -> dead),
+            # reconnect, clamp its stale watermark to the empty hello, and
+            # re-stream the whole log. Durability of a NEW record implies
+            # the clamp happened on the new connection; the content check
+            # proves the old records were re-mirrored, not just re-acked.
+            off = leader.append("t", 0, b"post-wipe")
+            assert leader.wait_durable("t", 0, off, timeout_s=20)
+            vals = [r.value for r in f2.fetch("t", 0, 0, 100)]
+            assert vals == [f"m{i}".encode() for i in range(10)] + \
+                [b"post-wipe"]
+        finally:
+            srv2.stop()
+            f2.close()
+    finally:
+        leader.close()
