@@ -1043,6 +1043,7 @@ def _run_all() -> None:
     forced = _env("SWARMDB_BENCH_PLATFORM", "auto")
     probe_timeout = _env("SWARMDB_BENCH_PROBE_TIMEOUT", 120.0)
     tpu_ok = False  # once a probe succeeds, stop re-probing
+    probe_failed = False  # after one failure, later re-probes go short
 
     for m in _ALL_MODES:
         remaining = deadline - time.time()
@@ -1059,11 +1060,18 @@ def _run_all() -> None:
             else:
                 # RE-probe before every backend mode (VERDICT r4 #1a): a
                 # tunnel that flaps on ~hour timescales can come back at
-                # any point in this multi-thousand-second run
-                probe = probe_backend(min(probe_timeout, remaining / 3))
+                # any point in this multi-thousand-second run. A LIVE
+                # tunnel answers in ~15 s, so after the first failure the
+                # re-probes shrink to 45 s — recovery is still caught but
+                # a dead tunnel costs minutes, not half the budget
+                # (today's all-CPU fallback burned 120 s x 4 modes).
+                budget = probe_timeout if not probe_failed else min(
+                    probe_timeout, 45.0)
+                probe = probe_backend(min(budget, remaining / 3))
                 if probe["ok"]:
                     tpu_ok, platform = True, "tpu"
                 else:
+                    probe_failed = True
                     platform, tpu_error = "cpu", probe["error"]
         child_limit = min(base_limit, max(90.0, remaining - 60.0))
         results[m] = _run_mode_subprocess(m, platform, child_limit, tpu_error)

@@ -1284,6 +1284,13 @@ class Engine:
                     "these pages were planned (engine restart); the "
                     "conversation must restart fresh"
                 )
+        if request.keep_pages and self._mh is not None:
+            # the dense keep-retirement extraction (_extract_lane_fused)
+            # is not a mirrored call, so it would silently desync worker
+            # prefix pools; and kept custody is useless in a pod anyway
+            # (resume is refused above). Refuse symmetrically (review r5).
+            raise ValueError("rolling-KV keep_pages is not supported in "
+                             "multi-host (pod) mode")
         if self.paged:
             need = self.paged.allocator.pages_needed(
                 len(request.prompt), request.sampling.max_new_tokens,
@@ -2319,11 +2326,24 @@ class Engine:
         n = -(-written // ps) if written > 0 else 0
         if not (0 < n <= self._prefix_pp_buckets[-1]):
             return
+        # escalation ladder for the page budget: plain acquire ->
+        # self-reuse (release the superseded SOURCE pages first: their
+        # last reads — the resume prefill; this extraction gathers the
+        # LANE, not them — were dispatched earlier, so any re-acquirer's
+        # writes land after those reads in device program order; without
+        # this a resumed conversation needs 2x its footprint live during
+        # extraction and rolls starve at half-pool occupancy) ->
+        # pressure hook (LRU-evict parked conversations)
+        released_source = False
         pages: List[int] = self._prefix.acquire(n)
+        if len(pages) != n and req.resume_pages:
+            for p in pages:
+                self._prefix.release(p)
+            for p in req.resume_pages:
+                self._prefix.release(p)
+            released_source = True
+            pages = self._prefix.acquire(n)
         if len(pages) != n and self.on_pool_pressure is not None:
-            # pool full of parked conversations: let the serving layer
-            # LRU-evict idle rolling state, then retry once (the dense
-            # counterpart of the paged admission pressure hook)
             for p in pages:
                 self._prefix.release(p)
             try:
@@ -2334,20 +2354,41 @@ class Engine:
         if len(pages) != n:
             for p in pages:
                 self._prefix.release(p)
+            if released_source and req.on_pages is not None:
+                # the registry's kept state now references freed pages —
+                # hand it an EMPTY state (the serving layer treats
+                # pages=[] as restart-next-turn) instead of leaving
+                # dangling ids behind
+                try:
+                    req.on_pages(req.request_id, [], 0, [])
+                except Exception:
+                    logger.exception("on_pages callback failed")
             return
         target = np.zeros(self.max_seq // ps, np.int32)
         target[: n] = pages
         pk, pv = self._prefix_pool
-        pk, pv = self._extract_lane_fused(
-            self.cache, pk, pv, np.int32(slot_id), target)
+        try:
+            pk, pv = self._extract_lane_fused(
+                self.cache, pk, pv, np.int32(slot_id), target)
+        except Exception:
+            # dispatch failed: nothing read `pages` on device — return
+            # them. If the source pages were already self-reuse-released
+            # above, the registry still references freed ids: hand it an
+            # empty state (review r5 #2: letting _rolling_finalize free
+            # st["pages"] AGAIN would put duplicates on the free list —
+            # two conversations acquiring the same page)
+            for p in pages:
+                self._prefix.release(p)
+            if released_source and req.on_pages is not None:
+                try:
+                    req.on_pages(req.request_id, [], 0, [])
+                except Exception:
+                    logger.exception("on_pages callback failed")
+            raise
         self._prefix_pool = (pk, pv)
-        if req.resume_pages:
-            # the resumed turn's SOURCE pages are superseded by this
-            # fresh extraction (dense copies — unlike paged, the new set
-            # does not include them); their last reads (resume prefill +
-            # this extraction's gather... which reads the LANE, not them)
-            # were dispatched earlier, so re-acquisition can only be
-            # written after those reads in device program order
+        if req.resume_pages and not released_source:
+            # superseded SOURCE pages (safe for the same program-order
+            # reason as the early release above)
             for p in req.resume_pages:
                 self._prefix.release(p)
         if req.on_pages is not None:

@@ -594,19 +594,29 @@ class ServingService:
             # ones are allocated at admission (kept pages are referenced
             # in place) — evicting to the full footprint would destroy
             # other conversations' kept KV for nothing. DENSE: retirement
-            # extraction acquires the FULL new page set while the kept
-            # pages are still held (they are released only after the
-            # copy), so the full footprint must be provisioned or the
-            # extraction bails at retirement — and the pressure hook
-            # cannot evict THIS conversation (in_flight) to cover it
+            # extraction wants the FULL new page set; provision it here
+            # when others' idle state can cover it, but shortage is not
+            # fatal — the extraction releases this conversation's own
+            # superseded pages first and reuses them (engine
+            # _dense_keep_extract escalation ladder)
             total_pages = -(-(st["len"] + len(ptoks)
                               + sampling.max_new_tokens
                               + eng.decode_chunk) // ps)
             need = (total_pages - len(st["pages"]) if eng.paged
                     else total_pages)
-            if need > 0:
-                self._rolling_evict(need)
+            # claim THIS conversation before evicting: _rolling_evict
+            # skips in_flight entries, and without the claim a
+            # pool-pressure eviction here could LRU-free the very pages
+            # the plan returns below (review r5: freed pages re-allocated
+            # by a concurrent admission while the resume prefill composes
+            # from them — silent cross-conversation KV aliasing)
             st["in_flight"] = True
+            if need > 0:
+                # shortage after evicting others is survivable downstream:
+                # paged admission break-retries with the pressure hook,
+                # and the dense retirement extraction self-reuses the
+                # conversation's own superseded pages (_dense_keep_extract)
+                self._rolling_evict(need)
             st["pending_count"] = total
             st["await_store"] = True  # see placeholder comment
             st["last"] = time.time()

@@ -318,6 +318,118 @@ def paged_decode_gqa_attention_chunked(
     return out
 
 
+def _dense_chunk_attn_kernel(start_ref, step_ref, q_ref, k_ref, v_ref,
+                             ck_ref, cv_ref, o_ref, acc_ref, m_ref, l_ref,
+                             *, tile: int, n_kv_heads: int, window):
+    """Dense two-segment decode attention (the serve-bench hot path):
+    stream the FROZEN slot cache in [tile]-token blocks, then fold the
+    in-chunk buffer, all under one online softmax. Mirrors
+    `_paged_chunk_attn_kernel` with the page table replaced by the slot's
+    own contiguous lane; dead tiles (>= the slot's chunk start) re-point
+    at the last live tile so their DMA is skipped — HBM traffic scales
+    with each slot's LIVE prefix, which the XLA einsum path (always a
+    full [S] read + materialized fp32 scores) cannot do.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_tiles = pl.num_programs(1) - 1
+    start = start_ref[b]              # frozen prefix length = chunk start
+    step = step_ref[0]
+    Hkv = n_kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when((j < n_tiles) & (j * tile < start))
+    def _cache():
+        pos = j * tile + jax.lax.broadcasted_iota(
+            jnp.int32, (1, tile), 1)
+        valid = pos < start
+        if window is not None:
+            valid &= pos > (start + step - window)
+        _attend_tile(q_ref, k_ref, v_ref, valid, Hkv, acc_ref, m_ref, l_ref)
+
+    @pl.when(j == n_tiles)
+    def _chunk():
+        Kc = ck_ref.shape[1]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, Kc), 1)
+        valid = idx <= step
+        if window is not None:
+            valid &= (start + idx) > (start + step - window)
+        _attend_tile(q_ref, ck_ref, cv_ref, valid, Hkv, acc_ref, m_ref,
+                     l_ref)
+
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        Hq, D = q_ref.shape[1], q_ref.shape[2]
+        o_ref[0] = (acc_ref[...] / denom).reshape(Hq, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("window", "tile", "interpret"))
+def decode_gqa_attention_chunked(
+    q: jnp.ndarray,          # [B, Hq, D] one decode query per slot
+    cache_k: jnp.ndarray,    # [B, S, Hkv, D] FROZEN slot cache
+    cache_v: jnp.ndarray,
+    chunk_k: jnp.ndarray,    # [B, Kc, Hkv, D] this chunk's K so far
+    chunk_v: jnp.ndarray,
+    starts: jnp.ndarray,     # [B] int32 frozen prefix length (chunk start)
+    step: jnp.ndarray,       # scalar int32 current step within the chunk
+    window=None,
+    tile: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Dense two-segment decode attention; returns [B, Hq, D] in q.dtype.
+    Requires S % tile == 0 (the dispatch in ops/layers.py checks)."""
+    B, Hq, D = q.shape
+    S, Hkv = cache_k.shape[1], cache_k.shape[2]
+    G = Hq // Hkv
+    n_tiles = S // tile
+    starts = starts.astype(jnp.int32)
+    step_arr = jnp.reshape(step, (1,)).astype(jnp.int32)
+
+    def q_map(b, j, start_ref, step_ref):
+        return (b, 0, 0)
+
+    def kv_map(b, j, start_ref, step_ref):
+        last_live = _last_live_page(start_ref[b], tile)
+        return (b, jnp.minimum(j, last_live), 0, 0)
+
+    def chunk_map(b, j, start_ref, step_ref):
+        return (b, 0, 0, 0)
+
+    def o_map(b, j, start_ref, step_ref):
+        return (b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_tiles + 1),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), q_map),
+            pl.BlockSpec((1, tile, Hkv, D), kv_map),
+            pl.BlockSpec((1, tile, Hkv, D), kv_map),
+            pl.BlockSpec((1, chunk_k.shape[1], Hkv, D), chunk_map),
+            pl.BlockSpec((1, chunk_k.shape[1], Hkv, D), chunk_map),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running max (bcast)
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running denom (bcast)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_dense_chunk_attn_kernel, tile=tile,
+                          n_kv_heads=Hkv, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(starts, step_arr, q, cache_k, cache_v, chunk_k, chunk_v)
+    return out
+
+
 @functools.partial(
     jax.jit, static_argnames=("window", "interpret")
 )

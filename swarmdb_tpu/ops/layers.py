@@ -277,6 +277,22 @@ def gqa_attention_chunked(
     group = Hq // Hkv
     D = q.shape[-1]
 
+    tile = min(S, 256)
+    if _pallas_decode_enabled() and S % tile == 0:
+        # round-4 silicon trace: the two einsums below run at 2.2x their
+        # HBM floor and always read the FULL [S] lane; the kernel streams
+        # tiles under an online softmax and skips the DMA past each
+        # slot's live prefix, so traffic tracks occupancy
+        from .attention_pallas import decode_gqa_attention_chunked
+
+        out = decode_gqa_attention_chunked(
+            q[:, 0], cache_k, cache_v, chunk_k, chunk_v,
+            (q_positions[:, 0] - step).astype(jnp.int32), step,
+            window=window, tile=tile,
+            interpret=jax.default_backend() != "tpu",
+        )
+        return out[:, None]
+
     qg = q.reshape(B, 1, Hkv, group, D)
     s_f = jnp.einsum("btkgd,bskd->bkgts", qg, cache_k,
                      preferred_element_type=jnp.float32)

@@ -89,3 +89,87 @@ def test_full_model_decode_with_pallas(monkeypatch):
     out_logits, _ = llama.forward(params, cfg, tokens, positions, cache)
     np.testing.assert_allclose(np.asarray(out_logits), np.asarray(ref_logits),
                                rtol=2e-2, atol=2e-2)
+
+
+# ---- dense two-segment (chunked) kernel -----------------------------------
+
+
+def _chunk_case(B=4, S=64, Kc=8, Hq=8, Hkv=2, D=16, seed=3):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)).astype(np.float32))
+    ck = jnp.asarray(rng.normal(size=(B, Kc, Hkv, D)).astype(np.float32))
+    cv = jnp.asarray(rng.normal(size=(B, Kc, Hkv, D)).astype(np.float32))
+    starts = jnp.asarray(rng.integers(0, S - Kc, size=B).astype(np.int32))
+    return q, k, v, ck, cv, starts
+
+
+@pytest.mark.parametrize("step_val", [0, 3, 7])
+def test_chunked_matches_einsum_reference(step_val, monkeypatch):
+    from swarmdb_tpu.ops.attention_pallas import decode_gqa_attention_chunked
+    from swarmdb_tpu.ops.layers import gqa_attention_chunked
+
+    # the reference must be the EINSUM path even if the environment
+    # exports SWARMDB_PALLAS=1 (kernel-vs-itself would be vacuous)
+    monkeypatch.setenv("SWARMDB_PALLAS", "0")
+    q, k, v, ck, cv, starts = _chunk_case()
+    step = jnp.int32(step_val)
+    out = decode_gqa_attention_chunked(
+        q, k, v, ck, cv, starts, step, tile=32, interpret=True)
+    ref = gqa_attention_chunked(
+        q[:, None], k, v, ck, cv, (starts + step_val)[:, None], step)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_ignores_dead_cache_and_future_chunk():
+    """Cache entries >= start (previous occupant's garbage) and chunk
+    entries > step must not influence the output."""
+    from swarmdb_tpu.ops.attention_pallas import decode_gqa_attention_chunked
+
+    q, k, v, ck, cv, starts = _chunk_case(seed=4)
+    starts = jnp.full_like(starts, 5)
+    step = jnp.int32(2)
+    out1 = decode_gqa_attention_chunked(
+        q, k, v, ck, cv, starts, step, tile=32, interpret=True)
+    k2 = k.at[:, 5:].set(1e6)
+    v2 = v.at[:, 5:].set(-1e6)
+    ck2 = ck.at[:, 3:].set(1e6)
+    cv2 = cv.at[:, 3:].set(-1e6)
+    out2 = decode_gqa_attention_chunked(
+        q, k2, v2, ck2, cv2, starts, step, tile=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_sliding_window_parity(monkeypatch):
+    from swarmdb_tpu.ops.attention_pallas import decode_gqa_attention_chunked
+    from swarmdb_tpu.ops.layers import gqa_attention_chunked
+
+    monkeypatch.setenv("SWARMDB_PALLAS", "0")
+    q, k, v, ck, cv, starts = _chunk_case(seed=5)
+    step = jnp.int32(4)
+    out = decode_gqa_attention_chunked(
+        q, k, v, ck, cv, starts, step, window=16, tile=32, interpret=True)
+    ref = gqa_attention_chunked(
+        q[:, None], k, v, ck, cv, (starts + 4)[:, None], step,
+        window=16)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_dispatch_env(monkeypatch):
+    """SWARMDB_PALLAS=1 routes gqa_attention_chunked through the kernel
+    (interpret off-TPU) and matches the einsum path exactly enough."""
+    from swarmdb_tpu.ops import layers
+
+    q, k, v, ck, cv, starts = _chunk_case(seed=6)
+    step = jnp.int32(1)
+    qpos = (starts + 1)[:, None]
+    monkeypatch.setenv("SWARMDB_PALLAS", "0")
+    ref = layers.gqa_attention_chunked(q[:, None], k, v, ck, cv, qpos, step)
+    monkeypatch.setenv("SWARMDB_PALLAS", "1")
+    out = layers.gqa_attention_chunked(q[:, None], k, v, ck, cv, qpos, step)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
