@@ -88,6 +88,55 @@ _PAGED_SCRIPT = textwrap.dedent("""
 """)
 
 
+_DENSE_PREFIX_SCRIPT = textwrap.dedent("""
+    import json, sys
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                               process_id=pid)
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.models import llama
+    from swarmdb_tpu.models.configs import TINY_DEBUG
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+
+    # dense sharded engine + the dense prefix side pool (pod mode must
+    # rematerialize the pool ON the mesh — Engine.place_state — and
+    # publish CALL_DENSE_PREFIX_PREFILL for prefix-hit admissions);
+    # prefix_fns wired exactly as ServingService.from_model_name's dense
+    # branch does
+    cfg = TINY_DEBUG
+    prefix_fns = (
+        lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+            llama.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv, lp,
+                                      logits_at=logits_at),
+        lambda n, ps: llama.init_prefix_pool(cfg, n, ps),
+    )
+    engine, sm = build_serving_engine(
+        cfg, mesh=make_mesh(n_devices=2, model=1, expert=1),
+        max_batch=4, max_seq=64, decode_chunk=4, prefill_buckets=[32],
+        prefix_fns=prefix_fns, prefix_page_size=8, prefix_pages=32,
+    )
+    prompt = list(range(1, 21))
+    if pid == 0:
+        engine.enable_multihost()
+        engine.start()
+        toks1, r1 = engine.generate_sync(
+            prompt, SamplingParams(max_new_tokens=5), timeout=180)
+        toks2, r2 = engine.generate_sync(
+            prompt, SamplingParams(max_new_tokens=5), timeout=180)
+        hits = engine.metrics.counters["prefix_reused_tokens"].value
+        engine.stop()
+        print("RESULT " + json.dumps({"t1": toks1, "t2": toks2,
+                                      "r": r1, "hits": int(hits)}),
+              flush=True)
+    else:
+        engine.worker_loop()
+        print("WORKER_DONE", flush=True)
+""")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -205,3 +254,43 @@ def test_two_process_paged_prefix_pod():
     finally:
         engine.stop()
     assert res["t1"] == ref
+
+
+def test_two_process_dense_prefix_pod():
+    """Pod-mode DENSE + prefix-cache serving: the side pool is
+    rematerialized on the global mesh (Engine.place_state) and prefix-hit
+    admissions publish CALL_DENSE_PREFIX_PREFILL; worker stays in
+    lockstep across a miss turn and a hit turn."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _DENSE_PREFIX_SCRIPT, str(pid),
+             str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("dense-prefix pod run deadlocked")
+        outs.append((p.returncode, out, err))
+
+    rc0, out0, err0 = outs[0]
+    rc1, out1, err1 = outs[1]
+    assert rc0 == 0, f"coordinator failed:\n{err0[-2000:]}"
+    assert rc1 == 0, f"worker failed:\n{err1[-2000:]}"
+    assert "WORKER_DONE" in out1
+    line = next(l for l in out0.splitlines() if l.startswith("RESULT "))
+    res = json.loads(line[len("RESULT "):])
+    assert res["t1"] == res["t2"], "pod dense decode must be deterministic"
+    assert res["hits"] > 0, "second turn must hit the dense prefix cache"
+    assert len(res["t1"]) > 0 and res["r"] in ("length", "eos")
