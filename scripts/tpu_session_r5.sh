@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 TPU measurement queue — run when the tunnel answers.
+# Serialized: ONE process owns the chip at a time. Each step tees its
+# record into bench_logs/ so a mid-run tunnel death still leaves
+# committed evidence (VERDICT r4: the round-4 recovery queue landed
+# zero logs; this one writes as it goes).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_logs
+TS=$(date -u +%Y%m%dT%H%M%S)
+log() { echo "[tpu-r5 $(date -u +%H:%M:%S)] $*"; }
+
+probe() {
+  timeout 90 python -c "import jax; d=jax.devices()[0]; print(d.platform)" \
+    2>/dev/null | tail -1
+}
+
+if [ "$(probe)" != "axon" ] && [ "$(probe)" != "tpu" ]; then
+  log "tunnel down; aborting"; exit 1
+fi
+log "tunnel is up"
+
+# 1. merge-formulation race (PROFILE r4 session 2: ~27 ms fixed
+#    overhead — six full-cache copies + the one-hot merge)
+log "step 1: profile_merge race"
+timeout 1800 python scripts/profile_merge.py \
+  2>&1 | tee "bench_logs/profile_merge_${TS}.txt"
+
+# 2. dense-chunked Pallas kernel A/B (new this round; env-gated)
+log "step 2: pallas chunked kernel serve A/B"
+for p in 0 1; do
+  SWARMDB_PALLAS=$p SWARMDB_BENCH_MODE=serve SWARMDB_BENCH_MAX_S=900 \
+    timeout 1000 python bench.py 2>/dev/null | tail -1 \
+    | tee "bench_logs/serve_pallas${p}_${TS}.json"
+done
+
+# 3. full bench (the driver-format record, on silicon)
+log "step 3: bench mode=all"
+SWARMDB_BENCH_MAX_S=900 timeout 5600 python bench.py \
+  2>/dev/null | tee "bench_logs/all_${TS}.jsonl"
+
+# 4. long-context (S=1024 paged + in-place prefix reuse)
+log "step 4: longctx"
+SWARMDB_BENCH_MODE=longctx SWARMDB_BENCH_MAX_S=1200 timeout 1300 \
+  python bench.py 2>/dev/null | tail -1 \
+  | tee "bench_logs/longctx_${TS}.json"
+
+# 5. rolling-KV serve A/B (paged), incl. the r5 self-reuse extraction
+log "step 5: rolling A/B"
+for r in 0 1; do
+  SWARMDB_PAGED=1 SWARMDB_ROLLING_KV=$r SWARMDB_BENCH_MODE=serve \
+    SWARMDB_BENCH_MAX_S=900 timeout 1000 python bench.py 2>/dev/null \
+    | tail -1 | tee "bench_logs/serve_paged_roll${r}_${TS}.json"
+done
+
+log "queue complete; records in bench_logs/"
