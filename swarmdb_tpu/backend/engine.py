@@ -248,6 +248,9 @@ class Engine:
         self._topp = np.ones(max_batch, np.float32)
 
         self._queue: List[Tuple[int, float, int, GenRequest]] = []  # heap
+        # rotates the DP-shard interleave in _free_slot_ids (engine
+        # thread only)
+        self._admit_rr = 0
         # requests popped from the queue but not yet activated into slots
         # (prefill in flight): cancel() can neither find them queued nor
         # active, so it flags them here and _activate retires them at the
@@ -1455,7 +1458,28 @@ class Engine:
         return any(s.active for s in self.slots)
 
     def _free_slot_ids(self) -> List[int]:
-        return [i for i, s in enumerate(self.slots) if not s.active]
+        free = [i for i, s in enumerate(self.slots) if not s.active]
+        if (free and self.paged is not None
+                and getattr(self.paged.allocator, "n_shards", 1) > 1):
+            # DP-sharded pool: id-order admission would pile every light-
+            # load request onto shard 0 (slot->shard affinity binds a
+            # slot's pages to its shard's SUB-pool), exhausting one
+            # sub-pool while the others sit empty. Interleave the free
+            # list across shards — rotated by an admission counter so a
+            # strictly SERIAL stream (slot 0 always free again by the
+            # next admission) also spreads, instead of re-landing every
+            # request and its prefix-cache registrations on shard 0.
+            alloc = self.paged.allocator
+            by_shard: Dict[int, List[int]] = {}
+            for i in free:
+                by_shard.setdefault(alloc.shard_of(i), []).append(i)
+            lanes = list(by_shard.values())
+            rot = self._admit_rr % len(lanes)
+            self._admit_rr += 1
+            lanes = lanes[rot:] + lanes[:rot]
+            free = [lane[k] for k in range(max(map(len, lanes)))
+                    for lane in lanes if k < len(lane)]
+        return free
 
     # ------------------------------------------------------------- admission
 

@@ -200,3 +200,46 @@ def test_graft_entry_single_chip():
         assert logits.shape[0] == args[1].shape[0]
     finally:
         del os.environ["SWARMDB_ENTRY_MODEL"]
+
+
+def test_dp_paged_admission_spreads_shards():
+    """Light load on a DP-sharded paged engine must spread across the
+    shards' sub-pools (id-order admission would exhaust shard 0's pool
+    while the others idle — review r5)."""
+
+    from swarmdb_tpu.backend.engine import GenRequest
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+
+    engine, _sm = build_serving_engine(
+        "tiny-debug", make_mesh(8, data=8, model=1, expert=1),
+        max_batch=16, max_seq=64, decode_chunk=4, prefill_buckets=[16],
+        paged=True, page_size=8,
+    )
+    alloc = engine.paged.allocator
+    assert alloc.n_shards == 8
+    engine.start()
+    results = []
+    try:
+        for i in range(4):
+            engine.submit(GenRequest(
+                prompt=[1 + i, 2, 3],
+                sampling=SamplingParams(max_new_tokens=24),
+                on_done=lambda rid, toks, reason: results.append(reason),
+            ))
+        deadline = 90
+        import time as _t
+        t0 = _t.time()
+        shards_seen = set()
+        while _t.time() - t0 < deadline and len(results) < 4:
+            with alloc._lock:
+                held = list(alloc._by_slot.keys())
+            shards_seen |= {alloc.shard_of(s) for s in held}
+            if len(shards_seen) >= 4:
+                break
+            _t.sleep(0.02)
+        assert len(shards_seen) >= 4, (
+            f"4 concurrent requests used only shards {shards_seen}")
+    finally:
+        engine.stop()
