@@ -42,6 +42,7 @@ import os
 import threading
 import time
 import uuid
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -91,6 +92,13 @@ class GenRequest:
     # reclaims every page, so resuming stale ids would alias another
     # slot's pages — cross-conversation KV corruption (ADVICE r4 #2).
     resume_epoch: Optional[int] = None
+    # shard_hint: DP-sharded paged pools only — admission prefers a free
+    # slot on this shard (mod n_shards). Prefix-cache pages are only
+    # usable by same-shard slots, so routing a conversation's turns to
+    # one shard keeps its cached prefix hittable; without the hint the
+    # load-spreading rotation would scatter turns (and their
+    # registrations) across shards. Advisory: any free slot still admits.
+    shard_hint: Optional[int] = None
 
 
 @dataclass
@@ -1527,21 +1535,53 @@ class Engine:
                     plans: Dict[int, Tuple] = {}
                     use_pp = self._prefix is not None
                     resume_rows: Dict[int, np.ndarray] = {}
-                    for slot_id in free[:take]:
-                        if not self._queue:
-                            break
+                    # candidates = ALL free slots (the wave-size cap
+                    # bounds how many ADMIT, not which slots are
+                    # eligible — free[:take] would pre-pick slots
+                    # positionally and defeat the shard-hint search)
+                    remaining = list(free)
+                    admitted = 0
+                    n_sh = getattr(self.paged.allocator, "n_shards", 1)
+                    while remaining and self._queue and admitted < take:
                         req = self._queue[0][3]
-                        if req.resume_pages is not None:
+                        if (req.resume_pages is not None
+                                and req.resume_epoch is not None
+                                and req.resume_epoch
+                                != self.paged.allocator.generation):
                             # re-validate the resume epoch at ADMISSION,
                             # not just submit (ADVICE r4 #2): a pool
                             # reset while the request sat queued makes
-                            # its page ids dangling aliases
-                            if (req.resume_epoch is not None
-                                    and req.resume_epoch
-                                    != self.paged.allocator.generation):
-                                heapq.heappop(self._queue)
-                                stale_resumes.append(req)
-                                continue
+                            # its page ids dangling aliases. No slot is
+                            # consumed by a stale pop.
+                            heapq.heappop(self._queue)
+                            stale_resumes.append(req)
+                            continue
+                        # slot choice: honor the request's shard hint
+                        # when its shard still has a free slot, so a
+                        # conversation's turns land where its cached
+                        # prefix pages live (same-shard-only reuse).
+                        # Unhinted prefix-eligible requests get a
+                        # CONTENT-affine default — a stable hash of the
+                        # first page of tokens — so identical prefixes
+                        # collide on one shard (cross-request reuse)
+                        # while distinct prompts still spread.
+                        slot_id = None
+                        hint = req.shard_hint
+                        if (hint is None and n_sh > 1 and use_pp
+                                and len(req.prompt) >= self._prefix_ps
+                                and not req.keep_pages):
+                            hint = zlib.crc32(np.asarray(
+                                req.prompt[:self._prefix_ps],
+                                np.int32).tobytes())
+                        if hint is not None and n_sh > 1:
+                            h = hint % n_sh
+                            for j, sid in enumerate(remaining):
+                                if self.paged.allocator.shard_of(sid) == h:
+                                    slot_id = remaining.pop(j)
+                                    break
+                        if slot_id is None:
+                            slot_id = remaining.pop(0)
+                        if req.resume_pages is not None:
                             # rolling-KV continuation: the kept pages are
                             # referenced (caller custody); only the part
                             # past resume_len needs fresh pages
@@ -1565,41 +1605,64 @@ class Engine:
                             popped.append(req)
                             rows.append((slot_id, row))
                             resume_rows[slot_id] = row
+                            admitted += 1
                             continue
                         need = self.paged.allocator.pages_needed(
                             len(req.prompt), req.sampling.max_new_tokens,
                             self.decode_chunk,
                         )
+                        row = None
                         hits: List[int] = []
                         chains: List[bytes] = []
-                        # keep_pages (rolling) requests bypass the hash
-                        # prefix cache both ways: a hit would reference
-                        # cache-custody pages that retirement cannot hand
-                        # to the caller, and registration would steal the
-                        # slot's own pages INTO cache custody
-                        if (use_pp and len(req.prompt) >= self._prefix_ps
-                                and not req.keep_pages):
-                            hits, chains = self._prefix_plan(req.prompt,
-                                                             pin=True)
-                            # DP-sharded pool: a slot can only reference
-                            # pages of its own shard (the shard_map'd
-                            # decode addresses its local sub-pool);
-                            # truncate foreign-shard hits and unpin them
-                            keep = self.paged.allocator.usable_prefix(
-                                slot_id, hits)
-                            if keep < len(hits):
-                                self._prefix.unpin(hits[keep:])
-                                hits = hits[:keep]
-                        row = self._paged_allocate(slot_id, hits,
-                                                   max(0, need - len(hits)))
+                        for attempt in range(2):
+                            hits, chains = [], []
+                            # keep_pages (rolling) requests bypass the
+                            # hash prefix cache both ways: a hit would
+                            # reference cache-custody pages that
+                            # retirement cannot hand to the caller, and
+                            # registration would steal the slot's own
+                            # pages INTO cache custody
+                            if (use_pp and len(req.prompt) >= self._prefix_ps
+                                    and not req.keep_pages):
+                                hits, chains = self._prefix_plan(
+                                    req.prompt, pin=True)
+                                # DP-sharded pool: a slot can only
+                                # reference pages of its own shard (the
+                                # shard_map'd decode addresses its local
+                                # sub-pool); truncate foreign-shard hits
+                                keep = self.paged.allocator.usable_prefix(
+                                    slot_id, hits)
+                                if keep < len(hits):
+                                    self._prefix.unpin(hits[keep:])
+                                    hits = hits[:keep]
+                            row = self._paged_allocate(
+                                slot_id, hits, max(0, need - len(hits)))
+                            if row is not None:
+                                break
+                            if hits:
+                                self._prefix.unpin(hits)
+                            # the hint is ADVISORY (review r5): a hinted
+                            # shard whose sub-pool cannot cover the
+                            # request must not head-of-line-block the 7
+                            # healthy shards — retry once on the
+                            # freest-pooled other free slot
+                            if (attempt == 0 and hint is not None
+                                    and n_sh > 1 and remaining):
+                                remaining.append(slot_id)  # still free
+                                alt = max(remaining,
+                                          key=self.paged.allocator.free_count)
+                                remaining.remove(alt)
+                                slot_id = alt
+                                continue
+                            break
                         if row is None:
-                            self._prefix.unpin(hits) if hits else None
                             pressure_need = max(0, need - len(hits))
                             break  # pool exhausted; retry after retirements
                         heapq.heappop(self._queue)
                         self._admitting.add(req.request_id)
                         popped.append(req)
                         rows.append((slot_id, row))
+                        admitted += 1
                         if (use_pp and len(req.prompt) >= self._prefix_ps
                                 and not req.keep_pages):
                             plans[slot_id] = (hits, chains)

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from ..core.messages import Message, MessagePriority, MessageType
 from ..core.runtime import SwarmDB
+from ..utils.hashing import stable_partition
 from .engine import Engine, GenRequest, PagedKV
 from .sampling import SamplingParams
 from .tokenizer import Tokenizer, default_tokenizer
@@ -881,6 +882,17 @@ class ServingService:
                 on_token=_tok, on_done=_done,
                 metadata={"message_id": msg.id},
             )
+            if (self.engine.paged is not None
+                    and getattr(self.engine.paged.allocator, "n_shards", 1)
+                    > 1):
+                # DP-sharded pool: pin the conversation to one shard so
+                # its prefix-cache pages (same-shard-only reuse) stay
+                # hittable across turns — the order-insensitive pair key
+                # matches get_conversation's identity
+                pair = "|".join(sorted((msg.sender_id,
+                                        msg.receiver_id or "")))
+                req.shard_hint = stable_partition(
+                    pair, self.engine.paged.allocator.n_shards)
             if rolling_key is not None:
                 req.keep_pages = True
                 req.on_pages = (lambda rid, pages, written, tail,

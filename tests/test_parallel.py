@@ -243,3 +243,89 @@ def test_dp_paged_admission_spreads_shards():
             f"4 concurrent requests used only shards {shards_seen}")
     finally:
         engine.stop()
+
+
+def test_dp_paged_shard_hint_preserves_prefix_affinity():
+    """A conversation's turns carry a shard hint: turn 2 must land on the
+    same shard as turn 1's prefix-cache registrations and HIT them —
+    without the hint, the load-spreading rotation scatters turns across
+    shards where the cached pages are unusable (same-shard-only reuse)."""
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+
+    engine, _sm = build_serving_engine(
+        "tiny-debug", make_mesh(8, data=8, model=1, expert=1),
+        max_batch=16, max_seq=64, decode_chunk=4, prefill_buckets=[32],
+        paged=True, page_size=8,
+    )
+    engine.start()
+    try:
+        prompt = list(range(1, 21))  # 2 full pages -> registers on hit path
+        for turn in range(3):
+            from swarmdb_tpu.backend.engine import GenRequest
+            import threading as _th
+
+            done = _th.Event()
+            engine.submit(GenRequest(
+                prompt=prompt, sampling=SamplingParams(max_new_tokens=3),
+                shard_hint=5,
+                on_done=lambda rid, toks, reason: done.set(),
+            ))
+            assert done.wait(120)
+        hits = engine.metrics.counters["prefix_reused_tokens"].value
+        assert hits >= 32, (  # turns 2+3 each reuse 2 pages = 16 tokens
+            f"shard-hinted turns never hit the prefix cache (hits={hits})")
+    finally:
+        engine.stop()
+
+
+def test_dp_paged_hint_falls_back_when_shard_exhausted():
+    """The shard hint is advisory: a request hinted at a shard whose
+    sub-pool cannot cover it must admit on another shard instead of
+    head-of-line blocking the queue (review r5)."""
+    import threading as _th
+    import time as _t
+
+    from swarmdb_tpu.backend.engine import GenRequest
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+
+    # tiny pool: ~9 pages/shard; each request's worst case is 7 pages,
+    # so a shard can hold ONE request at a time
+    engine, _sm = build_serving_engine(
+        "tiny-debug", make_mesh(8, data=8, model=1, expert=1),
+        max_batch=16, max_seq=64, decode_chunk=4, prefill_buckets=[32],
+        paged=True, page_size=8, kv_pool_tokens=512,
+    )
+    alloc = engine.paged.allocator
+    engine.start()
+    done = [_th.Event(), _th.Event()]
+    try:
+        for i in range(2):
+            engine.submit(GenRequest(
+                prompt=list(range(1 + i, 21 + i)),
+                sampling=SamplingParams(max_new_tokens=30),
+                shard_hint=5,
+                on_done=lambda rid, toks, reason, e=done[i]: e.set(),
+            ))
+        # while the first still decodes, the second must already hold
+        # pages on a DIFFERENT shard (fallback admitted it)
+        deadline = _t.time() + 60
+        shards = set()
+        while _t.time() < deadline:
+            with alloc._lock:
+                held = list(alloc._by_slot.keys())
+            shards = {alloc.shard_of(s) for s in held}
+            if len(shards) == 2:
+                break
+            if done[0].is_set() and done[1].is_set():
+                break
+            _t.sleep(0.02)
+        assert len(shards) == 2, (
+            f"hinted request head-of-line blocked instead of falling "
+            f"back (shards seen concurrently: {shards})")
+        assert done[0].wait(120) and done[1].wait(120)
+    finally:
+        engine.stop()
