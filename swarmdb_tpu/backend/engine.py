@@ -136,6 +136,11 @@ class PagedKV:
     page_size: int
     num_pages: int
     allocator: Any              # ops.paged_kv.PageAllocator
+    # DP-sharded pools only: shard_map'd collective-free PLAIN prefill
+    # (parallel/serving.build_sharded_paged) over [n_shards * prefill_
+    # batch, T] waves packed into per-shard row blocks. None = the
+    # generic GSPMD prefill (single-chip, or prefix/resume waves).
+    prefill_packed: Optional[Callable] = None
 
 
 class Engine:
@@ -480,6 +485,14 @@ class Engine:
             self._prefill_paged_fused = jax.jit(
                 _prefill_paged_insert, donate_argnums=(5, 6, 7, 8)
             )
+            self._prefill_paged_packed = None
+            if paged.prefill_packed is not None:
+                # same argument order as _prefill_paged_insert, same
+                # donation; rows = n_shards * prefill_batch per wave so
+                # any admission skew still fits one dispatch
+                self._prefill_paged_packed = jax.jit(
+                    paged.prefill_packed, donate_argnums=(5, 6, 7, 8)
+                )
 
         # ---- automatic prefix caching --------------------------------------
         # Chat serving re-prefills each conversation's WHOLE history every
@@ -829,6 +842,7 @@ class Engine:
     CALL_PAGED_RESUME_PREFILL = 2
     CALL_SET_PT_ROWS = 3
     CALL_DENSE_PREFIX_PREFILL = 4
+    CALL_PAGED_PREFILL_PACKED = 5
 
     def _replicate_block(self, all_toks, all_lps):
         """Constrain the chunk's sampled-token block to REPLICATED when the
@@ -857,6 +871,16 @@ class Engine:
                             temp, topk, topp) -> None:
         k_pool, v_pool, self._last_tokens, self._last_lps = \
             self._prefill_paged_fused(
+                self.params, tokens, lengths, target, scatter,
+                self.cache["k"], self.cache["v"], self._last_tokens,
+                self._last_lps, keys, temp, topk, topp,
+            )
+        self.cache = self._paged_cache_with(k_pool, v_pool)
+
+    def _call_paged_prefill_packed(self, tokens, lengths, target, scatter,
+                                   keys, temp, topk, topp) -> None:
+        k_pool, v_pool, self._last_tokens, self._last_lps = \
+            self._prefill_paged_packed(
                 self.params, tokens, lengths, target, scatter,
                 self.cache["k"], self.cache["v"], self._last_tokens,
                 self._last_lps, keys, temp, topk, topp,
@@ -909,6 +933,7 @@ class Engine:
         CALL_PAGED_RESUME_PREFILL: _call_paged_resume_prefill,
         CALL_SET_PT_ROWS: _call_set_pt_rows,
         CALL_DENSE_PREFIX_PREFILL: _call_dense_prefix_prefill,
+        CALL_PAGED_PREFILL_PACKED: _call_paged_prefill_packed,
     }
 
     def restart(self) -> None:
@@ -1071,12 +1096,28 @@ class Engine:
                 # target page 0 = the trash page (absorbs garbage writes);
                 # fed-token rows scatter to max_batch (dropped)
                 chunks = -(-bucket // self.paged.page_size)
-                drop = np.full(Bp, self.max_batch, np.int32)
-                self._mirrored(
-                    self.CALL_PAGED_PREFILL, tokens, lengths,
-                    np.zeros((Bp, chunks), np.int32), drop, keys, zero_f,
-                    zero_i, ones_f,
-                )
+                n_sh = getattr(self.paged.allocator, "n_shards", 1)
+                if self._prefill_paged_packed is not None and n_sh > 1:
+                    # sharded engines run the packed variant exclusively
+                    # on the plain path — warm it, not the dead GSPMD one
+                    R = n_sh * Bp
+                    self._mirrored(
+                        self.CALL_PAGED_PREFILL_PACKED,
+                        np.full((R, bucket), self.pad_id, np.int32),
+                        np.ones(R, np.int32),
+                        np.zeros((R, chunks), np.int32),
+                        np.full(R, self.max_batch, np.int32),
+                        self._base_keys_np[np.zeros(R, np.int64)],
+                        np.zeros(R, np.float32), np.zeros(R, np.int32),
+                        np.ones(R, np.float32),
+                    )
+                else:
+                    drop = np.full(Bp, self.max_batch, np.int32)
+                    self._mirrored(
+                        self.CALL_PAGED_PREFILL, tokens, lengths,
+                        np.zeros((Bp, chunks), np.int32), drop, keys,
+                        zero_f, zero_i, ones_f,
+                    )
             else:
                 drop = np.full(Bp, self.max_batch, np.int32)
                 if self._mh is not None:
@@ -1187,6 +1228,19 @@ class Engine:
             tok = sds((Bp, bucket), np.int32)
             if self.paged:
                 chunks = -(-bucket // self.paged.page_size)
+                n_sh = getattr(self.paged.allocator, "n_shards", 1)
+                if (getattr(self, "_prefill_paged_packed", None) is not None
+                        and n_sh > 1):
+                    R = n_sh * Bp
+                    keys_R = sds((R,) + self._base_keys_np.shape[1:],
+                                 key_dt)
+                    plan.append((self._prefill_paged_packed, (
+                        params_s, sds((R, bucket), np.int32),
+                        sds((R,), np.int32), sds((R, chunks), np.int32),
+                        sds((R,), np.int32), cache_s["k"], cache_s["v"],
+                        lt_s, llp_s, keys_R, sds((R,), np.float32),
+                        sds((R,), np.int32), sds((R,), np.float32))))
+                    continue
                 plan.append((self._prefill_paged_fused, (
                     params_s, tok, i32_Bp, sds((Bp, chunks), np.int32),
                     i32_Bp, cache_s["k"], cache_s["v"], lt_s, llp_s,
@@ -2180,6 +2234,38 @@ class Engine:
         # in a big bucket) route the all-padding chunks to trash page 0;
         # padding rows (beyond n) scatter entirely to trash
         chunks = -(-bucket // self.paged.page_size)
+        n_sh = getattr(self.paged.allocator, "n_shards", 1)
+        if self._prefill_paged_packed is not None and n_sh > 1:
+            # shard-packed collective-free prefill: re-lay the wave as
+            # [n_shards * Bp] with block d = shard d's rows (slot→shard
+            # affinity makes every row's pages and fed-token slot local
+            # to its block's device; padding rows are dropped/trashed)
+            R = n_sh * Bp
+            p_tokens = np.full((R, bucket), self.pad_id, np.int32)
+            p_lengths = np.ones(R, np.int32)
+            p_target = np.zeros((R, chunks), np.int32)
+            p_scatter = np.full(R, self.max_batch, np.int32)
+            p_gather = np.zeros(R, np.int64)
+            fill = [0] * n_sh  # next free row within each shard block
+            for row, (slot_id, req) in enumerate(batch):
+                sh = self.paged.allocator.shard_of(slot_id)
+                r = sh * Bp + fill[sh]
+                fill[sh] += 1
+                p_tokens[r] = padded[row]
+                p_lengths[r] = lengths[row]
+                p_scatter[r] = slot_id
+                p_gather[r] = slot_id
+                pages = self.paged.allocator.pages_for(slot_id)
+                m = min(len(pages), chunks)
+                p_target[r, :m] = pages[:m]
+            self._mirrored(
+                self.CALL_PAGED_PREFILL_PACKED, p_tokens, p_lengths,
+                p_target, p_scatter, self._base_keys_np[p_gather],
+                self._temp[p_gather], self._topk[p_gather],
+                self._topp[p_gather],
+            )
+            self._activate(batch, t0)
+            return
         target = np.zeros((Bp, chunks), np.int32)
         for row in range(n):
             pages = self.paged.allocator.pages_for(int(gather[row]))

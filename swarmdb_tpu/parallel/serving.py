@@ -332,6 +332,78 @@ def build_sharded_paged(
         }
         return jax.jit(shape_fn, out_shardings=out_sh)()
 
+    # -- shard-packed PLAIN prefill (collective-free) ----------------------
+    # The generic paged prefill writes pages with dynamic indices into the
+    # pool's sharded axis, which GSPMD cannot prove shard-local — it
+    # inserts pool-sized collectives per admission wave (the KNOWN COST
+    # note above). But the allocator makes every write shard-local by
+    # construction (slot→shard affinity), so when the engine packs a
+    # wave's rows into per-shard blocks, the whole prefill — forward,
+    # sampling, pool scatter, fed-token update — runs under shard_map
+    # with ZERO collectives: dp independent single-chip prefills, the
+    # exact structure of the decode path. Row geometry: [dp * rows_per,
+    # T] with block d = shard d's rows (padding rows: length 1, local
+    # trash pages, fed-scatter out of local range -> dropped).
+    from ..backend.sampling import sample_tokens, token_logprob
+
+    slots_per = max_batch // dp
+
+    def _packed_body(p, tokens, lengths, target, scatter, k_pool, v_pool,
+                     last_tokens, last_lps, keys, temp, topk, topp):
+        # local shapes: tokens [R, T], target [R, chunks] GLOBAL page ids
+        # (localized via _localize, like the decode body), scatter [R]
+        # GLOBAL slot ids (block-local by packing; padding -> out of
+        # range, dropped), k/v_pool [L, per_shard, ...], last_* [slots_per]
+        #
+        # PARITY CONTRACT: this is the shard-local twin of
+        # backend/engine._prefill_paged_insert — same forward (fam.forward
+        # with logits_at IS what the engine's _forward_last_of resolves
+        # to), same sampling fold, same pad/reshape/page-scatter shapes.
+        # A change to either body must land in both;
+        # tests/test_parallel.py::test_sharded_paged_engine_matches_dense_
+        # sharded pins greedy token parity across them.
+        R, T = tokens.shape
+        d = jax.lax.axis_index("data").astype(jnp.int32)
+        positions = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None], (R, T))
+        cacheB = fam.init_kv_cache(cfg, R, T)
+        with pallas_disabled():
+            logits, cacheB = fam.forward(p, cfg, tokens, positions, cacheB,
+                                         logits_at=lengths - 1)
+        last = (logits if logits.ndim == 2
+                else logits[jnp.arange(R), lengths - 1])
+        next_tok = sample_tokens(last, keys, lengths - 1, temp, topk, topp)
+        lp = token_logprob(last, next_tok)
+        ck, cv = cacheB
+        ps_ = page_size
+        chunks = target.shape[1]
+        pad_to = chunks * ps_
+        if pad_to != T:
+            pad = [(0, 0), (0, 0), (0, pad_to - T), (0, 0), (0, 0)]
+            ck = jnp.pad(ck, pad)
+            cv = jnp.pad(cv, pad)
+        L = ck.shape[0]
+        tail = ck.shape[3:]
+        kc = ck.reshape((L, R * chunks, ps_) + tail)
+        vc = cv.reshape((L, R * chunks, ps_) + tail)
+        flat = _localize(target).reshape(-1)
+        k_pool = k_pool.at[:, flat].set(kc.astype(k_pool.dtype))
+        v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
+        local_slots = scatter - d * slots_per  # packing makes own rows
+        last_tokens = last_tokens.at[local_slots].set(next_tok, mode="drop")
+        last_lps = last_lps.at[local_slots].set(lp, mode="drop")
+        return k_pool, v_pool, last_tokens, last_lps
+
+    prefill_packed = shard_map(
+        _packed_body, mesh=mesh,
+        in_specs=(params_specs, P("data", None), P("data"),
+                  P("data", None), P("data"), PAGED_POOL_SPEC,
+                  PAGED_POOL_SPEC, P("data"), P("data"), P("data", None),
+                  P("data"), P("data"), P("data")),
+        out_specs=(PAGED_POOL_SPEC, PAGED_POOL_SPEC, P("data"), P("data")),
+        check_rep=False,
+    )
+
     from ..backend.engine import PagedKV
 
     paged_spec = PagedKV(
@@ -340,6 +412,7 @@ def build_sharded_paged(
         page_size=page_size,
         num_pages=num_pages,
         allocator=allocator,
+        prefill_packed=prefill_packed,
     )
 
     prefix_fns = None
