@@ -201,16 +201,15 @@ def build_sharded_paged(
       to [1, Pl), zeroed/trash entries to the shard's local trash 0) and
       gathers/scatters ONLY its own sub-pool. No collectives in the
       decode hot loop: DP decode is dp independent single-chip programs.
-    - Prefill (admission-time, amortized) stays on GSPMD with GLOBAL page
-      ids against the sharded pool; the dense forward inside it is
-      data-sharded by the ShardedModel's constraints. KNOWN COST: the
-      page-pool scatter at the end of prefill has dynamic indices into
-      the pool's sharded axis, which GSPMD cannot prove shard-local —
-      expect pool-sized collectives per admission wave on real hardware.
-      The fix (shard-block-packed admission waves so the scatter runs
-      inside shard_map too) needs admission-side wave packing and is the
-      next step for this path; until then the sharded engine's DECODE is
-      collective-free but its prefill is not.
+    - PLAIN prefill runs shard-packed under shard_map (``prefill_packed``
+      below): the engine lays each admission wave out as per-shard row
+      blocks, so the forward, sampling, page scatter and fed-token update
+      are all block-local — the compiled program carries ZERO collectives
+      (asserted by the multichip dry run), where the generic GSPMD form
+      emitted pool-sized all-gathers per wave. PREFIX and RESUME waves
+      keep GSPMD with GLOBAL page ids (admission-time, shortened by the
+      hits themselves, amortized); packing them too is the remaining
+      headroom on this path.
     - Requires a pure-DP mesh for the pool (``model`` axis size 1): TP
       inside shard_map would need manual collectives the model fns don't
       emit. TP+paged is a deliberate non-goal this round — the v5e-8
