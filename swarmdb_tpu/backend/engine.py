@@ -137,9 +137,9 @@ class PagedKV:
     num_pages: int
     allocator: Any              # ops.paged_kv.PageAllocator
     # DP-sharded pools only: shard_map'd collective-free PLAIN prefill
-    # (parallel/serving.build_sharded_paged) over [n_shards * prefill_
-    # batch, T] waves packed into per-shard row blocks. None = the
-    # generic GSPMD prefill (single-chip, or prefix/resume waves).
+    # (parallel/serving.build_sharded_paged) over waves packed into
+    # per-shard row blocks (Engine._packed_geometry sizes the blocks).
+    # None = the generic GSPMD prefill (single-chip, or prefix waves).
     prefill_packed: Optional[Callable] = None
 
 
@@ -1096,11 +1096,10 @@ class Engine:
                 # target page 0 = the trash page (absorbs garbage writes);
                 # fed-token rows scatter to max_batch (dropped)
                 chunks = -(-bucket // self.paged.page_size)
-                n_sh = getattr(self.paged.allocator, "n_shards", 1)
-                if self._prefill_paged_packed is not None and n_sh > 1:
+                if self._packed_active():
                     # sharded engines run the packed variant exclusively
                     # on the plain path — warm it, not the dead GSPMD one
-                    R = n_sh * Bp
+                    _, _, R = self._packed_geometry()
                     self._mirrored(
                         self.CALL_PAGED_PREFILL_PACKED,
                         np.full((R, bucket), self.pad_id, np.int32),
@@ -1186,6 +1185,26 @@ class Engine:
         out["v"] = v_pool
         return out
 
+    def _packed_active(self) -> bool:
+        """Whether the PLAIN paged path runs the shard-packed
+        collective-free prefill. ONE gate shared by warmup(),
+        warmup_call_plan() and _prefill_batch — the three must agree or
+        warmup compiles a dead variant while the serving path pays a
+        cold compile mid-traffic (same contract as _warm_resume)."""
+        return (self.paged is not None
+                and getattr(self, "_prefill_paged_packed", None) is not None
+                and getattr(self.paged.allocator, "n_shards", 1) > 1)
+
+    def _packed_geometry(self):
+        """(n_shards, rows_per_shard, total_rows) of a packed wave. A
+        wave holds at most min(prefill_batch, slots_per_shard) DISTINCT
+        slots of any one shard (slot ids are unique per wave), so each
+        block is sized to that — not to prefill_batch, which would run
+        up to slots_per/Bp-fold wasted forward FLOPs per device."""
+        n_sh = self.paged.allocator.n_shards
+        rows_per = max(1, min(self.prefill_batch, self.max_batch // n_sh))
+        return n_sh, rows_per, n_sh * rows_per
+
     def _warm_resume(self) -> bool:
         """Whether warmup covers the rolling-KV resume variants (paged +
         prefix engines, SWARMDB_ROLLING_KV deployments only). ONE gate
@@ -1205,15 +1224,26 @@ class Engine:
         precompiled engine's warmup adds ZERO new persistent-cache
         entries (any shape/dtype/arg-order/donation mismatch shows up
         as a fresh compile)."""
-        sds = jax.ShapeDtypeStruct
+        from jax.sharding import NamedSharding
+
+        def sds(shape, dtype, a=None):
+            # mesh-placed device state must carry its NamedSharding into
+            # the spec: lowering without it compiles a DIFFERENT program
+            # than the eager call on sharded engines, so precompile would
+            # populate the persistent cache with executables warmup (and
+            # serving) never hit (review r5 drift-guard finding)
+            sh = getattr(a, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(shape, dtype)
 
         def spec(x):
-            return jax.tree.map(lambda a: sds(a.shape, a.dtype), x)
+            return jax.tree.map(lambda a: sds(a.shape, a.dtype, a), x)
 
         B, Bp = self.max_batch, self.prefill_batch
         params_s, cache_s = spec(self.params), spec(self.cache)
-        lt_s = sds((B,), jnp.int32)
-        llp_s = sds((B,), jnp.float32)
+        lt_s = spec(self._last_tokens)
+        llp_s = spec(self._last_lps)
         keys_B = spec(self._base_keys_np)
         key_dt = self._base_keys_np.dtype
         f32_B, i32_B = sds((B,), np.float32), sds((B,), np.int32)
@@ -1228,10 +1258,8 @@ class Engine:
             tok = sds((Bp, bucket), np.int32)
             if self.paged:
                 chunks = -(-bucket // self.paged.page_size)
-                n_sh = getattr(self.paged.allocator, "n_shards", 1)
-                if (getattr(self, "_prefill_paged_packed", None) is not None
-                        and n_sh > 1):
-                    R = n_sh * Bp
+                if self._packed_active():
+                    _, _, R = self._packed_geometry()
                     keys_R = sds((R,) + self._base_keys_np.shape[1:],
                                  key_dt)
                     plan.append((self._prefill_paged_packed, (
@@ -2234,13 +2262,12 @@ class Engine:
         # in a big bucket) route the all-padding chunks to trash page 0;
         # padding rows (beyond n) scatter entirely to trash
         chunks = -(-bucket // self.paged.page_size)
-        n_sh = getattr(self.paged.allocator, "n_shards", 1)
-        if self._prefill_paged_packed is not None and n_sh > 1:
+        if self._packed_active():
             # shard-packed collective-free prefill: re-lay the wave as
-            # [n_shards * Bp] with block d = shard d's rows (slot→shard
+            # per-shard row blocks (block d = shard d's rows; slot→shard
             # affinity makes every row's pages and fed-token slot local
             # to its block's device; padding rows are dropped/trashed)
-            R = n_sh * Bp
+            n_sh, rows_per, R = self._packed_geometry()
             p_tokens = np.full((R, bucket), self.pad_id, np.int32)
             p_lengths = np.ones(R, np.int32)
             p_target = np.zeros((R, chunks), np.int32)
@@ -2249,7 +2276,7 @@ class Engine:
             fill = [0] * n_sh  # next free row within each shard block
             for row, (slot_id, req) in enumerate(batch):
                 sh = self.paged.allocator.shard_of(slot_id)
-                r = sh * Bp + fill[sh]
+                r = sh * rows_per + fill[sh]
                 fill[sh] += 1
                 p_tokens[r] = padded[row]
                 p_lengths[r] = lengths[row]

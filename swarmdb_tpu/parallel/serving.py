@@ -206,10 +206,11 @@ def build_sharded_paged(
       blocks, so the forward, sampling, page scatter and fed-token update
       are all block-local — the compiled program carries ZERO collectives
       (asserted by the multichip dry run), where the generic GSPMD form
-      emitted pool-sized all-gathers per wave. PREFIX and RESUME waves
-      keep GSPMD with GLOBAL page ids (admission-time, shortened by the
-      hits themselves, amortized); packing them too is the remaining
-      headroom on this path.
+      emitted pool-sized all-gathers per wave. PREFIX waves keep GSPMD
+      with GLOBAL page ids (admission-time, shortened by the hits
+      themselves, amortized); packing them too is the remaining headroom
+      on this path. Resume waves don't arise here at all — rolling is
+      disabled on sharded pools (below).
     - Requires a pure-DP mesh for the pool (``model`` axis size 1): TP
       inside shard_map would need manual collectives the model fns don't
       emit. TP+paged is a deliberate non-goal this round — the v5e-8

@@ -78,9 +78,15 @@ _PAGED_SCRIPT = textwrap.dedent("""
         toks2, r2 = engine.generate_sync(
             prompt, SamplingParams(max_new_tokens=5), timeout=180)
         hits = engine.metrics.counters["prefix_reused_tokens"].value
+        # sub-page prompt: no prefix plan possible -> the PLAIN path ->
+        # the shard-packed collective-free prefill, mirrored as
+        # CALL_PAGED_PREFILL_PACKED across both processes
+        toks3, r3 = engine.generate_sync(
+            [1, 2, 3], SamplingParams(max_new_tokens=5), timeout=180)
         engine.stop()
         print("RESULT " + json.dumps({"t1": toks1, "t2": toks2,
-                                      "r": r1, "hits": int(hits)}),
+                                      "t3": toks3, "r": r1,
+                                      "hits": int(hits)}),
               flush=True)
     else:
         engine.worker_loop()
@@ -237,6 +243,7 @@ def test_two_process_paged_prefix_pod():
     assert res["t1"] == res["t2"], "pod paged decode must be deterministic"
     assert res["hits"] > 0, "second turn must hit the prefix cache"
     assert len(res["t1"]) > 0 and res["r"] in ("length", "eos")
+    assert len(res["t3"]) > 0, "packed plain prefill produced nothing"
 
     from swarmdb_tpu.backend.sampling import SamplingParams
     from swarmdb_tpu.parallel.mesh import make_mesh
@@ -251,9 +258,13 @@ def test_two_process_paged_prefix_pod():
     try:
         ref, _ = engine.generate_sync(list(range(1, 21)),
                                       SamplingParams(max_new_tokens=5))
+        ref3, _ = engine.generate_sync([1, 2, 3],
+                                       SamplingParams(max_new_tokens=5))
     finally:
         engine.stop()
     assert res["t1"] == ref
+    # the packed shard_map prefill must be process-count invariant
+    assert res["t3"] == ref3
 
 
 def test_two_process_dense_prefix_pod():

@@ -329,3 +329,38 @@ def test_dp_paged_hint_falls_back_when_shard_exhausted():
         assert done[0].wait(120) and done[1].wait(120)
     finally:
         engine.stop()
+
+
+def test_sharded_warmup_plan_covers_packed_variant(tmp_path):
+    """Drift guard for the SHARDED paged engine's warmup_call_plan (review
+    r5: the single-chip drift test never builds an n_shards > 1 engine,
+    so packed-variant drift would ship silently). The plan must contain
+    the packed prefill with spec args that LOWER against the real jitted
+    fn — catching the shape/dtype/arg-order/donation drift class.
+
+    KNOWN GAP (pre-existing, affects prefix variants too, documented in
+    PROFILE r5): on mesh-placed engines the persistent-cache key of a
+    spec-lowered AOT compile does not match the eager call's, so the
+    stronger zero-new-cache-entries assertion of
+    test_precompile_cache_covers_warmup cannot hold here — sharded
+    parallel-precompile burns duplicate compiles instead of reusing
+    them. Sequential warmup() is unaffected."""
+    engine, _sm = build_serving_engine(
+        get_config("tiny-debug"),
+        make_mesh(8, data=8, model=1, expert=1),
+        max_batch=16, max_seq=64, decode_chunk=4,
+        prefill_buckets=[16], paged=True, page_size=8,
+    )
+    assert engine._packed_active()
+    plan = engine.warmup_call_plan()
+    packed = [(fn, specs) for fn, specs in plan
+              if fn is engine._prefill_paged_packed]
+    n_buckets = len(engine.prefill_buckets)
+    assert len(packed) == n_buckets, (
+        f"plan holds {len(packed)} packed variants for {n_buckets} "
+        "buckets")
+    # the GSPMD plain variant must NOT be planned (dead on sharded
+    # engines — warming it would waste a 30-90 s tunnel compile each)
+    assert not any(fn is engine._prefill_paged_fused for fn, _ in plan)
+    for fn, specs in plan:
+        fn.lower(*specs)  # type-checks shapes/dtypes/order for each
