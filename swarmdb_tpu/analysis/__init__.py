@@ -1,0 +1,40 @@
+"""swarmlint — JAX-aware static analysis for this repo's real bug classes.
+
+Four check families, each grounded in a regression this codebase has
+actually had (see ISSUE/ADVICE history):
+
+- **host-sync** (SWL101/SWL102, hostsync.py): host round-trips inside
+  functions annotated ``# swarmlint: hot`` — the decode/dispatch path's
+  "one sync per chunk" contract, machine-checked.
+- **recompile-hazard** (SWL201-SWL203, recompile.py): jit wrappers built
+  per call, per-call-varying argument signatures, and jit entry points a
+  class's warmup plan doesn't cover (the static twin of the precompile
+  drift test).
+- **lock-discipline** (SWL301, locks.py): reads/writes of declared
+  guarded attributes outside a ``with`` on their lock/Condition.
+- **tracer-leak** (SWL401, tracers.py): stores to self/global/nonlocal
+  from inside traced functions.
+
+Run it::
+
+    python -m swarmdb_tpu.analysis swarmdb_tpu/ --baseline analysis/baseline.json
+
+Findings are suppressible inline (``# swarmlint: disable=SWL101 -- why``)
+and diffed against a committed baseline so CI fails only on NEW findings.
+See core.py for the full directive grammar and README.md for workflow.
+"""
+
+from .core import (Finding, RULES, analyze_file, analyze_paths,
+                   iter_py_files, load_baseline, write_baseline)
+from .cli import main
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "iter_py_files",
+    "load_baseline",
+    "write_baseline",
+    "main",
+]

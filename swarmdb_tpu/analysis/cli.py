@@ -1,0 +1,107 @@
+"""swarmlint CLI: ``python -m swarmdb_tpu.analysis [paths...]``.
+
+Exit codes: 0 = no findings beyond the baseline; 1 = new findings (or
+any finding with ``--no-baseline``); 2 = usage error. The default
+baseline is ``analysis/baseline.json`` relative to the current directory
+when it exists, so the acceptance invocation
+``python -m swarmdb_tpu.analysis swarmdb_tpu/`` run from the repo root
+diffs against the committed baseline with no extra flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import (DEFAULT_BASELINE, RULES, Finding, analyze_paths,
+                   expand_rule_names, load_baseline, write_baseline)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m swarmdb_tpu.analysis",
+        description="swarmlint: JAX-aware static analysis (host-sync, "
+                    "recompile, lock-discipline, tracer-leak)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to scan "
+                         "(default: swarmdb_tpu/)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline json of accepted findings (default: "
+                         f"{DEFAULT_BASELINE} if it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline; every finding fails")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids or family names to run "
+                         "(default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id}  [{rule.family}]  {rule.summary}")
+        return 0
+
+    paths = args.paths or ["swarmdb_tpu"]
+    select = None
+    if args.select:
+        try:
+            select = expand_rule_names(args.select.split(","))
+        except KeyError as exc:
+            print(f"swarmlint: {exc.args[0]}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = analyze_paths(paths, select=select)
+    except (OSError, SyntaxError) as exc:
+        print(f"swarmlint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline \
+            and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.update_baseline:
+        target = baseline_path or DEFAULT_BASELINE
+        write_baseline(target, findings)
+        print(f"swarmlint: wrote {len(findings)} accepted finding(s) to "
+              f"{target}")
+        return 0
+
+    accepted = set()
+    if baseline_path and not args.no_baseline:
+        try:
+            accepted = load_baseline(baseline_path)
+        except FileNotFoundError:
+            print(f"swarmlint: baseline {baseline_path} not found",
+                  file=sys.stderr)
+            return 2
+    new = [f for f in findings if f.fingerprint not in accepted]
+    known = len(findings) - len(new)
+
+    if args.format == "json":
+        print(json.dumps({"new": [f.to_json() for f in new],
+                          "baselined": known}, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        suffix = f" ({known} baselined)" if known else ""
+        if new:
+            print(f"swarmlint: {len(new)} new finding(s){suffix}")
+        else:
+            print(f"swarmlint: clean{suffix}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

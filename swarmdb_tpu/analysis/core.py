@@ -1,0 +1,439 @@
+"""swarmlint core: source model, directives, findings, baseline.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``) so the linter runs
+in a bare CI job without JAX installed. The check families live in sibling
+modules (hostsync, recompile, locks, tracers); this module owns what they
+share:
+
+- :class:`SourceFile` — parsed tree + the comment **directives** that carry
+  the repo's annotations (see below).
+- :class:`Finding` — one diagnostic, with a content-addressed fingerprint
+  (rule + path + enclosing scope + normalized source line) so the committed
+  baseline survives unrelated line-number churn.
+- baseline load/diff/update — CI fails only on findings whose fingerprint
+  is not in ``analysis/baseline.json``.
+
+Directive grammar (comments beginning ``# swarmlint:``):
+
+``# swarmlint: hot``
+    On (or directly above) a ``def``: the function is a hot-path function —
+    host syncs inside it are findings (hostsync.py). An identity decorator
+    named ``hot`` works too.
+``# swarmlint: disable=<rule>[,<rule>] [-- reason]``
+    Suppress the named rules (ids like ``SWL101`` or family names like
+    ``host-sync``) on this line, or — when the comment is a standalone
+    comment line — on the next line. Bare ``disable`` suppresses all.
+``# swarmlint: guarded-by[<guard>]: <name>[, <name>]``
+    Lock-discipline declaration (locks.py): the listed attributes/locals
+    may only be read or written inside ``with <guard>:``. A guard spelled
+    ``self.X`` attaches the declaration to the enclosing *class* (names are
+    ``self.<name>`` attributes); a bare name attaches it to the enclosing
+    function (names are locals — nested ``def``s inherit the declaration
+    but NOT any held lock, matching thread reality).
+``# swarmlint: holds[<guard>]``
+    On (or directly above) a ``def``: this function's calling contract is
+    that the guard is already held (RLock helper methods) — its body is
+    checked as if inside ``with <guard>:``. The contract claim is on the
+    author; the checker polices everything past it.
+``# swarmlint: device-state: <name>[, <name>]``
+    Class-level taint declaration (hostsync.py): ``self.<name>`` holds
+    device arrays, so host-materializing it in a hot function is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+DIRECTIVE_RE = re.compile(r"#\s*swarmlint:\s*(.*)$")
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    family: str
+    summary: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule("SWL101", "host-sync",
+             "explicit host sync (device_get / block_until_ready) in a "
+             "hot-path function"),
+        Rule("SWL102", "host-sync",
+             "host materialization of a device value (.item() / np.asarray "
+             "/ device_put) in a hot-path function"),
+        Rule("SWL201", "recompile-hazard",
+             "jax.jit called inside a loop or hot function — a fresh "
+             "wrapper (and compile-cache miss) per call"),
+        Rule("SWL202", "recompile-hazard",
+             "argument signature to a jit-wrapped callable can vary per "
+             "call (varying static arg, f-string, len(), dict display)"),
+        Rule("SWL203", "recompile-hazard",
+             "jit entry point not reachable from the class's warmup call "
+             "plan — first real traffic pays a cold compile"),
+        Rule("SWL301", "lock-discipline",
+             "guarded attribute accessed outside a `with` on its declared "
+             "lock/Condition"),
+        Rule("SWL401", "tracer-leak",
+             "store to self/global/nonlocal from inside a traced (jit/"
+             "shard_map/scan) function leaks a tracer"),
+    )
+}
+
+FAMILIES: Dict[str, Set[str]] = {}
+for _r in RULES.values():
+    FAMILIES.setdefault(_r.family, set()).add(_r.id)
+
+
+def expand_rule_names(names: Iterable[str]) -> Set[str]:
+    """Map a mix of rule ids and family names to a set of rule ids."""
+    out: Set[str] = set()
+    for n in names:
+        n = n.strip()
+        if not n:
+            continue
+        if n in RULES:
+            out.add(n)
+        elif n in FAMILIES:
+            out.update(FAMILIES[n])
+        else:
+            raise KeyError(f"unknown swarmlint rule or family: {n!r}")
+    return out
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    scope: str = "<module>"
+    fingerprint: str = ""
+
+    @property
+    def family(self) -> str:
+        return RULES[self.rule].family
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.family}] {self.message} (in {self.scope})")
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "family": self.family,
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+@dataclass
+class GuardDecl:
+    line: int
+    guard: str           # unparse-normalized guard expression text
+    names: Tuple[str, ...]
+
+
+@dataclass
+class Directives:
+    hot_lines: Set[int] = field(default_factory=set)
+    # line -> None (suppress all) or set of rule ids
+    disables: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
+    comment_only_lines: Set[int] = field(default_factory=set)
+    guards: List[GuardDecl] = field(default_factory=list)
+    holds: Dict[int, str] = field(default_factory=dict)  # line -> guard
+    device_state: List[Tuple[int, Tuple[str, ...]]] = field(
+        default_factory=list)
+
+
+def _parse_directive(body: str, line: int, out: Directives) -> None:
+    body = body.strip()
+    if body == "hot" or body.startswith("hot "):
+        out.hot_lines.add(line)
+        return
+    if body.startswith("disable"):
+        rest = body[len("disable"):]
+        # strip an optional trailing free-text reason after '--'
+        rest = rest.split("--", 1)[0].strip()
+        if rest.startswith("="):
+            names = [n for n in rest[1:].split(",") if n.strip()]
+            try:
+                out.disables[line] = expand_rule_names(names)
+            except KeyError as exc:
+                raise SyntaxError(
+                    f"line {line}: {exc.args[0]}") from None
+        else:
+            out.disables[line] = None  # suppress everything
+        return
+    m = re.match(r"holds\[(?P<guard>[^\]]+)\]\s*$", body)
+    if m:
+        out.holds[line] = m.group("guard").strip()
+        return
+    m = re.match(r"guarded-by\[(?P<guard>[^\]]+)\]\s*:\s*(?P<names>.+)$",
+                 body)
+    if m:
+        names = tuple(n.strip() for n in m.group("names").split(",")
+                      if n.strip())
+        out.guards.append(GuardDecl(line, m.group("guard").strip(), names))
+        return
+    m = re.match(r"device-state\s*:\s*(?P<names>.+)$", body)
+    if m:
+        names = tuple(n.strip() for n in m.group("names").split(",")
+                      if n.strip())
+        out.device_state.append((line, names))
+        return
+    raise SyntaxError(f"unrecognized swarmlint directive on line {line}: "
+                      f"{body!r}")
+
+
+class SourceFile:
+    """One parsed source file plus its swarmlint directives."""
+
+    def __init__(self, path: str, text: Optional[str] = None) -> None:
+        self.path = path
+        if text is None:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.directives = self._scan_directives()
+        self._scopes = self._index_scopes()
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    # ----------------------------------------------------------- directives
+
+    def _scan_directives(self) -> Directives:
+        out = Directives()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except tokenize.TokenError:
+            return out
+        code_lines: Set[int] = set()
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                m = DIRECTIVE_RE.search(tok.string)
+                if m:
+                    _parse_directive(m.group(1), tok.start[0], out)
+            elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                                  tokenize.INDENT, tokenize.DEDENT,
+                                  tokenize.ENCODING, tokenize.ENDMARKER):
+                code_lines.add(tok.start[0])
+        for tok in tokens:
+            if (tok.type == tokenize.COMMENT
+                    and tok.start[0] not in code_lines):
+                out.comment_only_lines.add(tok.start[0])
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True if ``rule`` is disabled on ``line`` (same-line comment, or a
+        standalone directive comment on the line above)."""
+        for cand in (line, line - 1):
+            if cand not in self.directives.disables:
+                continue
+            if cand == line - 1 and (
+                    cand not in self.directives.comment_only_lines):
+                continue
+            rules = self.directives.disables[cand]
+            if rules is None or rule in rules:
+                return True
+        return False
+
+    # --------------------------------------------------------------- scopes
+
+    def _index_scopes(self) -> List[Tuple[int, int, ast.AST]]:
+        spans = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                spans.append((node.lineno, node.end_lineno or node.lineno,
+                              node))
+        return spans
+
+    def enclosing_scope(self, line: int,
+                        classes_only: bool = False) -> Optional[ast.AST]:
+        """Innermost function/class whose span contains ``line``."""
+        best = None
+        best_span = None
+        for lo, hi, node in self._scopes:
+            if classes_only and not isinstance(node, ast.ClassDef):
+                continue
+            if lo <= line <= hi:
+                span = hi - lo
+                if best_span is None or span < best_span:
+                    best, best_span = node, span
+        return best
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def is_hot(self, fn: ast.AST) -> bool:
+        """Hot if decorated ``@hot`` (any dotted path ending in hot) or a
+        ``# swarmlint: hot`` comment sits on the decorator/def lines or the
+        line directly above the def."""
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        for dec in fn.decorator_list:
+            name = dotted_name(dec)
+            if name and name.split(".")[-1] == "hot":
+                return True
+        first = min([fn.lineno]
+                    + [d.lineno for d in fn.decorator_list]) - 1
+        for line in range(first, fn.body[0].lineno):
+            if line in self.directives.hot_lines:
+                return True
+        return False
+
+    def held_guards(self, fn: ast.AST) -> Set[str]:
+        """Guards a ``# swarmlint: holds[...]`` directive on/above the
+        def declares as already held by this function's callers."""
+        out: Set[str] = set()
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return out
+        first = min([fn.lineno]
+                    + [d.lineno for d in fn.decorator_list]) - 1
+        for line in range(first, fn.body[0].lineno):
+            if line in self.directives.holds:
+                out.add(self.directives.holds[line])
+        return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains; None for anything else."""
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func)
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def make_finding(src: SourceFile, rule: str, node: ast.AST,
+                 message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    scope = src.enclosing_scope(line)
+    scope_name = src.qualname(scope) if scope is not None else "<module>"
+    text = src.lines[line - 1].strip() if 0 < line <= len(src.lines) else ""
+    norm_path = os.path.normpath(src.path).replace(os.sep, "/")
+    # fingerprint on the trailing two path components so the same file
+    # hashes identically whether scanned as `swarmdb_tpu/` from the repo
+    # root or by absolute path (tests, editors); scope + line text keep
+    # it collision-safe and line-number-churn-proof
+    fp_path = "/".join(norm_path.split("/")[-2:])
+    raw = "\x00".join((rule, fp_path, scope_name, text))
+    fp = hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+    return Finding(rule=rule, path=norm_path, line=line, col=col + 1,
+                   message=message, scope=scope_name, fingerprint=fp)
+
+
+# ------------------------------------------------------------------ baseline
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join("analysis", "baseline.json")
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return {entry["fingerprint"] for entry in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": ("Accepted swarmlint findings. CI fails only on NEW "
+                    "findings; regenerate with --update-baseline after "
+                    "reviewing every entry you are accepting."),
+        "findings": [f.to_json() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule))],
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+# -------------------------------------------------------------------- runner
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif os.path.isfile(p) and p.endswith(".py"):
+            files.append(p)
+        else:
+            # a typo'd path silently reporting "clean" would neuter CI
+            raise OSError(f"not a directory or .py file: {p}")
+    return files
+
+
+def analyze_file(path: str, select: Optional[Set[str]] = None,
+                 text: Optional[str] = None) -> List[Finding]:
+    from . import hostsync, locks, recompile, tracers
+
+    try:
+        src = SourceFile(path, text=text)
+    except SyntaxError as exc:
+        if exc.filename:  # ast.parse errors already carry the path
+            raise
+        raise SyntaxError(f"{path}: {exc}") from None
+    findings: List[Finding] = []
+    for checker in (hostsync.check, recompile.check, locks.check,
+                    tracers.check):
+        findings.extend(checker(src))
+    out = []
+    seen = set()
+    for f in findings:
+        key = (f.rule, f.line, f.col, f.message)
+        if key in seen:  # e.g. a scan body nested in a jitted fn
+            continue
+        seen.add(key)
+        if select is not None and f.rule not in select:
+            continue
+        if src.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(analyze_file(path, select=select))
+    return findings

@@ -1,0 +1,203 @@
+"""host-sync checks (SWL101/SWL102).
+
+The engine's throughput contract is "one host sync per decode chunk"
+(backend/engine.py module docstring): on this image's tunneled TPU every
+synchronous fetch costs ~80 ms, so a stray ``device_get`` or ``.item()``
+in the dispatch path caps the whole engine regardless of batch size. The
+contract used to live in comments only; here it is machine-checked for
+every function annotated hot (``# swarmlint: hot`` or an ``@hot``
+decorator).
+
+- SWL101: calls that ARE a host sync — ``jax.device_get``,
+  ``jax.block_until_ready``, ``<x>.block_until_ready()``. Flagged
+  unconditionally inside hot functions (the engine's one sanctioned sync
+  carries an inline ``disable`` with its justification).
+- SWL102: host materialization of a *device* value — ``.item()`` /
+  ``.tolist()`` / ``np.asarray`` / ``np.array`` / ``jnp.asarray`` /
+  ``jax.device_put`` / ``float()`` / ``int()`` — flagged only when the
+  operand is device-tainted: assigned from a ``jax.*``/``jnp.*`` call or a
+  known jit-wrapped callable in the same function, or a ``self.<attr>``
+  declared ``# swarmlint: device-state``. Plain numpy-on-host work (the
+  admission path builds its dispatch arguments with numpy on purpose —
+  the transfer rides the jit call) is NOT flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name, make_finding
+
+SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+MATERIALIZE_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jnp.asarray", "jax.device_put", "float", "int",
+}
+MATERIALIZE_METHODS = {"item", "tolist"}
+# call results that produce device values (taint sources)
+DEVICE_PREFIXES = ("jax.", "jnp.", "jax.numpy.")
+# call results that are explicitly host-side (taint sinks)
+HOST_CALLS = {"jax.device_get", "np.asarray", "np.array", "numpy.asarray",
+              "numpy.array"}
+
+
+def _collect_jitted_names(tree: ast.Module) -> Set[str]:
+    """Last-segment names of callables wrapped by jax.jit/pmap/shard_map
+    anywhere in the module — calling one returns device arrays."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        name = dotted_name(node.value)
+        if name is None:
+            continue
+        last = name.split(".")[-1]
+        if last in ("jit", "pmap", "shard_map"):
+            for tgt in node.targets:
+                tname = dotted_name(tgt)
+                if tname:
+                    out.add(tname.split(".")[-1])
+    return out
+
+
+def _device_state_of(src: SourceFile) -> Dict[ast.ClassDef, Set[str]]:
+    out: Dict[ast.ClassDef, Set[str]] = {}
+    for line, names in src.directives.device_state:
+        cls = src.enclosing_scope(line, classes_only=True)
+        if isinstance(cls, ast.ClassDef):
+            out.setdefault(cls, set()).update(names)
+    return out
+
+
+class _Taint:
+    """Flow-insensitive per-function taint: names assigned from device-
+    producing calls are device values; names assigned from device_get /
+    np.asarray are host values (host wins — de-tainting is explicit)."""
+
+    def __init__(self, fn: ast.AST, jitted: Set[str],
+                 device_attrs: Set[str]) -> None:
+        self.device: Set[str] = set()
+        self.host: Set[str] = set()
+        self.device_attrs = device_attrs
+        self.jitted = jitted
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                names = []
+                for t in targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    names.extend(e.id for e in elts
+                                 if isinstance(e, ast.Name))
+                if self._is_host_producer(value):
+                    self.host.update(names)
+                elif self._is_device_producer(value):
+                    self.device.update(names)
+
+    def _call_name(self, node: ast.AST) -> Optional[str]:
+        return dotted_name(node) if isinstance(node, ast.Call) else None
+
+    def _is_host_producer(self, value: ast.AST) -> bool:
+        return self._call_name(value) in HOST_CALLS
+
+    def _is_device_producer(self, value: ast.AST) -> bool:
+        name = self._call_name(value)
+        if name is None:
+            return False
+        if name in HOST_CALLS:
+            return False
+        if name.startswith(DEVICE_PREFIXES):
+            return True
+        return name.split(".")[-1] in self.jitted
+
+    def tainted(self, expr: ast.AST) -> bool:
+        """Is ``expr`` plausibly a device value?"""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.host:
+                return False
+            return expr.id in self.device
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return expr.attr in self.device_attrs
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self.tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            return self._is_device_producer(expr)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.tainted(e) for e in expr.elts)
+        return False
+
+
+def check(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted = _collect_jitted_names(src.tree)
+    device_state = _device_state_of(src)
+
+    # (hot function, enclosing class) pairs, hotness propagated into
+    # nested defs
+    hot_fns: List[Tuple[ast.AST, Optional[ast.ClassDef]]] = []
+
+    def visit(node: ast.AST, hot: bool, cls: Optional[ast.ClassDef]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, hot, child)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_hot = hot or src.is_hot(child)
+                if child_hot:
+                    hot_fns.append((child, cls))
+                visit(child, child_hot, cls)
+            else:
+                visit(child, hot, cls)
+
+    visit(src.tree, False, None)
+
+    seen_lines: Set[int] = set()
+    for fn, cls in hot_fns:
+        attrs = device_state.get(cls, set()) if cls is not None else set()
+        taint = _Taint(fn, jitted, attrs)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen_lines:
+                continue
+            name = dotted_name(node.func)
+            if name in SYNC_CALLS:
+                seen_lines.add(key)
+                findings.append(make_finding(
+                    src, "SWL101", node,
+                    f"`{name}` blocks on the device inside hot function "
+                    f"`{fn.name}` — every sync here serializes the decode "
+                    f"pipeline"))
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                seen_lines.add(key)
+                findings.append(make_finding(
+                    src, "SWL101", node,
+                    f"`.block_until_ready()` inside hot function "
+                    f"`{fn.name}` blocks the decode pipeline"))
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in MATERIALIZE_METHODS
+                    and taint.tainted(node.func.value)):
+                seen_lines.add(key)
+                findings.append(make_finding(
+                    src, "SWL102", node,
+                    f"`.{node.func.attr}()` on a device value inside hot "
+                    f"function `{fn.name}` forces a host transfer"))
+                continue
+            if (name in MATERIALIZE_CALLS and node.args
+                    and taint.tainted(node.args[0])):
+                seen_lines.add(key)
+                findings.append(make_finding(
+                    src, "SWL102", node,
+                    f"`{name}(...)` materializes a device value on the "
+                    f"host inside hot function `{fn.name}`"))
+    return findings

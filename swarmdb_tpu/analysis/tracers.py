@@ -1,0 +1,146 @@
+"""tracer-leak check (SWL401).
+
+A function traced by ``jax.jit`` / ``shard_map`` / ``jax.lax.scan`` runs
+with abstract tracers, not arrays. Storing a traced value onto ``self``,
+a global, or a nonlocal smuggles the tracer out of the trace: the store
+happens once at trace time (not per call), the leaked object escapes into
+host state, and the next use raises a leaked-tracer error at a line far
+from the cause — or worse, silently pins stale trace-time values.
+
+Detection is structural: a function counts as traced if it is
+
+- decorated with ``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)``
+  / ``pmap``, or
+- passed (directly, or through ``functools.partial``) to ``jax.jit``,
+  ``pmap``, ``shard_map``, ``jax.lax.scan`` / ``while_loop`` / ``cond``
+  / ``fori_loop`` anywhere in the module, or
+- nested inside a traced function (inner defs trace with the outer).
+
+Inside traced functions, findings are: assignments to ``self.<attr>``,
+and assignments to names declared ``global`` or ``nonlocal`` in that
+function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .core import Finding, SourceFile, dotted_name, make_finding
+
+WRAPPERS = {"jit", "pmap", "shard_map"}
+# callable-position args of jax.lax control-flow combinators
+LAX_COMBINATORS = {
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "fori_loop": (2,),
+    "switch": None,  # every arg past the index may be a branch callable
+}
+
+
+def _callee_names(call: ast.Call) -> List[str]:
+    """Names of function objects this call traces (unwraps partial)."""
+
+    def unwrap(node: ast.AST) -> List[str]:
+        if isinstance(node, ast.Name):
+            return [node.id]
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            if fname.split(".")[-1] == "partial" and node.args:
+                return unwrap(node.args[0])
+        return []
+
+    name = dotted_name(call.func)
+    if name is None:
+        return []
+    last = name.split(".")[-1]
+    out: List[str] = []
+    if last in WRAPPERS and call.args:
+        out.extend(unwrap(call.args[0]))
+    elif last in LAX_COMBINATORS and name.split(".")[0] in ("jax", "lax"):
+        positions = LAX_COMBINATORS[last]
+        if positions is None:
+            positions = range(1, len(call.args))
+        for pos in positions:
+            if pos < len(call.args):
+                out.extend(unwrap(call.args[pos]))
+    return out
+
+
+def _is_traced_decorator(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name and name.split(".")[-1] in ("jit", "pmap"):
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func) or ""
+        if fname.split(".")[-1] == "partial" and dec.args:
+            inner = dotted_name(dec.args[0])
+            return bool(inner) and inner.split(".")[-1] in ("jit", "pmap")
+    return False
+
+
+def check(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+
+    traced_names: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            traced_names.update(_callee_names(node))
+
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    roots: List[ast.AST] = []
+    seen: Set[int] = set()
+    for name in traced_names:
+        for fn in defs.get(name, []):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                roots.append(fn)
+    for fns in defs.values():
+        for fn in fns:
+            if id(fn) in seen:
+                continue
+            if any(_is_traced_decorator(d) for d in fn.decorator_list):
+                seen.add(id(fn))
+                roots.append(fn)
+
+    for root in roots:
+        _check_traced_fn(src, root, findings)
+    return findings
+
+
+def _check_traced_fn(src: SourceFile, fn: ast.AST,
+                     findings: List[Finding]) -> None:
+    escaping: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            escaping.update(node.names)
+
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for e in elts:
+                if (isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self"):
+                    findings.append(make_finding(
+                        src, "SWL401", e,
+                        f"store to `self.{e.attr}` inside traced function "
+                        f"`{fn.name}` — runs once at trace time and leaks "
+                        f"a tracer into host state"))
+                elif isinstance(e, ast.Name) and e.id in escaping:
+                    findings.append(make_finding(
+                        src, "SWL401", e,
+                        f"store to global/nonlocal `{e.id}` inside traced "
+                        f"function `{fn.name}` leaks a tracer out of the "
+                        f"trace"))

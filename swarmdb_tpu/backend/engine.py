@@ -146,6 +146,11 @@ class PagedKV:
 class Engine:
     """Slot-based continuous batching over a jitted decode step."""
 
+    # Cross-thread / device-state contracts, machine-checked by swarmlint
+    # (python -m swarmdb_tpu.analysis — see analysis/ and README):
+    # swarmlint: guarded-by[self._cv]: _queue, _admitting, _cancel_pending, _stop
+    # swarmlint: device-state: _last_tokens, _last_lps, cache, base_keys
+
     def __init__(
         self,
         forward_fn: Callable,            # forward(params, tokens, positions, cache)
@@ -858,7 +863,7 @@ class Engine:
         return (jax.lax.with_sharding_constraint(all_toks, rep),
                 jax.lax.with_sharding_constraint(all_lps, rep))
 
-    def _mirrored(self, call_id: int, *args) -> None:
+    def _mirrored(self, call_id: int, *args) -> None:  # swarmlint: hot
         """Publish (pod mode) then execute one mirrored device call.
         Publish FIRST, matching the decode/prefill pattern: if the local
         execution raises, the pod is already failing loudly through the
@@ -867,6 +872,7 @@ class Engine:
             self._mh.publish_call(call_id, args)
         self._MH_CALLS[call_id](self, *args)
 
+    # swarmlint: hot
     def _call_paged_prefill(self, tokens, lengths, target, scatter, keys,
                             temp, topk, topp) -> None:
         k_pool, v_pool, self._last_tokens, self._last_lps = \
@@ -877,6 +883,7 @@ class Engine:
             )
         self.cache = self._paged_cache_with(k_pool, v_pool)
 
+    # swarmlint: hot
     def _call_paged_prefill_packed(self, tokens, lengths, target, scatter,
                                    keys, temp, topk, topp) -> None:
         k_pool, v_pool, self._last_tokens, self._last_lps = \
@@ -887,6 +894,7 @@ class Engine:
             )
         self.cache = self._paged_cache_with(k_pool, v_pool)
 
+    # swarmlint: hot
     def _call_paged_prefix_prefill(self, tokens, lengths, plens, table,
                                    target, scatter, keys, temp, topk,
                                    topp) -> None:
@@ -898,6 +906,7 @@ class Engine:
             )
         self.cache = self._paged_cache_with(pk, pv)
 
+    # swarmlint: hot
     def _call_paged_resume_prefill(self, tokens, lengths, rlens, table,
                                    row_tables, scatter, keys, temp, topk,
                                    topp) -> None:
@@ -909,12 +918,14 @@ class Engine:
             )
         self.cache = self._paged_cache_with(pk, pv)
 
+    # swarmlint: hot
     def _call_set_pt_rows(self, rows, vals) -> None:
         from ..ops.paged_kv import set_page_table_rows
 
         self.cache["page_table"] = set_page_table_rows(
             self.cache["page_table"], rows, vals)
 
+    # swarmlint: hot
     def _call_dense_prefix_prefill(self, tokens, lengths, plens, table,
                                    reg_cols, reg_pages, scatter, keys,
                                    temp, topk, topp) -> None:
@@ -1470,7 +1481,7 @@ class Engine:
 
     # ------------------------------------------------------------- the loop
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # swarmlint: hot
         in_flight: List[Tuple[Any, List[Tuple[int, GenRequest, int]]]] = []
         while True:
             with self._cv:
@@ -1547,7 +1558,7 @@ class Engine:
     def _any_active(self) -> bool:
         return any(s.active for s in self.slots)
 
-    def _free_slot_ids(self) -> List[int]:
+    def _free_slot_ids(self) -> List[int]:  # swarmlint: hot
         free = [i for i, s in enumerate(self.slots) if not s.active]
         if (free and self.paged is not None
                 and getattr(self.paged.allocator, "n_shards", 1) > 1):
@@ -1573,7 +1584,7 @@ class Engine:
 
     # ------------------------------------------------------------- admission
 
-    def _admit(self) -> None:
+    def _admit(self) -> None:  # swarmlint: hot
         """Move queued requests into free slots (highest priority first) and
         run their prefill in groups of up to ``prefill_batch``.
 
@@ -1993,6 +2004,7 @@ class Engine:
             return alloc.allocate_with_prefix(slot_id, hits, n_fresh)
         return alloc.allocate(slot_id, n_fresh)
 
+    # swarmlint: hot
     def _prefill_paged_prefix_batch(self, batch: List[Tuple], bucket: int,
                                     ppb: int) -> None:
         """Paged-pool prefix prefill: gather reused pages in place, forward
@@ -2058,6 +2070,7 @@ class Engine:
         self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
         self._activate([(s, r) for s, r, _, _ in batch], t0)
 
+    # swarmlint: hot
     def _prefill_paged_resume_batch(self, batch: List[Tuple], bucket: int,
                                     ppb: int) -> None:
         """One fused suffix prefill CONTINUING kept conversations
@@ -2097,6 +2110,7 @@ class Engine:
         self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
         self._activate([(s, r) for s, r, _ in batch], t0)
 
+    # swarmlint: hot
     def _prefix_fused_dispatch(self, rows, bucket: int, ppb: int,
                                t0: float) -> None:
         """Shared array build + dispatch for the dense prefix-path
@@ -2143,6 +2157,7 @@ class Engine:
         self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
         self._activate([(r[0], r[1]) for r in rows], t0)
 
+    # swarmlint: hot
     def _prefill_dense_resume_batch(self, batch, bucket: int,
                                     ppb: int) -> None:
         """Dense rolling resume: gather each row's KEPT prefix-pool pages,
@@ -2159,6 +2174,7 @@ class Engine:
             bucket, ppb, time.time(),
         )
 
+    # swarmlint: hot
     def _prefill_prefix_batch(self, batch, bucket: int,
                               ppb: int) -> None:
         """One fused suffix prefill for a group of admissions sharing a
@@ -2196,7 +2212,7 @@ class Engine:
         for rec in reg_records:
             self._prefix.register(*rec)
 
-    def _prefill_batch(self, batch: List[Tuple[int, GenRequest]]) -> None:
+    def _prefill_batch(self, batch: List[Tuple[int, GenRequest]]) -> None:  # swarmlint: hot
         """One compiled prefill for up to ``prefill_batch`` admissions.
 
         The call is padded to the fixed [Bp, bucket] shape (one compiled
@@ -2307,7 +2323,7 @@ class Engine:
         )
         self._activate(batch, t0)
 
-    def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:
+    def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:  # swarmlint: hot
         for slot_id, req in batch:
             slot = self.slots[slot_id]
             slot.active = True
@@ -2340,7 +2356,7 @@ class Engine:
 
     # --------------------------------------------------------------- decode
 
-    def _dispatch_decode(self):
+    def _dispatch_decode(self):  # swarmlint: hot
         """Issue one K-step decode chunk (NO host sync) and return
         (device token block, snapshot) for later processing.
 
@@ -2379,6 +2395,7 @@ class Engine:
             )
         return all_toks, all_lps, snapshot
 
+    # swarmlint: hot
     def _process_block(self, all_toks, all_lps, snapshot) -> None:
         """Fetch one dispatched chunk's [K+1, B] token block (+ matching
         raw-model logprobs) with the one host sync and emit its tokens.
@@ -2387,6 +2404,8 @@ class Engine:
         emission stops at a slot's EOS / max_new_tokens / max_seq and the
         remainder of its lane is discarded garbage.
         """
+        # everything else in the hot path rides jit dispatches; this is
+        # swarmlint: disable=SWL101 -- THE one sanctioned sync per chunk
         block, lps = jax.device_get((all_toks, all_lps))
         block = np.asarray(block)
         lps = np.asarray(lps)
@@ -2417,6 +2436,7 @@ class Engine:
             if s.active:
                 s.position = pos0 + K
 
+    # swarmlint: hot
     def _emit_token(self, slot_id: int, token: int,
                     now: Optional[float] = None,
                     logprob: Optional[float] = None) -> None:
@@ -2449,7 +2469,7 @@ class Engine:
         if finished_reason is not None:
             self._retire(slot_id, finished_reason)
 
-    def _retire(self, slot_id: int, reason: str) -> None:
+    def _retire(self, slot_id: int, reason: str) -> None:  # swarmlint: hot
         slot = self.slots[slot_id]
         req = slot.request
         slot.active = False
@@ -2510,6 +2530,7 @@ class Engine:
             except Exception:
                 logger.exception("on_done callback failed")
 
+    # swarmlint: hot
     def _dense_keep_extract(self, slot_id: int, slot: _Slot,
                             req: GenRequest) -> None:
         """Dense rolling-KV retirement (see _extract_lane in __init__):
@@ -2616,10 +2637,14 @@ class Engine:
     # ------------------------------------------------------------------ info
 
     def stats(self) -> Dict[str, Any]:
+        # caught by swarmlint SWL301 on landing the guard declarations:
+        # len() of a mutating heap from outside the engine lock
+        with self._cv:
+            queued = len(self._queue)
         out = {
             "active_slots": sum(1 for s in self.slots if s.active),
             "max_batch": self.max_batch,
-            "queued": len(self._queue),
+            "queued": queued,
             "total_requests": self.total_requests,
             "total_generated": self.total_generated,
             "tokens_per_sec_60s": self.metrics.rates["tokens_generated"].rate(),

@@ -177,6 +177,7 @@ class Producer:
     def __init__(self, broker: Broker) -> None:
         self._broker = broker
         self._pending: List[Tuple[DeliveryCallback, Optional[str], Record]] = []
+        # swarmlint: guarded-by[self._pending_lock]: _pending
         self._pending_lock = threading.Lock()
         # serializes whole poll() invocations: two concurrent pollers (the
         # runtime's delivery-poll thread + send_message's inline poll) could

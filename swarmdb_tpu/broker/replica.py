@@ -32,6 +32,16 @@ config is single-broker — ` main.py:115-124` — so leader election parity
 is out of scope): on leader loss, point the runtime at the follower's
 log directory; every DELIVERED message is in it, fsynced.
 
+What failover does NOT preserve: only the record log is replicated.
+Consumer-group committed offsets (``commit_offset``) and retention trims
+(``trim_older_than``) are leader-local and never cross the stream, so a
+manual failover resets every consumer group to the log beginning — each
+group re-reads (and the runtime re-delivers) history it had already
+consumed — and the follower's log may retain records the leader had
+already trimmed. Consumers must be idempotent across a failover, or the
+operator must re-seed group offsets by hand before pointing traffic at
+the follower.
+
 Resync: on (re)connect the leader streams from the follower's end
 offset. If retention trimming has advanced the leader's begin offset
 past it, that partition can no longer be mirrored contiguously — the
@@ -124,6 +134,15 @@ class ReplicaServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
+        # single-active-leader (ADVICE r5 #1): the one connection allowed
+        # to mirror records. A second accept while one leader streams is
+        # split-brain or a leader restart racing its old socket — either
+        # way last-writer-wins: the NEW connection supersedes and the old
+        # stream is closed before the new hello snapshots local ends, so
+        # two leaders can never interleave appends into the mirror.
+        self._conn_lock = threading.Lock()
+        # swarmlint: guarded-by[self._conn_lock]: _active_conn
+        self._active_conn: Optional[socket.socket] = None
 
     def start(self) -> "ReplicaServer":
         t = threading.Thread(target=self._accept_loop, daemon=True,
@@ -161,7 +180,25 @@ class ReplicaServer:
             # REUSEADDR on the accepted socket too: its eventual TIME_WAIT
             # must not block a restarted server's bind on this port
             conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            self._conns.append(conn)
+            with self._conn_lock:
+                stale = self._active_conn
+                self._active_conn = conn
+                self._conns.append(conn)
+            if stale is not None:
+                # last-writer-wins BEFORE the new _serve thread sends its
+                # hello: the stale _serve's next recv fails, so its append
+                # stream is dead by the time the new leader's cursor is
+                # anchored on the follower's end offsets
+                logger.warning(
+                    "replica: new leader connection from %s supersedes an "
+                    "active stream — closing the stale one "
+                    "(single-active-leader)", addr)
+                for op in (lambda: stale.shutdown(socket.SHUT_RDWR),
+                           stale.close):
+                    try:
+                        op()
+                    except OSError:
+                        pass
             logger.info("replica: leader connected from %s", addr)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True, name="swarmdb-replica-conn")
@@ -178,7 +215,9 @@ class ReplicaServer:
         return ends
 
     def _serve(self, conn: socket.socket) -> None:
-        appended: Dict[Tuple[str, int], int] = {}  # tp -> mirrored end
+        # tp -> mirrored end; shared with ack_loop (its own thread)
+        # swarmlint: guarded-by[lock]: appended
+        appended: Dict[Tuple[str, int], int] = {}
         acked: Dict[Tuple[str, int], int] = {}
         lock = threading.Lock()
         done = threading.Event()
@@ -255,11 +294,19 @@ class ReplicaServer:
                     # connection, not per record (review r5 #4: the
                     # per-record query serialized catch-up against the
                     # follower's own group-commit flusher)
-                    end = appended.get((topic, part))
+                    with lock:
+                        end = appended.get((topic, part))
                     if end is None:
                         end = self.broker.end_offset(topic, part)
                     if offset < end:
-                        continue  # duplicate after reconnect — already have
+                        # duplicate after reconnect — already have it.
+                        # Seed the tracked map FIRST (ADVICE r5 #3): a
+                        # duplicate BURST otherwise re-queries end_offset
+                        # under the broker lock once per record, exactly
+                        # the serialization the map exists to avoid.
+                        with lock:
+                            appended[(topic, part)] = end
+                        continue
                     if offset > end:
                         # contiguity broken (leader bug or operator error:
                         # follower dir not seeded from this leader) — stop
@@ -301,10 +348,13 @@ class ReplicaServer:
             # prune this connection's bookkeeping: a flapping leader
             # reconnects every _RECONNECT_S, and append-only lists would
             # accrete dead sockets/threads without bound
-            try:
-                self._conns.remove(conn)
-            except ValueError:
-                pass
+            with self._conn_lock:
+                if self._active_conn is conn:
+                    self._active_conn = None
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
             cur = threading.current_thread()
             self._threads = [t for t in self._threads
                              if t.is_alive() and t is not cur]
@@ -317,7 +367,10 @@ class Replicator:
         self.broker = broker
         host, _, port = target.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port))
-        self.acked: Dict[Tuple[str, int], int] = {}  # tp -> follower durable
+        # tp -> follower durable end, written by recv_acks / clamped at
+        # reconnect under the condition below
+        # swarmlint: guarded-by[self._cv]: acked
+        self.acked: Dict[Tuple[str, int], int] = {}
         self.gapped: set = set()
         self.connected = threading.Event()
         self._cv = threading.Condition()
@@ -339,6 +392,8 @@ class Replicator:
     def acked_offset(self, topic: str, part: int) -> int:
         if (topic, part) in self.gapped:
             return 0
+        # benign racy read of a watermark — a stale value only delays a
+        # swarmlint: disable=SWL301 -- delivery report by one poll tick
         return self.acked.get((topic, part), 0)
 
     def wait_acked(self, topic: str, part: int, offset: int,

@@ -128,6 +128,7 @@ class SwarmDB:
         self._ensure_topics_exist()
 
         self._lock = threading.RLock()
+        # swarmlint: guarded-by[self._lock]: registered_agents, messages, agent_inbox, _conversations, message_count, _stats_by_type, _stats_by_status, _stats_by_agent
         self.registered_agents: Set[str] = set()
         self.consumers: Dict[str, Consumer] = {}
         self.messages: Dict[str, Message] = {}
@@ -695,6 +696,7 @@ class SwarmDB:
 
     # ------------------------------------------------------------- status mgmt
 
+    # swarmlint: holds[self._lock]
     def _set_status(self, msg: Message, status: MessageStatus) -> None:
         """Single choke-point for status transitions; keeps incremental
         by-status counters consistent."""
@@ -922,6 +924,7 @@ class SwarmDB:
 
     # ------------------------------------------------------------------ stats
 
+    # swarmlint: holds[self._lock]
     def _stats_record_new(self, msg: Message) -> None:
         self._stats_by_type[msg.type.value] = self._stats_by_type.get(msg.type.value, 0) + 1
         self._stats_by_status[msg.status.value] = (
@@ -935,6 +938,7 @@ class SwarmDB:
             )
             recv["received"] += 1
 
+    # swarmlint: holds[self._lock]
     def _stats_record_removed(self, msg: Message) -> None:
         self._stats_by_type[msg.type.value] = max(
             0, self._stats_by_type.get(msg.type.value, 0) - 1
@@ -950,6 +954,7 @@ class SwarmDB:
             if recv is not None:
                 recv["received"] = max(0, recv["received"] - 1)
 
+    # swarmlint: holds[self._lock]
     def _rebuild_stats(self) -> None:
         self._stats_by_type = {}
         self._stats_by_status = {}

@@ -1,0 +1,47 @@
+"""Negative fixture: idiomatic hot-path / guarded / traced code that must
+produce ZERO swarmlint findings (asserted by test_swarmlint.py)."""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+step = jax.jit(lambda x: x + 1)
+
+
+def admit(batch):  # swarmlint: hot
+    # numpy on HOST data is the idiom (the transfer rides the dispatch)
+    rows = np.zeros((len(batch), 8), np.int32)
+    for i, item in enumerate(batch):
+        rows[i, : len(item)] = item
+    return step(rows)
+
+
+def fetch_block(device_block):
+    """Not annotated hot — the sanctioned sync point."""
+    block = jax.device_get(device_block)
+    return np.asarray(block)
+
+
+class GuardedCounter:
+    # swarmlint: guarded-by[self._mu]: _count
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._mu:
+            self._count += 1
+
+    def snapshot(self):
+        with self._mu:
+            return self._count
+
+
+def traced_pipeline(tokens):
+    def body(carry, tok):
+        return carry + tok, carry
+
+    total, prefix = jax.lax.scan(body, jnp.int32(0), tokens)
+    return total, prefix

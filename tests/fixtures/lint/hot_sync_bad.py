@@ -1,0 +1,42 @@
+"""Seeded host-sync violations (swarmlint fixture — never imported).
+
+Each violating line carries an ``# EXPECT: <rule>`` annotation consumed
+by tests/test_swarmlint.py, which asserts swarmlint reports exactly the
+annotated (line, rule) pairs — no more, no fewer.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+decode_step = jax.jit(lambda p, t: p @ t)
+
+
+def dispatch_chunk(params, tokens):  # swarmlint: hot
+    logits = jnp.dot(params, tokens)
+    jax.block_until_ready(logits)  # EXPECT: SWL101
+    host = jax.device_get(logits)  # EXPECT: SWL101
+    logits.block_until_ready()  # EXPECT: SWL101
+    top = logits.item()  # EXPECT: SWL102
+    arr = np.asarray(logits)  # EXPECT: SWL102
+    scalar = float(logits)  # EXPECT: SWL102
+    block = decode_step(params, tokens)
+    rows = np.asarray(block)  # EXPECT: SWL102
+    fine = np.asarray(host)  # clean: host came from device_get
+    return top, arr, scalar, rows, fine
+
+
+class HotEngine:
+    # swarmlint: device-state: _last_tokens
+
+    def __init__(self, last_tokens):
+        self._last_tokens = last_tokens
+
+    # swarmlint: hot
+    def emit(self):
+        toks = np.asarray(self._last_tokens)  # EXPECT: SWL102
+        return toks.tolist()
+
+
+def cold_path(dev):
+    """Not annotated hot: syncs here are deliberate and unflagged."""
+    return jax.device_get(dev)

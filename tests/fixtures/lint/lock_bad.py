@@ -1,0 +1,52 @@
+"""Seeded lock-discipline violations (swarmlint fixture — never
+imported). ``# EXPECT`` annotations are asserted by test_swarmlint.py."""
+import threading
+
+
+class SlotQueue:
+    # swarmlint: guarded-by[self._mu]: _items, _closed
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = []                 # fine: constructor carve-out
+        self._closed = False
+
+    def put(self, item):
+        with self._mu:
+            if not self._closed:
+                self._items.append(item)
+
+    def size(self):
+        return len(self._items)  # EXPECT: SWL301
+
+    def close(self):
+        self._closed = True  # EXPECT: SWL301
+
+    # swarmlint: holds[self._mu]
+    def _drain_locked(self):
+        out, self._items = self._items, []   # fine: caller holds the lock
+        return out
+
+    def spawn_worker(self):
+        def worker():
+            # a closure runs on its own thread: the enclosing method's
+            # lock (if any) is NOT held here
+            return list(self._items)  # EXPECT: SWL301
+        with self._mu:
+            t = threading.Thread(target=worker)
+        return t
+
+
+def local_guard():
+    lock = threading.Lock()
+    # swarmlint: guarded-by[lock]: pending
+    pending = []
+
+    def consume():
+        with lock:
+            return list(pending)         # fine: under the declared guard
+
+    def produce(x):
+        pending.append(x)  # EXPECT: SWL301
+
+    return consume, produce

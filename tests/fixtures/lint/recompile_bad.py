@@ -1,0 +1,40 @@
+"""Seeded recompile-hazard violations (swarmlint fixture — never
+imported). ``# EXPECT`` annotations are asserted by test_swarmlint.py."""
+import jax
+import numpy as np
+
+decode = jax.jit(lambda x: x * 2)                    # fine: module scope
+bucketed = jax.jit(lambda x, b: x[:b], static_argnums=(1,))
+
+
+def serve(xs):
+    for x in xs:
+        f = jax.jit(lambda v: v + 1)  # EXPECT: SWL201
+        f(x)
+
+
+def hot_dispatch(x):  # swarmlint: hot
+    g = jax.jit(lambda v: v * 3)  # EXPECT: SWL201
+    return g(x)
+
+
+def call_sites(xs, n):
+    bucketed(xs, n)  # EXPECT: SWL202
+    bucketed(xs, 256)                                # fine: constant static
+    decode(f"shape-{n}")  # EXPECT: SWL202
+    decode(len(xs))  # EXPECT: SWL202
+    decode(xs)                                       # fine: array argument
+
+
+class MiniEngine:
+    """Warmup covers `_decode` but not `_prefill`: the static twin of the
+    precompile drift test must flag the gap."""
+
+    def __init__(self):
+        self._decode = jax.jit(lambda x: x)
+        self._prefill = jax.jit(lambda x: x + 1)  # EXPECT: SWL203
+        self._variants = (self._decode,)
+
+    def warmup(self):
+        for fn in self._variants:
+            fn(np.zeros(4, np.int32))

@@ -1,0 +1,45 @@
+"""Pre-fix snapshot of broker/replica.py's ``_serve`` loop (seed-era
+shape, before the ISSUE-1 satellites landed): the mirror-position read of
+``appended`` happened OUTSIDE the lock that ``ack_loop`` — running on its
+own thread — takes to snapshot the same map, and the duplicate-skip
+``continue`` never seeded the map (ADVICE r5 #3). This fixture pins the
+lock-discipline half: swarmlint must re-detect the unguarded read, proving
+the checker would have caught the original finding before review did.
+
+Never imported; ``# EXPECT`` annotations asserted by test_swarmlint.py.
+"""
+import threading
+
+
+class ReplicaServeSnapshot:
+    def _serve(self, conn):
+        # swarmlint: guarded-by[lock]: appended
+        appended = {}
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def ack_loop():
+            # runs on its own thread; correctly takes the lock
+            while not done.is_set():
+                with lock:
+                    ends = dict(appended)
+                self._push_acks(conn, ends)
+                done.wait(0.002)
+
+        threading.Thread(target=ack_loop, daemon=True).start()
+        while True:
+            topic, part, offset, value = self._next_record(conn)
+            # PRE-FIX: mirror-position read outside the lock ack_loop
+            # snapshots under — the ADVICE r5 lock-discipline finding
+            end = appended.get((topic, part))  # EXPECT: SWL301
+            if end is None:
+                end = self.broker.end_offset(topic, part)
+            if offset < end:
+                # PRE-FIX: duplicate burst never seeds the map, so every
+                # duplicate re-queries end_offset under the broker lock
+                continue
+            got = self.broker.append(topic, part, value)
+            if got != offset:
+                raise RuntimeError("mirror divergence")
+            with lock:
+                appended[(topic, part)] = offset + 1

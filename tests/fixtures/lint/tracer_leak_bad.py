@@ -1,0 +1,39 @@
+"""Seeded tracer-leak violations (swarmlint fixture — never imported).
+``# EXPECT`` annotations are asserted by test_swarmlint.py."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+STEP_COUNT = 0
+
+
+def make_step(scale):
+    def _decode(state, tokens):
+        out = jnp.sum(tokens) * scale
+        global STEP_COUNT
+        STEP_COUNT = out  # EXPECT: SWL401
+        return state + out
+
+    return jax.jit(functools.partial(_decode, 0))
+
+
+def chunked(tokens):
+    def body(carry, tok):
+        global STEP_COUNT
+        STEP_COUNT += 1  # EXPECT: SWL401
+        return carry + tok, tok
+
+    return jax.lax.scan(body, 0, tokens)
+
+
+class KVCache:
+    @jax.jit
+    def update(self, pool, new_kv):
+        self.last_kv = new_kv  # EXPECT: SWL401
+        return pool.at[0].set(new_kv)
+
+    def read(self):
+        # not traced: host-side stores are fine
+        self.reads = getattr(self, "reads", 0) + 1
+        return self.last_kv

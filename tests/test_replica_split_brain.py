@@ -1,0 +1,120 @@
+"""Single-active-leader on the follower (ADVICE r5 #1 / ISSUE 1
+satellite): two simultaneous leader connections — split-brain, or a
+restarted leader racing its not-yet-dead old socket — must never
+interleave appends into the mirror. The ReplicaServer tracks the active
+mirroring connection and closes the stale stream on a new accept
+(last-writer-wins), BEFORE the new hello anchors the mirror cursor.
+
+Speaks the wire protocol over raw sockets against a LocalBroker-backed
+ReplicaServer (no native library needed), exactly like a leader would.
+"""
+
+import json
+import socket
+import time
+
+from swarmdb_tpu.broker.base import BrokerError
+from swarmdb_tpu.broker.local import LocalBroker
+from swarmdb_tpu.broker.replica import (_LEN, _REC_HDR, ReplicaServer,
+                                        _recv_exact)
+
+
+def _connect_and_hello(server):
+    sock = socket.create_connection((server.host, server.port), timeout=5)
+    sock.settimeout(5)
+    assert _recv_exact(sock, 1) == b"H"
+    (jlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    ends = json.loads(_recv_exact(sock, jlen))
+    return sock, ends
+
+
+def _send_topic(sock, name, parts=1):
+    spec = json.dumps({"name": name, "parts": parts}).encode()
+    sock.sendall(b"T" + _LEN.pack(len(spec)) + spec)
+
+
+def _send_record(sock, topic, part, offset, value):
+    t = topic.encode()
+    sock.sendall(b"R"
+                 + _REC_HDR.pack(len(t), part, offset, time.time(), -1,
+                                 len(value))
+                 + t + value)
+
+
+def _end_offset(broker, topic, part):
+    try:
+        return broker.end_offset(topic, part)
+    except BrokerError:
+        return 0
+
+
+def test_second_leader_supersedes_stale_stream():
+    broker = LocalBroker()
+    server = ReplicaServer(broker).start()
+    try:
+        stale, _ = _connect_and_hello(server)
+        fresh, _ = _connect_and_hello(server)
+
+        # the server closed the superseded stream: the stale socket sees
+        # EOF (or a reset) instead of hanging as a second live mirror
+        closed = False
+        deadline = time.time() + 5
+        while time.time() < deadline and not closed:
+            try:
+                closed = stale.recv(4096) == b""
+            except OSError:
+                closed = True
+        assert closed, "stale leader stream was not closed on a new accept"
+
+        # records on the stale socket must never land in the mirror
+        try:
+            _send_topic(stale, "ghost")
+            _send_record(stale, "ghost", 0, 0, b"from-the-dead")
+        except OSError:
+            pass  # already unreachable — even better
+        # the fresh stream still mirrors normally
+        _send_topic(fresh, "t")
+        _send_record(fresh, "t", 0, 0, b"alive")
+        deadline = time.time() + 5
+        while time.time() < deadline and _end_offset(broker, "t", 0) < 1:
+            time.sleep(0.01)
+        assert _end_offset(broker, "t", 0) == 1
+        assert [r.value for r in broker.fetch("t", 0, 0, 10)] == [b"alive"]
+        time.sleep(0.1)  # give any ghost append a beat to (not) land
+        assert "ghost" not in broker.list_topics()
+    finally:
+        server.stop()
+        broker.close()
+
+
+def test_flapping_leader_reconnect_keeps_mirroring():
+    """A leader restart reuses the listener: each reconnect supersedes the
+    previous stream and the mirror cursor stays contiguous."""
+    broker = LocalBroker()
+    server = ReplicaServer(broker).start()
+    socks = []
+    try:
+        offset = 0
+        for round_no in range(3):
+            sock, ends = _connect_and_hello(server)
+            socks.append(sock)
+            assert int(ends.get("t", {}).get("0", 0)) == offset
+            _send_topic(sock, "t")
+            for _ in range(4):
+                _send_record(sock, "t", 0, offset, b"m%d" % offset)
+                offset += 1
+            deadline = time.time() + 5
+            while (time.time() < deadline
+                   and _end_offset(broker, "t", 0) < offset):
+                time.sleep(0.01)
+            assert _end_offset(broker, "t", 0) == offset
+        values = [r.value for r in broker.fetch("t", 0, 0, 100)]
+        assert values == [b"m%d" % i for i in range(offset)]
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        server.stop()
+        broker.close()

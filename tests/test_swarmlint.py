@@ -1,0 +1,127 @@
+"""swarmlint self-tests (ISSUE 1 tentpole).
+
+Each check family must detect its seeded fixture violation with the right
+rule id on the right line (``# EXPECT: <rule>`` annotations in
+tests/fixtures/lint/), the clean fixture must be clean, suppression and
+baseline machinery must round-trip, and — the CI contract — the package
+tree itself must be clean against the committed ``analysis/baseline.json``.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from swarmdb_tpu.analysis import analyze_file
+from swarmdb_tpu.analysis.cli import main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+
+EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(SWL[0-9]+(?:\s*,\s*SWL[0-9]+)*)")
+
+
+def expected_findings(path: Path):
+    out = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        m = EXPECT_RE.search(line)
+        if m:
+            for rule in m.group(1).split(","):
+                out.add((lineno, rule.strip()))
+    return out
+
+
+@pytest.mark.parametrize("name", [
+    "hot_sync_bad.py",          # host-sync family (SWL101/SWL102)
+    "recompile_bad.py",         # recompile family (SWL201/202/203)
+    "lock_bad.py",              # lock-discipline family (SWL301)
+    "tracer_leak_bad.py",       # tracer-leak family (SWL401)
+])
+def test_each_family_detects_seeded_violations(name):
+    path = FIXTURES / name
+    expected = expected_findings(path)
+    assert expected, f"fixture {name} carries no EXPECT annotations"
+    actual = {(f.line, f.rule) for f in analyze_file(str(path))}
+    assert actual == expected, (
+        f"{name}: reported {sorted(actual)} != seeded {sorted(expected)}")
+
+
+def test_prefix_replica_snapshot_reproduces_advice_finding():
+    """The pre-fix ``_serve`` shape (ADVICE r5: mirror-map read outside
+    the lock its ack thread takes) must be re-detected — the checker
+    would have caught the original finding before review did."""
+    path = FIXTURES / "replica_prefix_snapshot.py"
+    findings = analyze_file(str(path))
+    assert [(f.rule, f.line) for f in findings] == [
+        ("SWL301", next(iter(expected_findings(path)))[0])]
+    assert "appended" in findings[0].message
+    # ...and the FIXED in-tree _serve no longer trips it
+    fixed = analyze_file(str(REPO / "swarmdb_tpu" / "broker" / "replica.py"))
+    assert [f for f in fixed if f.rule == "SWL301"] == []
+
+
+def test_clean_fixture_has_zero_findings():
+    assert analyze_file(str(FIXTURES / "clean.py")) == []
+
+
+def test_inline_disable_suppresses(tmp_path):
+    bad = (FIXTURES / "hot_sync_bad.py").read_text()
+    patched = bad.replace(
+        "    jax.block_until_ready(logits)  # EXPECT: SWL101",
+        "    jax.block_until_ready(logits)  # swarmlint: disable=host-sync")
+    assert patched != bad
+    target = tmp_path / "suppressed.py"
+    target.write_text(patched)
+    supp_line = next(i for i, l in enumerate(patched.splitlines(), 1)
+                     if "disable=host-sync" in l)
+    lines = {f.line for f in analyze_file(str(target))}
+    # the suppressed line is gone; every other seeded line survives
+    assert supp_line not in lines
+    assert lines == {ln for ln, _ in expected_findings(target)}
+    assert lines  # the patch must not have silenced the whole fixture
+
+
+def test_baseline_accepts_old_fails_new(tmp_path, capsys):
+    target = str(FIXTURES / "lock_bad.py")
+    baseline = tmp_path / "baseline.json"
+    assert main([target, "--update-baseline",
+                 "--baseline", str(baseline)]) == 0
+    data = json.loads(baseline.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == 4
+    # same tree, same baseline: clean
+    assert main([target, "--baseline", str(baseline)]) == 0
+    # a new violation elsewhere: exit 1, and ONLY the new one is reported
+    extra = tmp_path / "fresh_violation.py"
+    extra.write_text((FIXTURES / "tracer_leak_bad.py").read_text())
+    capsys.readouterr()
+    assert main([target, str(extra), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "SWL401" in out and "SWL301" not in out
+    # --no-baseline surfaces everything again
+    assert main([target, "--no-baseline"]) == 1
+
+
+def test_select_restricts_families():
+    target = str(FIXTURES / "hot_sync_bad.py")
+    assert main([target, "--no-baseline", "--select", "lock-discipline"]) == 0
+    assert main([target, "--no-baseline", "--select", "host-sync"]) == 1
+
+
+def test_repo_tree_clean_against_committed_baseline():
+    """The acceptance invocation: `python -m swarmdb_tpu.analysis
+    swarmdb_tpu/` (default baseline analysis/baseline.json) exits 0."""
+    assert main([str(REPO / "swarmdb_tpu"),
+                 "--baseline", str(REPO / "analysis" / "baseline.json")]) == 0
+
+
+def test_cli_module_smoke():
+    """`python -m swarmdb_tpu.analysis` end-to-end (module entry point)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "swarmdb_tpu.analysis", "--list-rules"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for rule in ("SWL101", "SWL203", "SWL301", "SWL401"):
+        assert rule in proc.stdout
