@@ -36,7 +36,8 @@ Modes (SWARMDB_BENCH_MODE) — one per BASELINE.md config:
   dpserve  — DP-scaling A/B of the sharded paged path on N virtual CPU
              devices (never probes the TPU; see bench_dpserve docstring).
   longctx  — opt-in: S=1024 paged + in-place prefix reuse (long-context
-             regime; excluded from `all` — see bench_longctx docstring).
+             regime; excluded from `all`, which records a machine-
+             readable skip reason — see bench_longctx docstring).
   all      — run every mode above except longctx; per-mode detail lines
              + the final compact summary line.
 
@@ -169,6 +170,43 @@ def bench_echo(seconds: float) -> dict:
         "vs_baseline": round(value / TARGET_MSGS_PER_SEC, 4),
         "mode": "echo",
     }
+    # tracer-overhead A/B (acceptance: <= 5% msgs/sec, recorded here).
+    # Alternating on/off segments over ONE shared db: back-to-back whole
+    # runs drift by more than the effect being measured (observed ±5%
+    # between identical runs), while interleaving cancels warm-up and
+    # allocator drift. The engine modes amortize the same ring writes
+    # over far more work per message, so echo is the worst case.
+    try:
+        from swarmdb_tpu.obs import TRACER
+
+        was_enabled = TRACER.enabled
+        if was_enabled:
+            seg = max(1.0, min(seconds, 8.0) / 2)
+            on_rate = off_rate = 0.0
+            try:
+                with tempfile.TemporaryDirectory() as tmp:
+                    db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
+                                 autosave_interval=1e9)
+                    for _ in range(2):
+                        TRACER.set_enabled(True)
+                        on_rate += _echo_loop(db, seg)
+                        TRACER.set_enabled(False)
+                        off_rate += _echo_loop(db, seg)
+                    db.close()
+            finally:
+                TRACER.set_enabled(True)
+            on_rate /= 2
+            off_rate /= 2
+            result["echo_tracer_on_msgs_per_sec"] = round(on_rate, 2)
+            result["echo_tracer_off_msgs_per_sec"] = round(off_rate, 2)
+            if off_rate > 0:
+                result["tracer_overhead_pct"] = round(
+                    max(0.0, (off_rate - on_rate) / off_rate) * 100.0, 2)
+        else:
+            result["tracer_overhead_pct"] = 0.0
+            result["tracer_disabled"] = True
+    except Exception as exc:  # noqa: BLE001 — echo headline must survive
+        result["tracer_overhead_error"] = repr(exc)[-200:]
     # same loop over the durable C++ broker (fsync'd partitioned log) —
     # the ADVICE r2 gap: the native engine had never been benchmarked
     try:
@@ -336,9 +374,21 @@ def _run_window(db, seconds: float, pump, drain_grace: float = 2.0,
             jax.profiler.stop_trace()
 
 
+_PHASES = ("queue_wait", "prefill", "decode", "host_sync")
+
+
 def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
                     prompt_toks) -> dict:
     reused = db.metrics.counters["prefix_reused_tokens"]
+    # per-phase time accumulators (engine-side, microseconds): deltas
+    # over the window become the phase breakdown that explains WHERE a
+    # bad headline number went (queue wait vs prefill vs decode vs the
+    # sanctioned host sync). Decode sums per-chunk latency, so with
+    # pipeline_depth > 1 the shares can total > wall-clock — they are
+    # shares of measured phase time, not of the window.
+    phase_counters = {p: db.metrics.counters[f"phase_us_{p}"]
+                      for p in _PHASES}
+    ph0 = {p: c.value for p, c in phase_counters.items()}
     c0, k0, pt0, r0 = (completed.value, tokens.value, prompt_toks.value,
                        reused.value)
     sent0 = pump.sent
@@ -366,6 +416,37 @@ def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
         out["prompt_tokens_computed_per_sec"] = round(
             out["prompt_tokens_per_sec"] - out["prompt_tokens_reused_per_sec"],
             1)
+    phase_s = {p: (phase_counters[p].value - ph0[p]) / 1e6 for p in _PHASES}
+    total_phase = sum(phase_s.values())
+    if total_phase > 0:
+        out["phase_seconds"] = {p: round(v, 3) for p, v in phase_s.items()}
+        out["phase_shares"] = {p: round(v / total_phase, 4)
+                               for p, v in phase_s.items()}
+    return out
+
+
+def _deposit_obs_artifacts(service, mode: str) -> dict:
+    """Write the run's Chrome trace + flight record under bench_logs/
+    (VERDICT r5: bench_logs held only a README — every bench record now
+    ships the timelines that explain its numbers). Returns the artifact
+    paths for the mode's JSON line; never raises. SWARMDB_BENCH_LOGS_DIR
+    overrides the destination (tests point it at a tmp dir so harness
+    runs never dirty the repo's bench_logs/)."""
+    out: dict = {}
+    logs = os.environ.get("SWARMDB_BENCH_LOGS_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_logs")
+    try:
+        from swarmdb_tpu.obs import TRACER
+
+        os.makedirs(logs, exist_ok=True)
+        tpath = os.path.join(logs, f"{mode}_trace.json")
+        with open(tpath, "w") as f:
+            json.dump(TRACER.to_chrome_trace(), f)
+        out["trace_artifact"] = tpath
+        out["flight_artifact"] = service.engine.flight.dump_to(
+            logs, reason=f"bench_{mode}")
+    except Exception as exc:  # noqa: BLE001 — artifacts must not kill a bench
+        out["obs_artifact_error"] = repr(exc)[-200:]
     return out
 
 
@@ -467,6 +548,10 @@ def bench_serve(seconds: float) -> dict:
         trace_dir = os.environ.get("SWARMDB_BENCH_TRACE_DIR")
         window = _run_window(db, seconds, pump, trace_dir=trace_dir)
         extras = _device_extras(service, model)
+        # the longctx wrapper runs through here too; the env names the
+        # artifacts correctly in mode=all children either way
+        extras.update(_deposit_obs_artifacts(
+            service, _env("SWARMDB_BENCH_MODE", "serve")))
         if trace_dir:
             extras["trace_dir"] = trace_dir
         # open-loop latency at ~half the measured closed-loop capacity
@@ -530,6 +615,7 @@ def bench_group(seconds: float) -> dict:
                           completions_per_send=group_size)
         window = _run_window(db, seconds, pump)
         extras = _device_extras(service, model)
+        extras.update(_deposit_obs_artifacts(service, "group"))
 
     value = window.pop("completed_per_sec")
     return {
@@ -583,6 +669,7 @@ def bench_tooluse(seconds: float) -> dict:
         pump = _make_pump(db, max_batch * 2, send)
         window = _run_window(db, seconds, pump)
         extras = _device_extras(service, model)
+        extras.update(_deposit_obs_artifacts(service, "tooluse"))
         # contract check: replies to function_call must be function_result
         results = sum(
             1 for m in db.messages.values()
@@ -645,6 +732,7 @@ def bench_swarm100(seconds: float) -> dict:
         pump = _make_pump(db, max_batch * 2, send)
         window = _run_window(db, seconds, pump)
         extras = _device_extras(service, model)
+        extras.update(_deposit_obs_artifacts(service, "swarm100"))
         # priority-admission evidence: p50 TTFT per MessagePriority level
         # (the engine admits CRITICAL first; LOW should wait longest)
         prio_ttft = {}
@@ -763,6 +851,8 @@ def bench_dpserve(seconds: float) -> dict:
                 pump = _make_pump(db, total_slots * 2, send)
                 window = _run_window(db, seconds, pump)
                 extras = _device_extras(service, model)
+                extras.update(_deposit_obs_artifacts(
+                    service, f"dpserve_dp{ndev}"))
             finally:
                 service.stop()
                 db.close()
@@ -911,12 +1001,15 @@ _SUMMARY_KEYS = (
     ("pl", "platform"),
     ("native", "native_broker_msgs_per_sec"),
     ("dpx", "dp_scaling_x"),
+    ("ovh", "tracer_overhead_pct"),
 )
 
 
 def _mode_summary(r: dict) -> dict:
     """Compress one mode's detailed result to a handful of scalars for the
     final line. The full detail is on that mode's own stdout line."""
+    if r.get("skipped"):
+        return {"skip": r.get("reason_code", "skipped")}
     if "metric" not in r:
         return {"err": str(r.get("error", "no result"))[-120:]}
     out = {"v": r.get("value")}
@@ -1044,6 +1137,21 @@ def _run_all() -> None:
     probe_timeout = _env("SWARMDB_BENCH_PROBE_TIMEOUT", 120.0)
     tpu_ok = False  # once a probe succeeds, stop re-probing
     probe_failed = False  # after one failure, later re-probes go short
+
+    # longctx is opt-in only, but its absence must be machine-readable
+    # (VERDICT weak row 18): the record says WHY it was skipped and how
+    # to run it, instead of silently not existing
+    results["longctx"] = {
+        "mode": "longctx",
+        "skipped": True,
+        "reason_code": "warmup_compile_budget",
+        "reason": ("S=1024 warmup compiles ~12 big-shape variants, "
+                   "30-90s each cold on the tunneled XLA service — a "
+                   "cold container would blow the scheduled run's "
+                   "watchdog; run SWARMDB_BENCH_MODE=longctx explicitly"),
+    }
+    print(json.dumps({"mode": "longctx", **results["longctx"]}),
+          flush=True)
 
     for m in _ALL_MODES:
         remaining = deadline - time.time()

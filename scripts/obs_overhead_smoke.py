@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Tracer-overhead smoke for CI (ISSUE 2 acceptance: <= 5% budget).
+
+Runs the pure-routing echo loop with the span tracer enabled vs disabled
+in ALTERNATING segments (back-to-back whole runs drift more than the
+effect measured) and fails if the overhead exceeds the smoke bound.
+Stdlib + pydantic only — no jax, no aiohttp, no pytest — so the bare
+`lint` CI job can run it. The bound is 20%: CI boxes are noisy, and the
+point of the smoke is to catch a catastrophic regression (a lock or an
+O(n) walk landing on the record path), not to re-measure the tight
+number — bench.py's echo mode records that (`tracer_overhead_pct`).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEG_S = float(os.environ.get("SMOKE_SEGMENT_S", "2.0"))
+BOUND = float(os.environ.get("SMOKE_BOUND_PCT", "20.0"))
+
+
+def main() -> int:
+    import bench
+    from swarmdb_tpu.broker.local import LocalBroker
+    from swarmdb_tpu.core.runtime import SwarmDB
+    from swarmdb_tpu.obs import TRACER
+
+    on = off = 0.0
+    with tempfile.TemporaryDirectory() as tmp:
+        db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
+                     autosave_interval=1e9)
+        try:
+            for _ in range(2):
+                TRACER.set_enabled(True)
+                on += bench._echo_loop(db, SEG_S)
+                TRACER.set_enabled(False)
+                off += bench._echo_loop(db, SEG_S)
+        finally:
+            TRACER.set_enabled(True)
+            db.close()
+    overhead = max(0.0, (off - on) / off * 100.0) if off else 0.0
+    print(f"echo msgs/sec: tracer on {on / 2:.1f}, off {off / 2:.1f}, "
+          f"overhead {overhead:.2f}% (bound {BOUND:.0f}%)")
+    if overhead > BOUND:
+        print("FAIL: tracer overhead above smoke bound", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

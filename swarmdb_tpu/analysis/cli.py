@@ -24,7 +24,8 @@ def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m swarmdb_tpu.analysis",
         description="swarmlint: JAX-aware static analysis (host-sync, "
-                    "recompile, lock-discipline, tracer-leak)")
+                    "recompile, lock-discipline, tracer-leak, "
+                    "span-discipline)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to scan "
                          "(default: swarmdb_tpu/)")

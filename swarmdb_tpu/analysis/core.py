@@ -86,6 +86,12 @@ RULES: Dict[str, Rule] = {
         Rule("SWL401", "tracer-leak",
              "store to self/global/nonlocal from inside a traced (jit/"
              "shard_map/scan) function leaks a tracer"),
+        Rule("SWL501", "span-discipline",
+             "span_begin without any span_end in the function (or a "
+             "discarded span_begin stamp) — the span is silently dropped"),
+        Rule("SWL502", "span-discipline",
+             "allocating span(...) context manager inside a hot-path "
+             "function — use the span_begin/span_end ring writes"),
     )
 }
 
@@ -404,7 +410,7 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def analyze_file(path: str, select: Optional[Set[str]] = None,
                  text: Optional[str] = None) -> List[Finding]:
-    from . import hostsync, locks, recompile, tracers
+    from . import hostsync, locks, recompile, spans, tracers
 
     try:
         src = SourceFile(path, text=text)
@@ -414,7 +420,7 @@ def analyze_file(path: str, select: Optional[Set[str]] = None,
         raise SyntaxError(f"{path}: {exc}") from None
     findings: List[Finding] = []
     for checker in (hostsync.check, recompile.check, locks.check,
-                    tracers.check):
+                    tracers.check, spans.check):
         findings.extend(checker(src))
     out = []
     seen = set()

@@ -1,0 +1,87 @@
+"""span-discipline checks (SWL501/SWL502) for the obs tracer.
+
+The tracer (swarmdb_tpu/obs/tracer.py) has two record APIs with a
+contract the type system cannot enforce:
+
+- ``span_begin()`` returns a monotonic stamp that only becomes a span
+  when some ``span_end(stamp, ...)`` consumes it. A function that calls
+  ``span_begin`` but never ``span_end`` records NOTHING — the span is
+  silently dropped, which is the observability equivalent of a swallowed
+  exception (SWL501). Likewise a bare ``span_begin()`` expression whose
+  stamp is discarded can never be ended. ``span_end`` without a local
+  ``span_begin`` is fine: closing against an externally carried stamp
+  (e.g. the engine's dispatch stamp) is the intended hot-path pattern.
+- ``span(...)`` is an allocating context manager for warm paths. Inside
+  a ``# swarmlint: hot`` function the only sanctioned record forms are
+  the allocation-free ring writes (``span_begin``/``span_end``/
+  ``span_at``/``instant``); a ``.span(...)`` context manager there
+  allocates an object + frame per call on the decode path (SWL502).
+
+``__enter__``/``__exit__`` pairs are exempt from SWL501 — the context-
+manager protocol balances them across two methods by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from .core import Finding, SourceFile, dotted_name, make_finding
+
+_BALANCE_EXEMPT = {"__enter__", "__exit__"}
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested defs (each
+    function's span discipline is judged on its own scope — a nested
+    callback that ends a span does not balance its parent)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_call_to(node: ast.AST, method: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return bool(name) and name.split(".")[-1] == method
+
+
+def check(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        begins: List[ast.Call] = []
+        ends = 0
+        for node in _own_nodes(fn):
+            if _is_call_to(node, "span_begin"):
+                begins.append(node)  # type: ignore[arg-type]
+            elif _is_call_to(node, "span_end"):
+                ends += 1
+            if (isinstance(node, ast.Expr)
+                    and _is_call_to(node.value, "span_begin")):
+                # stamp discarded on the spot — unendable
+                findings.append(make_finding(
+                    src, "SWL501", node,
+                    "span_begin() stamp discarded — the span can never "
+                    "be recorded (bind it and pass to span_end)"))
+            if (src.is_hot(fn) and isinstance(node, ast.Call)
+                    and _is_call_to(node, "span")):
+                findings.append(make_finding(
+                    src, "SWL502", node,
+                    f"allocating span(...) context manager inside "
+                    f"hot-path function `{fn.name}` — use the "
+                    f"span_begin/span_end ring writes"))
+        if (begins and ends == 0
+                and fn.name not in _BALANCE_EXEMPT):
+            findings.append(make_finding(
+                src, "SWL501", begins[0],
+                f"`{fn.name}` calls span_begin but never span_end — "
+                f"the span is begun and silently dropped"))
+    return findings

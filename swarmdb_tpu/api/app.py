@@ -35,6 +35,7 @@ from pydantic import ValidationError
 
 from ..core.messages import MessageStatus
 from ..core.runtime import SwarmDB
+from ..obs import TRACER
 from ..utils import jwt as jwt_util
 from . import schemas
 
@@ -227,6 +228,7 @@ def create_app(
 
     @web.middleware
     async def middleware(request: web.Request, handler: Any) -> web.StreamResponse:
+        t_req = TRACER.span_begin()
         # CORS preflight
         if request.method == "OPTIONS":
             resp: web.StreamResponse = web.Response(status=204)
@@ -259,6 +261,13 @@ def create_app(
                 logger.exception("unhandled error on %s %s",
                                  request.method, request.path)
                 resp = web.json_response({"detail": "internal error"}, status=500)
+        # API-route span: the root of every request's exported timeline
+        # (SSE streams close it when the stream ends, so a streamed reply
+        # span covers the full decode)
+        TRACER.span_end(t_req, "api.request", cat="api",
+                        args={"method": request.method,
+                              "path": request.path,
+                              "status": resp.status})
         _add_cors(resp, request.headers.get("Origin"))
         if recycle_at is not None and request.path != "/health":
             served_requests["n"] += 1
@@ -596,8 +605,61 @@ def create_app(
                 if s.get(key) is not None:
                     lines.append(f'{n}{{quantile="{q}"}} {s[key]}')
             lines.append(f"{n}_count {int(s.get('count') or 0)}")
+        # replication lag (acks=all deployments): per-follower fsync-
+        # watermark lag so the back-pressure path is observable instead
+        # of silent — a disconnected follower shows up here as growing
+        # lag_records and connected=0 while DELIVERED reports stall
+        repl_stats = getattr(db.broker, "replication_stats", None)
+        if repl_stats is not None:
+            try:
+                followers = await _run_sync(repl_stats)
+            except Exception:
+                logger.exception("replication_stats failed")
+                followers = []
+            if followers:
+                lines.append("# TYPE swarmdb_replica_lag_records gauge")
+                lines.append("# TYPE swarmdb_replica_lag_seconds gauge")
+                lines.append("# TYPE swarmdb_replica_connected gauge")
+                lines.append("# TYPE swarmdb_replica_gapped_partitions gauge")
+                for f in followers:
+                    lbl = f'{{follower="{f["target"]}"}}'
+                    lines.append(
+                        f"swarmdb_replica_lag_records{lbl} {f['lag_records']}")
+                    lines.append(
+                        f"swarmdb_replica_lag_seconds{lbl} {f['lag_seconds']}")
+                    lines.append(
+                        f"swarmdb_replica_connected{lbl} "
+                        f"{1 if f['connected'] else 0}")
+                    lines.append(
+                        f"swarmdb_replica_gapped_partitions{lbl} "
+                        f"{f['gapped']}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def trace_export(request: web.Request) -> web.Response:
+        """GET /admin/trace/export — the span tracer's buffered events as
+        Chrome trace-event JSON (load in https://ui.perfetto.dev or
+        chrome://tracing). Covers every layer that records spans: API
+        routes, runtime send/receive, broker publish, engine admission/
+        prefill/decode chunks/host syncs, and message stage marks."""
+        require_admin(current_agent(request))
+        trace = await _run_sync(TRACER.to_chrome_trace)
+        return web.json_response(trace)
+
+    async def flight_record(request: web.Request) -> web.Response:
+        """GET /admin/flight — the engine flight recorder's current rings
+        (last N engine steps + last M request timelines), plus the most
+        recent automatic dump if a restart already took one.
+        ``?last=1`` returns only that last automatic dump."""
+        require_admin(current_agent(request))
+        if serving is None or not hasattr(serving, "engine"):
+            raise _error(503, "no serving engine attached")
+        flight = serving.engine.flight
+        if request.query.get("last"):
+            if flight.last_dump is None:
+                raise _error(404, "no automatic dump taken yet")
+            return web.json_response(flight.last_dump)
+        return web.json_response(await _run_sync(flight.dump))
 
     async def dashboard(request: web.Request) -> web.Response:
         """GET /dashboard: self-contained observability page (the
@@ -762,6 +824,8 @@ def create_app(
         web.get("/agents/{agent_id}/load", agent_load),
         web.post("/admin/profile/start", profile_start),
         web.post("/admin/profile/stop", profile_stop),
+        web.get("/admin/trace/export", trace_export),
+        web.get("/admin/flight", flight_record),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

@@ -49,6 +49,17 @@ DASHBOARD_HTML = """<!DOCTYPE html>
 <h2>Messages</h2><div id="messages">-</div>
 <h2>Latencies</h2><div id="latencies">-</div>
 <h2>Agents</h2><div id="agents">-</div>
+<h2>Tracing &amp; flight recorder</h2>
+<p class="muted">
+  <button onclick="download('/admin/trace/export', 'trace.json')">
+    download Chrome trace</button>
+  (open in <a href="https://ui.perfetto.dev" target="_blank">Perfetto</a>
+  or chrome://tracing) &middot;
+  <button onclick="download('/admin/flight', 'flight.json')">
+    download flight record</button>
+  (last engine steps + request timelines; auto-dumped on engine restart)
+  &middot; admin token required
+</p>
 <script>
 function saveToken() {
   localStorage.setItem("swarmdb_token", document.getElementById("token").value);
@@ -78,6 +89,21 @@ async function getJSON(path) {
   const r = await fetch(path, {headers: {"Authorization": "Bearer " + tok()}});
   if (!r.ok) throw new Error(path + " -> " + r.status);
   return await r.json();
+}
+async function download(path, filename) {
+  const state = document.getElementById("state");
+  try {
+    const data = await getJSON(path);
+    const blob = new Blob([JSON.stringify(data)],
+                          {type: "application/json"});
+    const a = document.createElement("a");
+    a.href = URL.createObjectURL(blob);
+    a.download = filename;
+    a.click();
+    URL.revokeObjectURL(a.href);
+  } catch (err) {
+    state.textContent = String(err);
+  }
 }
 async function refresh() {
   const state = document.getElementById("state");

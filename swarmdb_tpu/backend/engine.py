@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import TRACER, FlightRecorder
 from ..utils.metrics import MetricsRegistry
 from .sampling import (SamplingParams, make_slot_keys,
                        sample_tokens, token_logprob)
@@ -111,6 +112,7 @@ class _Slot:
     pending_first: bool = False  # prefill token not yet surfaced to host
     cancelled: bool = False      # retire at the next processed block
     first_token_at: Optional[float] = None
+    admitted_at: Optional[float] = None  # prefill start (flight timeline)
     # device-side next write position: advances by K at each DISPATCH
     # (pipelined chunks are issued before the previous block is read);
     # ``position`` stays the host-confirmed value, advanced at processing
@@ -174,6 +176,8 @@ class Engine:
         prefix_pages: int = 0,
         prefix_page_size: int = 16,
         forward_last_fn: Optional[Callable] = None,
+        flight_dir: Optional[str] = None,
+        aging_s: Optional[float] = None,
     ) -> None:
         # forward_last_fn(params, tokens, positions, cache, last_pos) ->
         # ([B, V] logits at each row's last_pos, cache): prefill only ever
@@ -189,6 +193,26 @@ class Engine:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.metrics = metrics or MetricsRegistry()
+        # observability: request spans ride the process-global tracer;
+        # the flight recorder (last-N engine steps + last-M request
+        # timelines) is per-engine and auto-dumped on restart/error —
+        # see swarmdb_tpu/obs/ and GET /admin/flight
+        self.tracer = TRACER
+        self.flight = FlightRecorder()
+        self._flight_dir = flight_dir
+        self._flight_last_had_work = False
+        # priority aging (anti-starvation, see _age_queue): seconds a
+        # queued request waits per effective-priority-class bump; <= 0
+        # disables (strict priority, LOW can starve under saturation)
+        if aging_s is None:
+            try:
+                aging_s = float(os.environ.get("SWARMDB_AGING_S", "5.0"))
+            except ValueError:
+                logger.warning("SWARMDB_AGING_S=%r is not a float; "
+                               "using 5.0",
+                               os.environ.get("SWARMDB_AGING_S"))
+                aging_s = 5.0
+        self._aging_s = aging_s
 
         self.decode_chunk = max(1, int(decode_chunk))
         # How many decode chunks may be in flight before the host reads
@@ -973,6 +997,11 @@ class Engine:
         # submit AND admission — is the authoritative guard; this
         # ordering just keeps the metric-derived view consistent too.)
         self.metrics.counters["engine_restarts"].inc()
+        # dump the flight record BEFORE _fail_all mutates slot state: the
+        # rings hold the last steps of the DEAD loop, which is exactly
+        # the evidence a post-mortem needs (SWARMDB_FLIGHT_DIR or the
+        # engine's configured flight_dir; always kept as last_dump too)
+        self.flight.auto_dump("engine_restart", self._flight_dir)
         self._fail_all("engine_restart")
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
         self._last_lps = jnp.zeros((self.max_batch,), jnp.float32)
@@ -1482,7 +1511,9 @@ class Engine:
     # ------------------------------------------------------------- the loop
 
     def _run(self) -> None:  # swarmlint: hot
-        in_flight: List[Tuple[Any, List[Tuple[int, GenRequest, int]]]] = []
+        # (token block, logprob block, snapshot, dispatch stamp) per chunk
+        in_flight: List[Tuple[Any, Any, List[Tuple[int, GenRequest, int]],
+                              int]] = []
         while True:
             with self._cv:
                 while (not self._stop and not self._queue
@@ -1509,9 +1540,11 @@ class Engine:
                 while in_flight and (len(in_flight) >= self.pipeline_depth
                                      or not self._any_active()):
                     self._process_block(*in_flight.pop(0))
+                self._flight_step(len(in_flight))
             except Exception:
                 in_flight.clear()
                 logger.exception("engine step failed; failing active requests")
+                self.flight.auto_dump("engine_error", self._flight_dir)
                 self._fail_all("engine_error")
                 if self._mh is not None:
                     # Pod mode: workers may have executed an op this
@@ -1558,6 +1591,112 @@ class Engine:
     def _any_active(self) -> bool:
         return any(s.active for s in self.slots)
 
+    def _compiled_count(self) -> int:
+        """Total compiled-executable count across the engine's jit entry
+        points (jax's per-wrapper cache sizes). A step-over-step increase
+        in the flight record is a RECOMPILE landing mid-traffic — the
+        exact stall class warmup exists to prevent."""
+        fns: List[Any] = list(self._decode_variants)
+        for name in ("_prefill_fused", "_prefill_paged_fused",
+                     "_prefill_paged_packed", "_prefill_paged_prefix_fused",
+                     "_prefill_paged_resume_fused", "_prefill_prefix_fused",
+                     "_extract_lane_fused"):
+            fn = getattr(self, name, None)
+            if fn is not None:
+                fns.append(fn)
+        n = 0
+        for fn in fns:
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                try:
+                    n += int(size())
+                except Exception:  # private API; absence is not an error
+                    pass
+        return n
+
+    def _flight_step(self, in_flight_n: int) -> None:  # swarmlint: hot
+        """One flight-recorder step record per engine-loop iteration that
+        has work (idle iterations are skipped so the ring's last-N steps
+        describe the crash window, not hours of quiet)."""
+        with self._cv:
+            queued = len(self._queue)
+            by_prio: Dict[int, int] = {}
+            for negp, _, _, _ in self._queue:
+                by_prio[-negp] = by_prio.get(-negp, 0) + 1
+        active = sum(1 for s in self.slots if s.active)
+        has_work = bool(active or queued or in_flight_n)
+        if not has_work and not self._flight_last_had_work:
+            return
+        # one trailing record after work drains: the ring's final step
+        # then carries the SETTLED counters (a dump taken while idle
+        # matches the metrics registry exactly)
+        self._flight_last_had_work = has_work
+        c = self.metrics.counters
+        rec: Dict[str, Any] = {
+            "ts": time.time(),
+            "active": active,
+            "max_batch": self.max_batch,
+            "queued": queued,
+            "queued_by_priority": by_prio,
+            "in_flight_chunks": in_flight_n,
+            # cumulative counters: deltas between steps localize where
+            # tokens/padding/syncs happened in time
+            "tokens_generated": c["tokens_generated"].value,
+            "prompt_tokens": c["prompt_tokens"].value,
+            "prefill_padding_tokens": c["prefill_padding_tokens"].value,
+            "host_syncs": c["engine_host_syncs"].value,
+            "restarts": c["engine_restarts"].value,
+            "compiled_variants": self._compiled_count(),
+        }
+        if self._prefix is not None:
+            ps = self._prefix.stats()
+            rec["prefix_hit_tokens"] = ps["hit_tokens"]
+            rec["prefix_miss_tokens"] = ps["miss_tokens"]
+        if (self.paged is not None
+                and getattr(self.paged.allocator, "n_shards", 1) > 1):
+            # DP-sharded pool: per-shard occupancy — the dpx=0.22 class
+            # of mystery is usually one starved/overloaded shard
+            shard_of = self.paged.allocator.shard_of
+            by_shard: Dict[int, int] = {}
+            for i, s in enumerate(self.slots):
+                if s.active:
+                    sh = shard_of(i)
+                    by_shard[sh] = by_shard.get(sh, 0) + 1
+            rec["active_by_shard"] = by_shard
+        self.flight.record_step(rec)
+
+    def _age_queue(self) -> None:  # swarmlint: hot
+        """Bounded anti-starvation for priority admission (BENCH_r05
+        diagnosis): the heap ORDERING — (-priority, submitted_at,
+        tiebreak) — is correct, but under a saturating arrival stream
+        strict priority leaves LOW waiting unboundedly (p50 TTFT 13.55 s
+        vs 2.62 s for CRITICAL on the swarm100 closed loop; the request
+        timelines show the whole gap is queue wait). Every ``aging_s``
+        seconds a request waits, it COMPETES one priority class higher —
+        the effective class is recomputed from wait time (idempotent
+        across passes; ``req.priority`` itself is never mutated) and ties
+        within a class still break on ``submitted_at``, so an aged LOW
+        outranks younger requests of its effective class. Wait is thus
+        bounded by ~(3 - priority) * aging_s + the class-3 backlog."""
+        if self._aging_s <= 0:
+            return
+        now = time.time()
+        with self._cv:
+            if not self._queue:
+                return
+            changed = False
+            for i, (negp, sub, tb, req) in enumerate(self._queue):
+                boost = int((now - sub) / self._aging_s)
+                if boost <= 0:
+                    continue
+                eff = min(3, req.priority + boost)
+                if eff > -negp:
+                    self._queue[i] = (-eff, sub, tb, req)
+                    changed = True
+            if changed:
+                heapq.heapify(self._queue)
+                self.metrics.counters["engine_priority_aged"].inc()
+
     def _free_slot_ids(self) -> List[int]:  # swarmlint: hot
         free = [i for i, s in enumerate(self.slots) if not s.active]
         if (free and self.paged is not None
@@ -1592,6 +1731,7 @@ class Engine:
         long one never pays the long bucket's O(T^2) attention (review
         finding); every popped request is still admitted this round.
         """
+        self._age_queue()
         if self.paged:
             # reclaim retired slots' pages first: zero their table rows on
             # device (mirrored to pod workers), THEN return pages to the
@@ -2057,6 +2197,8 @@ class Engine:
             target, scatter, self._base_keys_np[gather],
             self._temp[gather], self._topk[gather], self._topp[gather],
         )
+        self.metrics.counters["prefill_padding_tokens"].inc(
+            int(padded.size) - int(lengths[:len(batch)].sum()))
         pins: Dict[int, List[int]] = {}
         for slot_id, chain, toks, page_id in reg_records:
             if self._prefix.register(chain, toks, page_id):
@@ -2107,6 +2249,8 @@ class Engine:
             row_tables, scatter, self._base_keys_np[gather],
             self._temp[gather], self._topk[gather], self._topp[gather],
         )
+        self.metrics.counters["prefill_padding_tokens"].inc(
+            int(padded.size) - int(lengths[:len(batch)].sum()))
         self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
         self._activate([(s, r) for s, r, _ in batch], t0)
 
@@ -2155,6 +2299,8 @@ class Engine:
             self._temp[gather], self._topk[gather], self._topp[gather],
         )
         self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
+        self.metrics.counters["prefill_padding_tokens"].inc(
+            int(padded.size) - int(lengths[:len(rows)].sum()))
         self._activate([(r[0], r[1]) for r in rows], t0)
 
     # swarmlint: hot
@@ -2246,6 +2392,10 @@ class Engine:
             self._topk[slot_id] = s.top_k
             self._topp[slot_id] = s.top_p
             self._set_slot_key(slot_id, s.seed)
+        # padding waste: grid tokens dispatched minus real prompt tokens
+        # (bucket rounding + padding rows) — flight-recorder occupancy
+        self.metrics.counters["prefill_padding_tokens"].inc(
+            int(padded.size) - int(lengths[:n].sum()))
 
         if not self.paged:
             # ONE dispatch: forward + sample + slot insert + token scatter.
@@ -2328,6 +2478,7 @@ class Engine:
             slot = self.slots[slot_id]
             slot.active = True
             slot.request = req
+            slot.admitted_at = t0
             # next write position; rolling-KV continuations resume past
             # the tokens already in their kept pages
             slot.position = req.resume_len + len(req.prompt)
@@ -2352,7 +2503,22 @@ class Engine:
             self.metrics.counters["prompt_tokens"].inc(
                 len(req.prompt) + req.resume_len)
             self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
-        self.metrics.latencies["prefill_s"].observe(time.time() - t0)
+            self.metrics.counters["phase_us_queue_wait"].inc(
+                max(0, int((t0 - req.submitted_at) * 1e6)))
+            # retro-span: the wait was over before any tracer call site
+            # could run, so it is recorded from its wall-clock endpoints
+            self.tracer.span_at("engine.admit", req.submitted_at, t0,
+                                cat="engine", rid=req.request_id)
+        prefill_dt = time.time() - t0
+        self.metrics.latencies["prefill_s"].observe(prefill_dt)
+        self.metrics.counters["phase_us_prefill"].inc(
+            max(0, int(prefill_dt * 1e6)))
+        for slot_id, req in batch:
+            self.tracer.span_at(
+                "engine.prefill", t0, t0 + prefill_dt, cat="engine",
+                rid=req.request_id,
+                args={"slot": slot_id,
+                      "mid": req.metadata.get("message_id")})
 
     # --------------------------------------------------------------- decode
 
@@ -2393,10 +2559,14 @@ class Engine:
                 self.cache, self._base_keys_np,
                 self._temp, self._topk, self._topp,
             )
-        return all_toks, all_lps, snapshot
+        # dispatch stamp: _process_block closes each snapshot slot's
+        # "engine.decode_chunk" span against it (monotonic, so a wall
+        # clock step can't produce a negative chunk)
+        return all_toks, all_lps, snapshot, time.monotonic_ns()
 
     # swarmlint: hot
-    def _process_block(self, all_toks, all_lps, snapshot) -> None:
+    def _process_block(self, all_toks, all_lps, snapshot,
+                       t_dispatch_ns: int = 0) -> None:
         """Fetch one dispatched chunk's [K+1, B] token block (+ matching
         raw-model logprobs) with the one host sync and emit its tokens.
 
@@ -2404,14 +2574,33 @@ class Engine:
         emission stops at a slot's EOS / max_new_tokens / max_seq and the
         remainder of its lane is discarded garbage.
         """
+        t_sync0 = time.monotonic_ns()
         # everything else in the hot path rides jit dispatches; this is
         # swarmlint: disable=SWL101 -- THE one sanctioned sync per chunk
         block, lps = jax.device_get((all_toks, all_lps))
+        t_sync1 = time.monotonic_ns()
+        # the sanctioned sync is itself a span + counter: the flight
+        # recorder and bench phase breakdown both need "how much wall
+        # time went to host<->device" to be a first-class number
+        self.tracer.span_end(t_sync0, "engine.host_sync", cat="engine")
+        self.metrics.counters["engine_host_syncs"].inc()
+        self.metrics.counters["phase_us_host_sync"].inc(
+            (t_sync1 - t_sync0) // 1000)
+        if t_dispatch_ns:
+            # per-chunk latency, dispatch -> processed (pipelined chunks
+            # overlap, so sums can exceed wall clock — documented)
+            self.metrics.counters["phase_us_decode"].inc(
+                (t_sync1 - t_dispatch_ns) // 1000)
         block = np.asarray(block)
         lps = np.asarray(lps)
         now = time.time()
         K = self.decode_chunk
         for i, req, pos0 in snapshot:
+            if t_dispatch_ns:
+                # one decode-chunk span per live snapshot slot: these are
+                # the leaves of a request's exported timeline
+                self.tracer.span_end(t_dispatch_ns, "engine.decode_chunk",
+                                     cat="engine", rid=req.request_id)
             s = self.slots[i]
             if not s.active or s.request is not req:
                 continue  # retired mid-flight (possibly re-admitted)
@@ -2520,6 +2709,19 @@ class Engine:
                 logger.exception("dense keep extraction failed")
         self.metrics.counters["engine_completed"].inc()
         self.metrics.rates["requests_completed"].mark()
+        if req is not None:
+            # flight-recorder request timeline (ring write, engine thread)
+            self.flight.record_request({
+                "rid": req.request_id,
+                "priority": req.priority,
+                "prompt_len": len(req.prompt) + req.resume_len,
+                "generated": len(slot.generated),
+                "reason": reason,
+                "submitted_at": req.submitted_at,
+                "admitted_at": slot.admitted_at,
+                "first_token_at": slot.first_token_at,
+                "retired_at": time.time(),
+            })
         if req is not None:
             # raw-model logprobs of the generated tokens (parallel list);
             # delivered via request metadata so on_done's signature stays

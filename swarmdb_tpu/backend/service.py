@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from ..core.messages import Message, MessagePriority, MessageType
 from ..core.runtime import SwarmDB
+from ..obs import TRACER
 from ..utils.hashing import stable_partition
 from .engine import Engine, GenRequest, PagedKV
 from .sampling import SamplingParams
@@ -358,7 +359,12 @@ class ServingService:
             pipeline_depth=int(os.environ.get("SWARMDB_PIPELINE", "2")),
             prefix_fns=prefix_fns, prefix_pages=prefix_pages,
             prefix_page_size=page_size, forward_last_fn=fwd_last,
+            # watchdog restarts auto-dump the flight record here (see
+            # obs/flight.py; SWARMDB_FLIGHT_DIR overrides)
+            flight_dir=os.path.join(db.save_dir, "flight"),
         )
+        engine.flight.meta.update({"backend_id": backend_id,
+                                   "model": model_name})
         return cls(db, engine, tokenizer, backend_id=backend_id)
 
     def start(self, warmup: Optional[bool] = None) -> None:
@@ -702,6 +708,7 @@ class ServingService:
     ) -> str:
         """Submit one message for generation; reply is emitted on completion.
         Returns the engine request id."""
+        t_serve = TRACER.span_begin()
         msg.stage_stamp("admitted")
         # rolling-KV bookkeeping reads the stream length BEFORE the
         # prompt-window fetch: a message landing between the two reads
@@ -903,9 +910,17 @@ class ServingService:
                     req.resume_len = resume[1]
                     req.resume_epoch = resume[2]
             if n > 1:
-                return self._serve_n(msg, req, prompt, sampling, priority, n,
-                                     want_logprobs, on_done)
-            return self.engine.submit(req)
+                rid = self._serve_n(msg, req, prompt, sampling, priority, n,
+                                    want_logprobs, on_done)
+            else:
+                rid = self.engine.submit(req)
+            # the span covers prompt build + trim + submit; args link the
+            # message id to the ENGINE request id so one export joins the
+            # runtime/broker spans (rid = msg.id) to the engine spans
+            # (rid = engine request id)
+            TRACER.span_end(t_serve, "serve.request", cat="serving",
+                            rid=msg.id, args={"engine_rid": rid})
+            return rid
         except Exception:
             # the in-flight claim taken by _rolling_plan must not leak on
             # ANY failure between the plan and the submit (ADVICE r4 low

@@ -369,8 +369,14 @@ class Replicator:
         self.addr = (host or "127.0.0.1", int(port))
         # tp -> follower durable end, written by recv_acks / clamped at
         # reconnect under the condition below
-        # swarmlint: guarded-by[self._cv]: acked
+        # swarmlint: guarded-by[self._cv]: acked, _ack_advanced_at
         self.acked: Dict[Tuple[str, int], int] = {}
+        # tp -> wall time the follower's watermark last ADVANCED — the
+        # age half of the lag gauge (/metrics): a lagging partition whose
+        # watermark is also old means the follower is stalled, not just
+        # busy catching up
+        self._ack_advanced_at: Dict[Tuple[str, int], float] = {}
+        self._started_at = time.time()
         self.gapped: set = set()
         self.connected = threading.Event()
         self._cv = threading.Condition()
@@ -395,6 +401,34 @@ class Replicator:
         # benign racy read of a watermark — a stale value only delays a
         # swarmlint: disable=SWL301 -- delivery report by one poll tick
         return self.acked.get((topic, part), 0)
+
+    def lag_stats(self, ends: Dict[Tuple[str, int], int]) -> Dict:
+        """Fsync-watermark lag vs the leader's end offsets: total lagging
+        RECORDS across partitions, and the age in SECONDS of the stalest
+        lagging watermark (0.0 when fully caught up). Gapped partitions
+        count their full backlog — they are out of the watermark until
+        the operator re-seeds (see module docstring)."""
+        with self._cv:
+            acked = dict(self.acked)
+            advanced = dict(self._ack_advanced_at)
+        now = time.time()
+        records = 0
+        stalest = 0.0
+        for tp, end in ends.items():
+            behind = max(0, end - (0 if tp in self.gapped
+                                   else acked.get(tp, 0)))
+            if behind <= 0:
+                continue
+            records += behind
+            stalest = max(stalest,
+                          now - advanced.get(tp, self._started_at))
+        return {
+            "target": f"{self.addr[0]}:{self.addr[1]}",
+            "lag_records": records,
+            "lag_seconds": round(stalest, 3),
+            "connected": self.connected.is_set(),
+            "gapped": len(self.gapped),
+        }
 
     def wait_acked(self, topic: str, part: int, offset: int,
                    timeout_s: float) -> bool:
@@ -480,6 +514,9 @@ class Replicator:
                             _recv_exact(sock, _ACK_HDR.size))
                         topic = _recv_exact(sock, tlen).decode()
                         with self._cv:
+                            if end > self.acked.get((topic, part), -1):
+                                self._ack_advanced_at[(topic, part)] = \
+                                    time.time()
                             self.acked[(topic, part)] = end
                             self._cv.notify_all()
                 except (ConnectionError, OSError, BrokerError):
@@ -590,6 +627,21 @@ class ReplicatedBroker(Broker):
                                 max(0.0, deadline - time.time())):
                 return False
         return True
+
+    def replication_stats(self) -> List[Dict]:
+        """Per-follower fsync-watermark lag vs this leader's log (the
+        /metrics replica gauges — VERDICT row 3: the acks=all
+        back-pressure path used to be observable only as stalled
+        DELIVERED reports). One end-offset sweep shared by every
+        follower's :meth:`Replicator.lag_stats`."""
+        ends: Dict[Tuple[str, int], int] = {}
+        for name, meta in self.inner.list_topics().items():
+            for p in range(meta.num_partitions):
+                try:
+                    ends[(name, p)] = self.inner.end_offset(name, p)
+                except BrokerError:
+                    continue
+        return [r.lag_stats(ends) for r in self.replicators]
 
     def close(self) -> None:
         for r in self.replicators:

@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Optional, Union
 
 from pydantic import BaseModel, Field, field_validator
 
+from ..obs import TRACER
+
 
 class MessageType(str, enum.Enum):
     """Kinds of inter-agent traffic (reference ` main.py:23-32`)."""
@@ -104,8 +106,15 @@ class Message(BaseModel):
 
         Stages used by the serving path: ``enqueued``, ``admitted``,
         ``prefill_done``, ``first_token``, ``done``.
+
+        Subsumed by the span tracer (swarmdb_tpu/obs): each stamp also
+        lands as an instant event keyed by the message id, so the stage
+        marks appear on the same exported timeline as the engine's
+        prefill/decode spans. The metadata dict is kept for wire/API
+        compatibility (clients read ``metadata["stages"]``).
         """
         self.metadata.setdefault("stages", {})[stage] = time.time()
+        TRACER.instant(f"stage.{stage}", cat="stage", rid=self.id)
 
 
 @dataclass

@@ -35,6 +35,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.base import Broker, Consumer, Producer, Record
+from ..obs import TRACER
 from ..utils.hashing import stable_partition
 from ..utils.metrics import MetricsRegistry
 from .messages import (
@@ -344,6 +345,7 @@ class SwarmDB:
         registered agent except the sender and is produced to EVERY
         partition (fan-out write) so partition-affine consumers still see it.
         """
+        t_send = TRACER.span_begin()
         message_type = MessageType(message_type)
         priority = MessagePriority(priority)
         # auto-register both ends (reference :419-427)
@@ -394,10 +396,13 @@ class SwarmDB:
             with self._lock:
                 self._set_status(msg, MessageStatus.DELIVERED)
             self.metrics.counters["messages_sent"].inc()
+            TRACER.span_end(t_send, "runtime.send", cat="runtime",
+                            rid=msg.id)
             return msg.id
 
         payload = json.dumps(msg.to_dict()).encode("utf-8")
         key = msg.id.encode("utf-8")
+        t_pub = TRACER.span_begin()
         try:
             if receiver_id is not None:
                 self.producer.produce(
@@ -427,9 +432,11 @@ class SwarmDB:
                 logger.exception("error-topic produce failed for %s", msg.id)
             raise
 
+        TRACER.span_end(t_pub, "broker.publish", cat="broker", rid=msg.id)
         self.metrics.counters["messages_sent"].inc()
         self.metrics.rates["messages_sent"].mark()
         self._maybe_autosave()
+        TRACER.span_end(t_send, "runtime.send", cat="runtime", rid=msg.id)
         return msg.id
 
     def broadcast_message(
@@ -470,6 +477,7 @@ class SwarmDB:
         wall-clock ``timeout``; marks received messages READ."""
         self.register_agent(agent_id)
         consumer = self.consumers[agent_id]
+        t_recv = TRACER.span_begin()
         out: List[Message] = []
         deadline = time.time() + timeout
         while len(out) < max_messages:
@@ -526,6 +534,13 @@ class SwarmDB:
             out.append(target)
             self.metrics.counters["messages_received"].inc()
             self.metrics.rates[f"agent_recv:{agent_id}"].mark()
+        if out:
+            # productive polls only, and the FIRST received message's id
+            # as the span rid: empty polls dominate a quiet consumer loop
+            # and per-poll/per-message records were the bulk of the
+            # tracer's echo-mode overhead (measured ~2x the 5% budget)
+            TRACER.span_end(t_recv, "runtime.receive", cat="runtime",
+                            rid=out[0].id)
         return out
 
     # ------------------------------------------------------------ read/query

@@ -1,0 +1,16 @@
+"""Observability: request-span tracing + engine flight recorder.
+
+Stdlib-only (no jax import) so the broker/runtime layers can record
+spans in processes that never touch a device, and so swarmlint's CI job
+can import the package without the ML stack.
+
+- :mod:`.tracer` — per-thread ring-buffer span tracer, Chrome
+  trace-event export (``GET /admin/trace/export``).
+- :mod:`.flight` — fixed-size rings of engine-step and request records,
+  dumped on watchdog restart and via ``GET /admin/flight``.
+"""
+
+from .flight import FlightRecorder
+from .tracer import TRACER, SpanTracer
+
+__all__ = ["FlightRecorder", "SpanTracer", "TRACER"]

@@ -1,0 +1,152 @@
+"""Always-on flight recorder: the last N engine steps + last M requests.
+
+When the engine dies (watchdog restart, in-loop error recovery) the
+question is always "what was it doing right before?" — and the metrics
+counters only answer "how much, ever". The flight recorder keeps two
+fixed-size rings that are cheap enough to feed on every engine-loop
+iteration:
+
+- **step records** — one per engine step while work exists: batch
+  occupancy (per shard on DP-sharded pools), queue depth by priority,
+  pipeline depth in flight, and the cumulative counters that explain
+  throughput (prompt/generated tokens, prefill padding waste, prefix
+  hit/miss tokens, sanctioned host syncs, compiled-variant count,
+  restarts).
+- **request records** — one per retirement: the request's timeline
+  (submitted → admitted → first token → retired), priority, prompt
+  length, generated count, finish reason.
+
+Both rings are written ONLY by the engine thread (no locks on the record
+path); readers snapshot racily, which at worst tears one record. Dumps
+are triggered automatically by :meth:`Engine.restart` (the watchdog
+path) and on demand via ``GET /admin/flight``; ``bench.py`` deposits one
+per mode under ``bench_logs/``.
+
+Knobs: ``SWARMDB_FLIGHT_STEPS`` (ring size, default 512),
+``SWARMDB_FLIGHT_REQUESTS`` (default 256), ``SWARMDB_FLIGHT_DIR``
+(where automatic dumps land; unset = in-memory ``last_dump`` only).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("swarmdb_tpu.obs")
+
+__all__ = ["FlightRecorder"]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _DictRing:
+    """Fixed-size single-writer ring of dict records."""
+
+    __slots__ = ("records", "idx", "cap")
+
+    def __init__(self, cap: int) -> None:
+        self.records: List[Optional[Dict[str, Any]]] = [None] * cap
+        self.idx = 0
+        self.cap = cap
+
+    def put(self, rec: Dict[str, Any]) -> None:
+        self.records[self.idx % self.cap] = rec
+        self.idx += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        idx = self.idx
+        records = list(self.records)
+        if idx <= self.cap:
+            out = records[:idx]
+        else:
+            cut = idx % self.cap
+            out = records[cut:] + records[:cut]
+        return [r for r in out if r is not None]
+
+
+class FlightRecorder:
+    def __init__(self, n_steps: Optional[int] = None,
+                 n_requests: Optional[int] = None) -> None:
+        if n_steps is None:
+            n_steps = _env_int("SWARMDB_FLIGHT_STEPS", 512)
+        if n_requests is None:
+            n_requests = _env_int("SWARMDB_FLIGHT_REQUESTS", 256)
+        self._steps = _DictRing(max(8, n_steps))
+        self._requests = _DictRing(max(8, n_requests))
+        # free-form identity (mesh shape, shard count, model) set by the
+        # engine builder; rides every dump
+        self.meta: Dict[str, Any] = {}
+        self.last_dump: Optional[Dict[str, Any]] = None
+        self.last_dump_path: Optional[str] = None
+
+    # ---------------------------------------------------------- record path
+
+    def record_step(self, rec: Dict[str, Any]) -> None:
+        """One engine-step record (engine thread only — no locks)."""
+        self._steps.put(rec)
+
+    def record_request(self, rec: Dict[str, Any]) -> None:
+        """One completed/failed request timeline (engine thread only)."""
+        self._requests.put(rec)
+
+    # -------------------------------------------------------------- reading
+
+    def steps(self) -> List[Dict[str, Any]]:
+        return self._steps.snapshot()
+
+    def requests(self) -> List[Dict[str, Any]]:
+        return self._requests.snapshot()
+
+    def dump(self, reason: str = "on_demand") -> Dict[str, Any]:
+        return {
+            "reason": reason,
+            "dumped_at": time.time(),
+            "meta": dict(self.meta),
+            "steps": self.steps(),
+            "requests": self.requests(),
+        }
+
+    def dump_to(self, directory: str, reason: str = "on_demand") -> str:
+        """Write a dump file under ``directory`` and return its path."""
+        os.makedirs(directory, exist_ok=True)
+        payload = self.dump(reason)
+        path = os.path.join(
+            directory, f"flight_{int(payload['dumped_at'] * 1000)}_{reason}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        self.last_dump = payload
+        self.last_dump_path = path
+        return path
+
+    def auto_dump(self, reason: str,
+                  directory: Optional[str] = None) -> Optional[str]:
+        """Best-effort dump for failure paths (watchdog restart, engine
+        error): never raises — the recovery it instruments must survive a
+        full disk or an unwritable directory. ``SWARMDB_FLIGHT_DIR``
+        overrides the configured directory (CI uploads one fixed dir);
+        with neither set, only the in-memory ``last_dump`` is kept."""
+        directory = os.environ.get("SWARMDB_FLIGHT_DIR") or directory
+        try:
+            if directory:
+                path = self.dump_to(directory, reason)
+                logger.info("flight record dumped to %s (%s)", path, reason)
+                return path
+            self.last_dump = self.dump(reason)
+            return None
+        except Exception:
+            logger.exception("flight-record dump failed (%s)", reason)
+            try:
+                self.last_dump = self.dump(reason)
+            except Exception:
+                pass
+            return None

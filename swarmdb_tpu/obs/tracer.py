@@ -1,0 +1,264 @@
+"""Low-overhead request-span tracer (the flight recorder's twin).
+
+The serving path previously had ONE tracing hook — ``Message.stage_stamp``
+wall-clock stamps in a metadata dict (SURVEY §5.1) — which cannot explain
+where a request's latency went: queue wait, prefill, decode chunks, and
+host syncs all collapse into "done minus enqueued". This tracer records
+closed spans with monotonic clocks into per-thread ring buffers and
+exports them as Chrome trace-event JSON (``chrome://tracing`` /
+https://ui.perfetto.dev load it directly), so a request is a readable
+timeline from the API route through the broker to individual engine
+decode chunks.
+
+Design constraints (the record path runs inside the engine decode loop
+and the broker send path):
+
+- **Zero locks on record.** Each thread owns one ring buffer; the only
+  lock is taken once per thread lifetime, at ring registration. Readers
+  (export) take benign racy snapshots — a torn read costs at most one
+  event, never a crash.
+- **Bounded memory.** Rings are fixed-size (``SWARMDB_TRACE_RING``,
+  default 4096 events/thread); old events are overwritten. Rings of dead
+  threads are pruned at the next registration.
+- **Monotonic time.** Spans are stamped with ``time.monotonic_ns`` so a
+  wall-clock step can never produce negative durations; one
+  (monotonic, epoch) anchor pair converts to wall time at export.
+- **Two record APIs.** ``span(...)`` is a convenience context manager for
+  warm paths; hot-path functions (``# swarmlint: hot``) must use the
+  allocation-free ``span_begin()`` / ``span_end()`` pair — machine-checked
+  by swarmlint SWL501/SWL502 (analysis/spans.py).
+
+``SWARMDB_TRACE=0`` disables recording entirely (the record path then
+costs one attribute read and a branch).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["SpanTracer", "TRACER"]
+
+# event tuple layout: (name, cat, rid, t0_ns, t1_ns, args-or-None)
+_Event = Tuple[str, str, Optional[str], int, int, Optional[Dict[str, Any]]]
+
+
+class _Ring:
+    """Single-writer event ring owned by one thread."""
+
+    __slots__ = ("events", "idx", "cap", "tid", "name")
+
+    def __init__(self, cap: int, tid: int, name: str) -> None:
+        self.events: List[Optional[_Event]] = [None] * cap
+        self.idx = 0
+        self.cap = cap
+        self.tid = tid
+        self.name = name
+
+    def put(self, ev: _Event) -> None:
+        self.events[self.idx % self.cap] = ev
+        self.idx += 1
+
+    def snapshot(self) -> List[_Event]:
+        """Oldest-first copy (benign racy read from other threads)."""
+        idx = self.idx
+        events = list(self.events)  # one shot; writer may lap one slot
+        if idx <= self.cap:
+            out = events[:idx]
+        else:
+            cut = idx % self.cap
+            out = events[cut:] + events[:cut]
+        return [e for e in out if e is not None]
+
+
+class _SpanCtx:
+    """Tiny context manager for ``SpanTracer.span`` (warm paths only)."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_rid", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: str,
+                 rid: Optional[str], args: Optional[Dict[str, Any]]) -> None:
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._rid = rid
+        self._args = args
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = self._tracer.span_begin()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._tracer.span_end(self._t0, self._name, cat=self._cat,
+                              rid=self._rid, args=self._args)
+
+
+class SpanTracer:
+    def __init__(self, capacity_per_thread: Optional[int] = None,
+                 enabled: Optional[bool] = None) -> None:
+        if capacity_per_thread is None:
+            try:
+                capacity_per_thread = int(
+                    os.environ.get("SWARMDB_TRACE_RING", "4096"))
+            except ValueError:
+                capacity_per_thread = 4096
+        if enabled is None:
+            enabled = os.environ.get("SWARMDB_TRACE", "1") != "0"
+        self.enabled = bool(enabled)
+        self.capacity = max(16, capacity_per_thread)
+        # ring registry: (ring, weakref-to-owning-thread); mutated only
+        # under _reg_lock (once per thread lifetime + resets)
+        self._rings: List[Tuple[_Ring, "weakref.ref"]] = []
+        self._reg_lock = threading.Lock()
+        self._local = threading.local()
+        # clock anchor: monotonic <-> epoch, captured together once
+        self._anchor_mono_ns = time.monotonic_ns()
+        self._anchor_epoch = time.time()
+
+    # ------------------------------------------------------------ recording
+
+    def _ring(self) -> _Ring:
+        ring = getattr(self._local, "ring", None)
+        if ring is None:
+            t = threading.current_thread()
+            ring = _Ring(self.capacity, t.ident or 0, t.name)
+            self._local.ring = ring
+            with self._reg_lock:
+                # prune rings whose owner thread is gone (bounds the
+                # registry under thread churn; their events are dropped,
+                # which matches the ring's own overwrite semantics)
+                alive = []
+                for r, wr in self._rings:
+                    owner = wr()
+                    if owner is not None and owner.is_alive():
+                        alive.append((r, wr))
+                self._rings = alive
+                self._rings.append((ring, weakref.ref(t)))
+        return ring
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    def span_begin(self) -> int:
+        """Monotonic-ns start stamp for ``span_end`` — allocation-free,
+        the hot-path half of the API (swarmlint SWL501 checks balance)."""
+        return time.monotonic_ns() if self.enabled else 0
+
+    def span_end(self, t0: int, name: str, cat: str = "span",
+                 rid: Optional[str] = None,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        """Record the closed span started at ``t0`` (one ring write)."""
+        if not self.enabled or t0 == 0:
+            return
+        self._ring().put((name, cat, rid, t0, time.monotonic_ns(), args))
+
+    def span_at(self, name: str, start_epoch: float, end_epoch: float,
+                cat: str = "span", rid: Optional[str] = None,
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a span from WALL-clock endpoints (retro-spans for
+        intervals whose start predates the tracer call site, e.g. queue
+        wait measured from ``submitted_at``)."""
+        if not self.enabled:
+            return
+        t0 = self.mono_of_epoch(start_epoch)
+        t1 = max(t0, self.mono_of_epoch(end_epoch))
+        self._ring().put((name, cat, rid, t0, t1, args))
+
+    def instant(self, name: str, cat: str = "mark",
+                rid: Optional[str] = None,
+                args: Optional[Dict[str, Any]] = None) -> None:
+        if not self.enabled:
+            return
+        now = time.monotonic_ns()
+        self._ring().put((name, cat, rid, now, now, args))
+
+    def span(self, name: str, cat: str = "span", rid: Optional[str] = None,
+             args: Optional[Dict[str, Any]] = None) -> _SpanCtx:
+        """Context-manager convenience (allocates — NOT for hot-path
+        functions; swarmlint SWL502 flags it there)."""
+        return _SpanCtx(self, name, cat, rid, args)
+
+    # -------------------------------------------------------------- reading
+
+    def mono_of_epoch(self, epoch_s: float) -> int:
+        return self._anchor_mono_ns + int(
+            (epoch_s - self._anchor_epoch) * 1e9)
+
+    def epoch_of_mono(self, mono_ns: int) -> float:
+        return self._anchor_epoch + (mono_ns - self._anchor_mono_ns) / 1e9
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """All buffered events as dicts (oldest-first per thread)."""
+        with self._reg_lock:
+            rings = [r for r, _ in self._rings]
+        out: List[Dict[str, Any]] = []
+        for ring in rings:
+            for name, cat, rid, t0, t1, args in ring.snapshot():
+                out.append({
+                    "name": name, "cat": cat, "rid": rid,
+                    "start_s": self.epoch_of_mono(t0),
+                    "dur_us": (t1 - t0) / 1e3,
+                    "tid": ring.tid, "thread": ring.name,
+                    "args": args,
+                })
+        out.sort(key=lambda e: e["start_s"])
+        return out
+
+    def events_for(self, rid: str) -> List[Dict[str, Any]]:
+        """One request's timeline (spans recorded with this rid)."""
+        return [e for e in self.snapshot() if e["rid"] == rid]
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (Perfetto / chrome://tracing loadable):
+        complete ("ph": "X") events, microsecond timestamps relative to
+        the tracer's clock anchor, one named track per source thread."""
+        pid = os.getpid()
+        with self._reg_lock:
+            rings = [r for r, _ in self._rings]
+        events: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "swarmdb_tpu"},
+        }]
+        for ring in rings:
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": ring.tid, "args": {"name": ring.name},
+            })
+            for name, cat, rid, t0, t1, args in ring.snapshot():
+                ev: Dict[str, Any] = {
+                    "name": name, "cat": cat, "ph": "X", "pid": pid,
+                    "tid": ring.tid,
+                    "ts": (t0 - self._anchor_mono_ns) / 1e3,
+                    "dur": max(0.0, (t1 - t0) / 1e3),
+                }
+                if rid is not None or args:
+                    a: Dict[str, Any] = dict(args or {})
+                    if rid is not None:
+                        a["rid"] = rid
+                    ev["args"] = a
+                events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "anchor_epoch_s": self._anchor_epoch,
+                "clock": "monotonic_ns relative to anchor",
+            },
+        }
+
+    def reset(self) -> None:
+        """Drop every buffered event (tests / bench window isolation).
+        Live threads lazily re-register their rings on the next record."""
+        with self._reg_lock:
+            self._rings.clear()
+        # threads keep their old (now unregistered) ring until they next
+        # record through _ring(); force re-registration for THIS thread
+        self._local = threading.local()
+
+
+# Process-global default tracer: every layer (API, runtime, broker,
+# engine) records here so one export holds the whole request path.
+TRACER = SpanTracer()

@@ -489,4 +489,15 @@ def build_serving_engine(
     # replicated engine state must live ON the mesh (mandatory for
     # multi-process pods, harmless single-process): Engine.place_state
     engine.place_state(sm.mesh)
+    # flight-recorder identity: step records of a sharded engine carry
+    # per-shard occupancy (Engine._flight_step); the dump's meta block
+    # names the mesh so a reader knows what those shards ARE
+    engine.flight.meta.update({
+        "mesh": {k: int(v) for k, v in sm.mesh.shape.items()},
+        "paged_shards": int(getattr(
+            getattr(engine.paged, "allocator", None), "n_shards", 1)
+            if engine.paged else 1),
+        "max_batch": max_batch,
+        "max_seq": max_seq,
+    })
     return engine, sm

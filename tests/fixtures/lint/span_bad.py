@@ -1,0 +1,57 @@
+"""Seeded span-discipline violations (SWL501/SWL502) — lint fixture.
+
+Not imported by anything; analyzed as text by tests/test_swarmlint.py.
+"""
+
+from swarmdb_tpu.obs import TRACER
+
+
+def begun_never_ended(x):
+    t0 = TRACER.span_begin()  # EXPECT: SWL501
+    return x + 1 if t0 else x
+
+
+def discarded_stamp(x):
+    TRACER.span_begin()  # EXPECT: SWL501
+    TRACER.span_end(0, "noop")
+    return x
+
+
+# swarmlint: hot
+def hot_with_ctx_manager(tracer, work):
+    with tracer.span("decode", cat="engine"):  # EXPECT: SWL502
+        return work()
+
+
+def balanced_ok(tracer, work):
+    t0 = tracer.span_begin()
+    out = work()
+    tracer.span_end(t0, "work")
+    return out
+
+
+def end_only_ok(tracer, t_dispatch):
+    # closing against an externally carried stamp is the sanctioned
+    # hot-path pattern — no finding
+    tracer.span_end(t_dispatch, "chunk")
+
+
+def nested_does_not_balance(tracer):
+    t0 = tracer.span_begin()  # EXPECT: SWL501
+
+    def inner():
+        tracer.span_end(t0, "inner-owned")
+
+    return inner
+
+
+class Ctx:
+    def __enter__(self):
+        self._t0 = self_tracer.span_begin()  # balance-exempt by protocol
+        return self
+
+    def __exit__(self, *exc):
+        self_tracer.span_end(self._t0, "ctx")
+
+
+self_tracer = TRACER

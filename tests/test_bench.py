@@ -160,12 +160,16 @@ def test_run_all_emits_detail_lines_then_compact_summary(monkeypatch, capsys):
     monkeypatch.setenv("SWARMDB_BENCH_SECONDS", "0.5")
     bench._run_all()
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
-    assert len(lines) == 2
-    detail, summary = lines
+    assert len(lines) == 3
+    longctx, detail, summary = lines
+    # longctx is opt-in only, but the skip must be machine-readable
+    assert longctx["mode"] == "longctx" and longctx["skipped"]
+    assert longctx["reason_code"] == "warmup_compile_budget"
     assert detail["mode"] == "echo"
     assert detail["value"] > 0
     assert summary["mode"] == "all"
     assert summary["modes"]["echo"]["v"] == detail["value"]
+    assert summary["modes"]["longctx"] == {"skip": "warmup_compile_budget"}
     assert len(json.dumps(summary)) < 1500
 
 
@@ -188,7 +192,21 @@ def test_serve_mode_end_to_end_cpu(monkeypatch):
     monkeypatch.setenv("SWARMDB_BENCH_SEQ", "128")
     monkeypatch.setenv("SWARMDB_BENCH_WARM_COMPLETIONS", "2")
     monkeypatch.setenv("SWARMDB_BENCH_AGENTS", "8")
-    result = bench.bench_serve(seconds=3.0)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as logs:
+        monkeypatch.setenv("SWARMDB_BENCH_LOGS_DIR", logs)
+        result = bench.bench_serve(seconds=3.0)
+        # observability artifacts deposited with the run (ISSUE 2)
+        assert result["trace_artifact"].startswith(logs)
+        assert result["flight_artifact"].startswith(logs)
+        trace = json.load(open(result["trace_artifact"]))
+        assert any(e.get("name") == "engine.decode_chunk"
+                   for e in trace["traceEvents"])
+        flight = json.load(open(result["flight_artifact"]))
+        assert flight["steps"] and flight["requests"]
+    assert result.get("phase_shares"), result.get("phase_seconds")
+    assert abs(sum(result["phase_shares"].values()) - 1.0) < 0.01
     assert result["metric"] == "completed_messages_per_sec"
     assert result["value"] > 0
     assert result["prompt_tokens_per_sec"] > 0

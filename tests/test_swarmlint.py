@@ -39,6 +39,7 @@ def expected_findings(path: Path):
     "recompile_bad.py",         # recompile family (SWL201/202/203)
     "lock_bad.py",              # lock-discipline family (SWL301)
     "tracer_leak_bad.py",       # tracer-leak family (SWL401)
+    "span_bad.py",              # span-discipline family (SWL501/502)
 ])
 def test_each_family_detects_seeded_violations(name):
     path = FIXTURES / name
@@ -123,5 +124,6 @@ def test_cli_module_smoke():
         [sys.executable, "-m", "swarmdb_tpu.analysis", "--list-rules"],
         cwd=str(REPO), capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    for rule in ("SWL101", "SWL203", "SWL301", "SWL401"):
+    for rule in ("SWL101", "SWL203", "SWL301", "SWL401", "SWL501",
+                 "SWL502"):
         assert rule in proc.stdout
