@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Unattended TPU-window poller (VERDICT r5 #1: rounds 4 AND 5 both
+missed their silicon windows because nothing was armed — r5's tunnel
+answered for ~7 minutes at session start and the manual queue script was
+never fired).
+
+Probes the backend on a short period and fires the measurement queue
+(scripts/tpu_session_r5.sh by default) THE MOMENT a probe answers,
+teeing everything into bench_logs/. Stdlib-only; safe to leave running
+for days:
+
+- every probe runs ``jax.devices()`` in a SUBPROCESS with a hard timeout
+  (the bench's round-1 lesson: a dead tunnel can hang backend init
+  forever — the poller itself must never wedge);
+- single-instance lock file (bench_logs/tpu_poller.lock, stale-PID
+  aware) so a cron line and a shell both arming it cannot double-fire
+  the queue against one chip;
+- after a fired session finishes, the poller REARMS (--once disables):
+  a tunnel that flaps on ~hour timescales gets caught again, and the
+  session script's own per-step tees mean a mid-run death still leaves
+  committed evidence;
+- every state change is appended to bench_logs/tpu_poller.log with a
+  UTC timestamp, so the driver record shows when the window opened and
+  what was launched.
+
+Arm it:            nohup python scripts/tpu_poller.py >/dev/null 2>&1 &
+or via cron:       * * * * * cd /root/repo && python scripts/tpu_poller.py --once-probe
+(--once-probe exits after a single probe+maybe-fire cycle — cron IS the
+loop; the lock file keeps overlapping cron fires out.)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGS = os.path.join(REPO, "bench_logs")
+LOCK = os.path.join(LOGS, "tpu_poller.lock")
+LOG = os.path.join(LOGS, "tpu_poller.log")
+
+_PROBE_CODE = (
+    "import jax, json; d = jax.devices()[0]; "
+    "print(json.dumps({'platform': d.platform, "
+    "'device_kind': getattr(d, 'device_kind', '')}))"
+)
+
+
+def log(msg: str) -> None:
+    line = f"[tpu-poller {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}"
+    print(line, flush=True)
+    os.makedirs(LOGS, exist_ok=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float) -> dict:
+    """One subprocess probe; {'ok': bool, ...} — never raises, never
+    hangs past timeout_s (same contract as bench.probe_backend)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            info = json.loads(out.stdout.strip().splitlines()[-1])
+            if info.get("platform") in ("tpu", "axon"):
+                return {"ok": True, **info}
+            return {"ok": False,
+                    "error": f"platform={info.get('platform')}"}
+        return {"ok": False,
+                "error": (out.stderr or "no output").strip()[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"probe timed out after {timeout_s:.0f}s"}
+    except Exception as exc:  # noqa: BLE001 — the poller must never die
+        return {"ok": False, "error": repr(exc)[-300:]}
+
+
+def take_lock() -> bool:
+    """Single-instance lock with stale-PID recovery."""
+    os.makedirs(LOGS, exist_ok=True)
+    while True:
+        try:
+            fd = os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            try:
+                pid = int(open(LOCK).read().strip() or "0")
+            except (ValueError, OSError):
+                pid = 0
+            if pid > 0:
+                try:
+                    os.kill(pid, 0)
+                    return False  # live holder
+                except ProcessLookupError:
+                    pass  # stale
+                except PermissionError:
+                    return False
+            try:
+                os.unlink(LOCK)  # stale/corrupt — retry the O_EXCL create
+            except FileNotFoundError:
+                pass
+
+
+def release_lock() -> None:
+    try:
+        if int(open(LOCK).read().strip() or "0") == os.getpid():
+            os.unlink(LOCK)
+    except (OSError, ValueError):
+        pass
+
+
+def fire(session: str) -> int:
+    ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    tee_path = os.path.join(LOGS, f"tpu_session_{ts}.log")
+    log(f"tunnel is UP — firing {session} (tee: {tee_path})")
+    with open(tee_path, "a") as tee:
+        proc = subprocess.Popen(
+            ["bash", session], cwd=REPO, stdout=tee, stderr=tee,
+        )
+        rc = proc.wait()
+    log(f"session finished rc={rc}")
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--session",
+                    default=os.path.join("scripts", "tpu_session_r5.sh"),
+                    help="queue script fired when the tunnel answers")
+    ap.add_argument("--interval", type=float, default=120.0,
+                    help="seconds between probes (daemon mode)")
+    ap.add_argument("--probe-timeout", type=float, default=60.0)
+    ap.add_argument("--once", action="store_true",
+                    help="exit after the first fired session")
+    ap.add_argument("--once-probe", action="store_true",
+                    help="one probe cycle then exit (cron mode)")
+    args = ap.parse_args()
+
+    if not take_lock():
+        print("another tpu_poller holds the lock; exiting", file=sys.stderr)
+        return 0
+    try:
+        log(f"armed: session={args.session} interval={args.interval:.0f}s "
+            f"probe_timeout={args.probe_timeout:.0f}s")
+        while True:
+            p = probe(args.probe_timeout)
+            if p["ok"]:
+                fire(args.session)
+                if args.once or args.once_probe:
+                    return 0
+                log("rearmed — waiting for the next window")
+            elif args.once_probe:
+                return 0
+            time.sleep(args.interval)
+    finally:
+        release_lock()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

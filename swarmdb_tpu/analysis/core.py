@@ -80,6 +80,9 @@ RULES: Dict[str, Rule] = {
         Rule("SWL203", "recompile-hazard",
              "jit entry point not reachable from the class's warmup call "
              "plan — first real traffic pays a cold compile"),
+        Rule("SWL204", "recompile-hazard",
+             "len()-shaped host array reaches a jit-wrapped callable — "
+             "every distinct count is a fresh traced shape (compile mine)"),
         Rule("SWL301", "lock-discipline",
              "guarded attribute accessed outside a `with` on its declared "
              "lock/Condition"),

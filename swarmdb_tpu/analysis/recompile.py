@@ -1,8 +1,8 @@
-"""recompile-hazard checks (SWL201/SWL202/SWL203).
+"""recompile-hazard checks (SWL201/SWL202/SWL203/SWL204).
 
 Every compiled variant costs 10-90 s on this image's tunneled XLA service
 (backend/engine.py warmup docstring), so a silent recompile mid-traffic is
-a latency cliff, not a nuisance. Three statically checkable shapes:
+a latency cliff, not a nuisance. Four statically checkable shapes:
 
 - SWL201: ``jax.jit`` (or ``pmap``) *called* inside a loop or a hot
   function. ``jit`` caches by wrapper identity — a fresh wrapper per call
@@ -20,6 +20,13 @@ a latency cliff, not a nuisance. Three statically checkable shapes:
   ``_decode_variants``, or through helper methods such as the mirrored-
   call table). An unreachable jit entry point means the first real request
   through it pays a cold compile while every in-flight request waits.
+- SWL204: a host array whose SHAPE derives from a runtime ``len(...)``
+  / row count (``np.zeros((len(pending), K))`` and friends) handed to a
+  jit-wrapped callable — directly or through a one-hop local binding.
+  Every distinct count is a distinct traced shape, i.e. a fresh compile:
+  the "compile mine" class PROFILE r4 stepped on twice (the eager
+  page-table zeroing and the first ``_extract_lane`` dispatch). The fix
+  is always the same — pad to a fixed wave size or bucket the count.
 """
 
 from __future__ import annotations
@@ -82,6 +89,7 @@ def check(src: SourceFile) -> List[Finding]:
     findings.extend(_check_jit_sites(src))
     findings.extend(_check_call_sites(src))
     findings.extend(_check_warmup_coverage(src))
+    findings.extend(_check_len_shaped_args(src))
     return findings
 
 
@@ -194,6 +202,75 @@ def _check_call_sites(src: SourceFile) -> List[Finding]:
                     f"dict display in static position {pos} of `{last}` — "
                     f"hash depends on insertion order; use a frozen/sorted "
                     f"structure"))
+    return findings
+
+
+# ------------------------------------------------------------------ SWL204
+
+# constructors whose FIRST argument is (or contains) the result shape
+_ARRAY_CTORS = ("zeros", "ones", "full", "empty")
+
+
+def _shape_has_len(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and dotted_name(n.func) == "len"
+               for n in ast.walk(node))
+
+
+def _is_len_shaped_ctor(node: ast.AST) -> bool:
+    """``np.zeros((len(x), K))``-style: an array constructor whose shape
+    expression embeds a runtime ``len(...)``."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return False
+    name = dotted_name(node.func)
+    if not name or name.split(".")[-1] not in _ARRAY_CTORS:
+        return False
+    return _shape_has_len(node.args[0])
+
+
+def _check_len_shaped_args(src: SourceFile) -> List[Finding]:
+    """SWL204: len()-shaped host arrays reaching jitted callables. Scope
+    is per-function: a direct constructor argument, or a local name bound
+    to such a constructor earlier in the same function (one hop — the
+    pattern both PROFILE r4 mines took)."""
+    findings: List[Finding] = []
+    jitted = _collect_jitted(src)
+    if not jitted:
+        return findings
+    fns = [n for n in ast.walk(src.tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns:
+        # one-hop local bindings: name -> the len-shaped ctor node
+        mined: Dict[str, ast.AST] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and _is_len_shaped_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        mined[tgt.id] = node.value
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = dotted_name(node.func)
+            if cname is None or cname.split(".")[-1] not in jitted:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    break
+                # report at the MINE (the constructor), not the call:
+                # that's the line to pad/bucket
+                via = None
+                if _is_len_shaped_ctor(arg):
+                    via = arg
+                elif isinstance(arg, ast.Name) and arg.id in mined:
+                    via = mined[arg.id]
+                if via is not None:
+                    findings.append(make_finding(
+                        src, "SWL204", via,
+                        f"argument of jit-wrapped "
+                        f"`{cname.split('.')[-1]}` has a len()-derived "
+                        f"shape — every distinct count is a fresh traced "
+                        f"shape (a compile mine); pad to a fixed wave "
+                        f"size or bucket the count"))
     return findings
 
 

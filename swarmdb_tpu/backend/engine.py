@@ -367,7 +367,8 @@ class Engine:
                 all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
                 all_lps = jnp.concatenate([last_lps[None], lps], axis=0)
                 all_toks, all_lps = self._replicate_block(all_toks, all_lps)
-                return all_toks, all_lps, last, lps[-1], new_cache
+                last, last_lp = self._pin_slot_state(last, lps[-1])
+                return all_toks, all_lps, last, last_lp, new_cache
 
             def body(carry, _):
                 tok, pos, cache = carry
@@ -388,7 +389,8 @@ class Engine:
             all_toks = jnp.concatenate([last_tokens[None], sampled], axis=0)
             all_lps = jnp.concatenate([last_lps[None], lps], axis=0)
             all_toks, all_lps = self._replicate_block(all_toks, all_lps)
-            return all_toks, all_lps, last, lps[-1], cache
+            last, last_lp = self._pin_slot_state(last, lps[-1])
+            return all_toks, all_lps, last, last_lp, cache
 
         self._decode = jax.jit(
             functools.partial(_decode, use_filters=True),
@@ -461,6 +463,8 @@ class Engine:
             )
             last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
             last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+            last_tokens, last_lps = self._pin_slot_state(last_tokens,
+                                                         last_lps)
             return cache, last_tokens, last_lps
 
         self._prefill_fused = jax.jit(_prefill_insert,
@@ -508,6 +512,8 @@ class Engine:
             v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
             last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
             last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+            last_tokens, last_lps = self._pin_slot_state(last_tokens,
+                                                         last_lps)
             return k_pool, v_pool, last_tokens, last_lps
 
         if paged is not None:
@@ -518,9 +524,26 @@ class Engine:
             if paged.prefill_packed is not None:
                 # same argument order as _prefill_paged_insert, same
                 # donation; rows = n_shards * prefill_batch per wave so
-                # any admission skew still fits one dispatch
+                # any admission skew still fits one dispatch. The pin is
+                # a no-op resharding (shard_map's out_specs already put
+                # the fed-token vectors on the canonical P('data')), so
+                # the packed program stays collective-free.
+                _packed_body_fn = paged.prefill_packed
+
+                def _prefill_packed_pinned(params, tokens, lengths, target,
+                                           scatter, k_pool, v_pool,
+                                           last_tokens, last_lps, keys,
+                                           temp, topk, topp):
+                    k_pool, v_pool, last_tokens, last_lps = _packed_body_fn(
+                        params, tokens, lengths, target, scatter, k_pool,
+                        v_pool, last_tokens, last_lps, keys, temp, topk,
+                        topp)
+                    last_tokens, last_lps = self._pin_slot_state(
+                        last_tokens, last_lps)
+                    return k_pool, v_pool, last_tokens, last_lps
+
                 self._prefill_paged_packed = jax.jit(
-                    paged.prefill_packed, donate_argnums=(5, 6, 7, 8)
+                    _prefill_packed_pinned, donate_argnums=(5, 6, 7, 8)
                 )
 
         # ---- automatic prefix caching --------------------------------------
@@ -594,6 +617,8 @@ class Engine:
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
                 last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+                last_tokens, last_lps = self._pin_slot_state(last_tokens,
+                                                             last_lps)
                 return k_pool, v_pool, last_tokens, last_lps
 
             self._prefill_paged_prefix_fused = jax.jit(
@@ -633,6 +658,8 @@ class Engine:
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
                 last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+                last_tokens, last_lps = self._pin_slot_state(last_tokens,
+                                                             last_lps)
                 return k_pool, v_pool, last_tokens, last_lps
 
             self._prefill_paged_resume_fused = jax.jit(
@@ -701,6 +728,8 @@ class Engine:
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
                 last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
+                last_tokens, last_lps = self._pin_slot_state(last_tokens,
+                                                             last_lps)
                 return (ck, cv), last_tokens, last_lps, pool_k, pool_v
 
             self._prefill_prefix_fused = jax.jit(
@@ -777,7 +806,18 @@ class Engine:
         over a global mesh cannot mix process-local arrays with global
         ones. Computing the state under ``out_shardings`` avoids any host
         transfer and yields bit-identical values on every host. Idempotent
-        and also valid (harmless) for single-process multi-chip meshes."""
+        and also valid (harmless) for single-process multi-chip meshes.
+
+        Also fixes the CANONICAL sharding of the per-slot state vectors
+        (``_state_sharding``, enforced by ``_pin_slot_state`` in every
+        jitted body): without it each compiled program hands the fed-token
+        vectors back in whatever sharding GSPMD picked for THAT program
+        (measured: decode returns them P('data') after place_state made
+        them replicated), so the next variant's eager call lowers a
+        DIFFERENT HLO than warmup_call_plan's specs and the parallel AOT
+        precompile's persistent-cache entries are never read — every
+        warmup variant compiled twice on mesh-placed engines (PROFILE r5
+        finding d / VERDICT r5 #6)."""
         from jax.sharding import NamedSharding, PartitionSpec
 
         rep = NamedSharding(mesh, PartitionSpec())
@@ -785,10 +825,17 @@ class Engine:
         # _replicate_block) — set BEFORE the first decode call traces
         self._out_rep = rep
         B = self.max_batch
-        self._last_tokens = jax.jit(
-            lambda: jnp.zeros((B,), jnp.int32), out_shardings=rep)()
-        self._last_lps = jax.jit(
-            lambda: jnp.zeros((B,), jnp.float32), out_shardings=rep)()
+        # canonical per-slot state sharding: batch over 'data' when it
+        # divides evenly (matches the shard_map'd packed prefill's
+        # out_specs, so pinning costs no collective there), replicated
+        # otherwise. What matters is that it never changes again.
+        data = mesh.shape.get("data", 1)
+        if data > 1 and B % data == 0:
+            self._state_sharding = NamedSharding(mesh,
+                                                 PartitionSpec("data"))
+        else:
+            self._state_sharding = rep
+        self._last_tokens, self._last_lps = self._fresh_slot_state()
         self.base_keys = jax.jit(
             lambda: make_slot_keys(self._seed, B), out_shardings=rep)()
         self._base_keys_np = np.array(
@@ -886,6 +933,39 @@ class Engine:
             return all_toks, all_lps
         return (jax.lax.with_sharding_constraint(all_toks, rep),
                 jax.lax.with_sharding_constraint(all_lps, rep))
+
+    def _pin_slot_state(self, *arrays):
+        """Constrain per-slot [B] state outputs (fed tokens / logprobs) to
+        the canonical sharding chosen by ``place_state``, inside every
+        jitted body that returns them. Without the pin, each compiled
+        program hands the vectors back in whatever sharding GSPMD picked
+        for THAT program (decode emitted P('data') where place_state made
+        them replicated), so the NEXT variant's eager call lowers a
+        different HLO than ``warmup_call_plan``'s specs — the AOT
+        persistent-cache mismatch of PROFILE r5 finding d. Traced at first
+        call, AFTER place_state; single-chip engines see None and compile
+        unchanged (same pattern as ``_replicate_block``)."""
+        sh = getattr(self, "_state_sharding", None)
+        if sh is None:
+            return arrays
+        return tuple(jax.lax.with_sharding_constraint(a, sh)
+                     for a in arrays)
+
+    def _fresh_slot_state(self):
+        """Zeroed fed-token/logprob vectors in the canonical placement —
+        on the mesh when place_state has run (restart must not demote the
+        state to process-local, or every variant recompiles against the
+        unplaced sharding), default device otherwise."""
+        B = self.max_batch
+        sh = getattr(self, "_state_sharding", None)
+        if sh is None:
+            return (jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), jnp.float32))
+        return (
+            jax.jit(lambda: jnp.zeros((B,), jnp.int32), out_shardings=sh)(),
+            jax.jit(lambda: jnp.zeros((B,), jnp.float32),
+                    out_shardings=sh)(),
+        )
 
     def _mirrored(self, call_id: int, *args) -> None:  # swarmlint: hot
         """Publish (pod mode) then execute one mirrored device call.
@@ -1003,8 +1083,7 @@ class Engine:
         # engine's configured flight_dir; always kept as last_dump too)
         self.flight.auto_dump("engine_restart", self._flight_dir)
         self._fail_all("engine_restart")
-        self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
-        self._last_lps = jnp.zeros((self.max_batch,), jnp.float32)
+        self._last_tokens, self._last_lps = self._fresh_slot_state()
         self.cache = self._fresh_cache()
         if self._prefix is not None:
             # dense: the side pool was donated into the failed dispatch —

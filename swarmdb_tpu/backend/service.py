@@ -116,6 +116,17 @@ def build_prompt(db: SwarmDB, msg: Message, tokenizer: Tokenizer,
     return tokenizer.encode("\n".join(lines))
 
 
+def _history_limit_for(max_seq: int) -> int:
+    """History depth the serving layer actually renders. The env limit is
+    an upper bound; a token-budgeted engine caps it near max_seq/8 —
+    rendering + byte-encoding 64 history lines only for the trim to keep
+    ~100 tokens of them was pure host work on every served message (the
+    tooluse profile: ~25x the retained volume at S=256), and at >= 8
+    tokens per line the cap can always still FILL the budget."""
+    env = _env_int("SWARMDB_HISTORY_LIMIT", 64)
+    return max(1, min(env, max(8, max_seq // 8)))
+
+
 def sampling_from_message(msg: Message) -> SamplingParams:
     """Sampling knobs ride in Message.metadata (free-form dict the reference
     already reserves for annotations, ` main.py:80`)."""
@@ -179,6 +190,16 @@ class ServingService:
         # relative to the window: an absolute seed larger than a small
         # window's budget would size the reserve before any evidence
         self._rolling_delta_ema = min(64.0, engine.max_seq / 8.0)
+        # sink-anchored window heads (see _trim_prompt): conversation pair
+        # -> the page-aligned FIRST tokens of its prompt, captured at the
+        # first budget overflow and immutable after. Insertion order is
+        # the LRU order for the size cap.
+        self._anchors: Dict[Tuple[str, str], List[int]] = {}
+        self._anchor_lock = threading.Lock()
+        self._anchor_cap = _env_int("SWARMDB_ANCHOR_MAX", 4096)
+        # fixed elision marker between head and tail — constant tokens, so
+        # it can never destabilize the prefix
+        self._anchor_sep = self.tokenizer.encode("\n[…]\n", add_bos=False)
         rolling_wanted = os.environ.get("SWARMDB_ROLLING_KV") == "1"
         if (rolling_wanted and self.engine.paged is not None
                 and getattr(self.engine.paged.allocator,
@@ -698,6 +719,84 @@ class ServingService:
                         and st["epoch"] == self._rolling_epoch()):
                     self.engine.rolling_free(st["pages"])
 
+    # ------------------------------------------------------- window trimming
+
+    def _hysteresis_trim(self, prompt: List[int], budget: int,
+                         ps: int) -> List[int]:
+        """Legacy sliding-window trim: drop the front in page-aligned
+        hysteresis steps (~half the budget). Epochs last step/delta turns,
+        so when the per-turn token delta approaches the step — exactly the
+        short-S regime (S=128 serves ~1.6 turns total) — the anchor moves
+        EVERY turn and the prefix cache goes dark (dpserve r5: 3.9% hit
+        vs swarm100's 40%). Kept as the fallback for no-prefix engines
+        and SWARMDB_ANCHOR_HEAD=0."""
+        frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
+        frac = min(0.9, max(0.1, frac))
+        step = max(ps, int(budget * frac) // ps * ps)
+        drop = -(-(len(prompt) - budget) // step) * step
+        if len(prompt) - drop >= 16:
+            return prompt[drop:]
+        return prompt[-budget:]
+
+    def _trim_prompt(self, msg: Message, prompt: List[int],
+                     budget: int) -> List[int]:
+        """Sink-anchored two-segment window (the short-S prefix fix,
+        VERDICT r5 #4): once a conversation overflows the token budget,
+        its prompt becomes
+
+            [HEAD: first page-aligned tokens, captured ONCE, immutable]
+            + [fixed elision marker]
+            + [TAIL: newest tokens, trimmed in page-aligned hysteresis
+               steps]
+
+        The head occupies positions 0..len(head) in EVERY subsequent turn,
+        so its pages hit the prefix cache unconditionally — a hit-rate
+        floor of head/prompt that survives any tail churn. This is what a
+        pure sliding window cannot provide at short S: with per-turn
+        deltas comparable to the whole budget, ANY recompute-from-length
+        trim re-anchors every turn and invalidates every cached page
+        (measured: S=128 dpserve at 3.9% hit). StreamingLLM's
+        attention-sink observation applied at the PROMPT level: keep the
+        conversation opening verbatim, elide the middle, keep the recent
+        turns. The tail keeps the old hysteresis so mid-epoch turns also
+        reuse tail pages at longer S (serve/swarm100).
+        SWARMDB_ANCHOR_HEAD sets the head size in pages (default 4;
+        0 restores the sliding trim)."""
+        eng = self.engine
+        if eng._prefix is None:
+            # no prefix cache -> keep the maximum recent history
+            return prompt[-budget:]
+        ps = eng._prefix_ps
+        head_pages = _env_int("SWARMDB_ANCHOR_HEAD", 4)
+        # head must leave at least half the budget to the tail (the
+        # recent turns are what the model answers from)
+        hb = min(head_pages * ps, (budget // 2) // ps * ps)
+        if head_pages <= 0 or msg.receiver_id is None or hb < ps:
+            return self._hysteresis_trim(prompt, budget, ps)
+        key = (msg.sender_id, msg.receiver_id)
+        with self._anchor_lock:
+            head = self._anchors.get(key)
+            if head is None:
+                head = prompt[:hb]
+                while len(self._anchors) >= self._anchor_cap:
+                    self._anchors.pop(next(iter(self._anchors)))
+                self._anchors[key] = head
+                self.db.metrics.counters["window_heads_anchored"].inc()
+            else:
+                # LRU touch (size-capped dict, insertion order = LRU)
+                self._anchors[key] = self._anchors.pop(key)
+        tail_budget = budget - len(head) - len(self._anchor_sep)
+        if tail_budget < max(ps, budget // 4):
+            # budget shrank since capture (larger max_new_tokens this
+            # turn): the split leaves no useful tail — slide this turn
+            return self._hysteresis_trim(prompt, budget, ps)
+        step = max(ps, (tail_budget // 2) // ps * ps)
+        drop = -(-(len(prompt) - tail_budget) // step) * step
+        tail = prompt[drop:] if 0 < len(prompt) - drop <= tail_budget \
+            else prompt[-tail_budget:]
+        self.db.metrics.counters["window_tail_trims"].inc()
+        return list(head) + list(self._anchor_sep) + tail
+
     # ------------------------------------------------------------- serving
 
     def serve_message(
@@ -720,7 +819,9 @@ class ServingService:
                                                  msg.receiver_id)
                      if self._rolling is not None and msg.receiver_id
                      else 0)
-        prompt = build_prompt(self.db, msg, self.tokenizer)
+        prompt = build_prompt(self.db, msg, self.tokenizer,
+                              history_limit=_history_limit_for(
+                                  self.engine.max_seq))
         sampling = sampling_from_message(msg)
         priority = int(msg.priority.value if hasattr(msg.priority, "value")
                        else msg.priority)
@@ -809,29 +910,7 @@ class ServingService:
                     if len(prompt) > budget:
                         prompt = prompt[-budget:]
                 elif len(prompt) > budget:
-                    if self.engine._prefix is not None:
-                        ps = self.engine._prefix_ps
-                        # trim-step fraction trades history depth right after
-                        # a jump against epoch length: each jump re-anchors
-                        # the prompt start, and EVERY cached page of the
-                        # conversation is invalidated across a jump (prompt
-                        # positions restart at 0, so KV computed under the
-                        # old anchor is numerically wrong under the new one).
-                        # Longer epochs = fewer full-miss turns; measured on
-                        # the serve mix the jump misses are the single
-                        # largest loss (~37% of prompt tokens at the 0.5
-                        # default, scripts/probe_prefix)
-                        frac = _env_float("SWARMDB_TRIM_STEP", 0.5)
-                        frac = min(0.9, max(0.1, frac))
-                        step = max(ps, int(budget * frac) // ps * ps)
-                        drop = -(-(len(prompt) - budget) // step) * step
-                        if len(prompt) - drop >= 16:
-                            prompt = prompt[drop:]
-                        else:
-                            prompt = prompt[-budget:]
-                    else:
-                        # no prefix cache -> keep the maximum recent history
-                        prompt = prompt[-budget:]
+                    prompt = self._trim_prompt(msg, prompt, budget)
 
             def _done(rid: str, tokens: List[int], reason: str) -> None:
                 # engine thread: just hand off — emission runs on _reply_loop.
@@ -1035,15 +1114,22 @@ class ServingService:
 
     def _reply_loop(self) -> None:
         """Drain completed generations into reply messages (worker thread)."""
+        emit_us = self.db.metrics.counters["phase_us_reply_emit"]
         while True:
             item = self._reply_queue.get()
             if item is None:
                 return
             msg, rid, tokens, reason, stop, lps, alts, on_done = item
+            t0 = time.perf_counter()
             try:
                 self._emit_reply(msg, tokens, reason, stop, lps, alts)
             except Exception:
                 logger.exception("failed to emit reply for %s", msg.id)
+            # reply-emit phase accumulator (same family as the engine's
+            # phase_us_*): decode + send_message + persistence hooks per
+            # completion — the tooluse decomposition needs this visible
+            # next to prefill/decode, not folded into wall-clock
+            emit_us.inc(int((time.perf_counter() - t0) * 1e6))
             if on_done is not None:
                 try:
                     on_done(rid, tokens, reason)

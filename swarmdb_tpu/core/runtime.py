@@ -677,18 +677,25 @@ class SwarmDB:
         return total, tail
 
     def get_conversation_window(
-        self, agent_a: str, agent_b: str, limit: int
+        self, agent_a: str, agent_b: str, limit: int,
+        step: Optional[int] = None,
     ) -> List[Message]:
         """Hysteresis-anchored conversation window, atomically.
 
-        Drops old messages in half-``limit`` steps computed from the
-        TOTAL stream length, so the window start moves once per ~limit/2
-        turns instead of every turn (a plain newest-``limit`` fetch
-        slides per message once it binds, and a prompt rendered from a
-        sliding window shares no prefix with its predecessor). Length
-        and slice are taken under ONE lock acquisition: splitting them
-        lets a concurrent send shift the window by one message for that
-        turn — exactly the one-off prefix miss the anchoring prevents."""
+        Drops old messages in ``step``-sized jumps (default half of
+        ``limit``) computed from the TOTAL stream length, so the window
+        start moves once per ~``step`` turns instead of every turn (a
+        plain newest-``limit`` fetch slides per message once it binds,
+        and a prompt rendered from a sliding window shares no prefix
+        with its predecessor). ``step`` is the epoch-length knob a
+        token-budgeted consumer tunes: a SHALLOW window (short-S serving
+        trims to a few turns) wants small steps — each jump invalidates
+        the whole rendered tail, so a half-of-64 default jump would
+        discard far more context than the token budget ever shows the
+        model. Length and slice are taken under ONE lock acquisition:
+        splitting them lets a concurrent send shift the window by one
+        message for that turn — exactly the one-off prefix miss the
+        anchoring prevents."""
         if limit <= 0:
             return []
         pair = self._pair(agent_a, agent_b)
@@ -697,7 +704,8 @@ class SwarmDB:
             total = len(stream)
             keep = limit
             if total > limit:
-                step = max(1, limit // 2)
+                step = max(1, limit // 2 if step is None
+                           else min(step, limit))
                 start = -(-(total - limit) // step) * step  # round UP
                 keep = max(1, total - start)
             tail = list(stream[-keep:])

@@ -2,22 +2,34 @@
 
 Same skeleton as ``models/llama.py`` (stacked layers + lax.scan, slot KV
 cache, GQA attention with per-row positions) with the dense FFN replaced by
-a top-k routed MoE block using the classic capacity-based einsum dispatch:
+a top-k routed MoE block. Two numerically-equivalent dispatch forms:
 
-    router -> top-k experts per token -> position-in-expert via cumsum ->
-    one-hot dispatch/combine tensors -> expert-major einsums.
+- ``einsum``: the classic capacity-based one-hot dispatch (router -> top-k
+  -> position-in-expert via cumsum -> [N, E, C] dispatch/combine tensors ->
+  expert-major einsums). This is the GSPMD-native form: with tokens sharded
+  over 'data' and expert weights over an 'expert' mesh axis, XLA lowers the
+  dispatch/combine einsums to all-to-alls over ICI (SURVEY §2.4 EP row;
+  BASELINE config 4 — Mixtral-8x7B tool-use backend). It is also ruinously
+  expensive off the EP path: the [N, k, E, C] intermediates grow with
+  N^2 (C ∝ N), and at a [16, 256] prefill the dispatch einsums cost ~10x
+  the expert matmuls themselves (PROFILE r6: the 5.6x tooluse gap was
+  almost entirely this term — 1766 ms vs 24 ms per block on the CPU A/B).
+- ``scatter``: same routing decisions (same capacity, same overflow drops,
+  same gates) realized as a token scatter into per-expert queues and a
+  gather back — O(N·k·D) data movement, no one-hot tensors. Used on
+  single-device / pure-DP engines; selected by default
+  (SWARMDB_MOE_DISPATCH overrides; ``parallel/serving`` pins ``einsum``
+  whenever the expert axis is actually sharded).
 
-This formulation is the GSPMD-native one: with tokens sharded over 'data'
-and expert weights sharded over an 'expert' mesh axis, XLA lowers the
-dispatch/combine einsums to all-to-alls over ICI (SURVEY §2.4 EP row;
-BASELINE config 4 — Mixtral-8x7B tool-use backend). Tokens over capacity
-are dropped (contribute zero; the residual connection carries them).
+Tokens over capacity are dropped (contribute zero; the residual connection
+carries them) in both forms.
 
 No reference counterpart: the reference has no model code (SURVEY §2.4).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -112,6 +124,15 @@ def init_kv_cache(
 # ---------------------------------------------------------------- MoE block
 
 
+def _default_dispatch() -> str:
+    """Module default for the MoE dispatch form (read at TRACE time, so a
+    jitted caller latches the value its first call saw). ``scatter`` is
+    strictly cheaper off the EP path; ``parallel/serving`` pins ``einsum``
+    explicitly when the expert axis is sharded (the all-to-all lowering
+    needs the einsum form)."""
+    return os.environ.get("SWARMDB_MOE_DISPATCH", "scatter")
+
+
 def moe_block(
     x: jnp.ndarray,          # [B, T, D]
     router_w: jnp.ndarray,   # [D, E]
@@ -120,17 +141,22 @@ def moe_block(
     w_down: jnp.ndarray,     # [E, F, D]
     top_k: int,
     capacity_factor: float = DEFAULT_CAPACITY_FACTOR,
+    dispatch: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k routed expert FFN with capacity-based dispatch.
 
     Returns (output [B, T, D], router aux: mean expert load [E] for
     balance metrics). Static shapes: capacity C = ceil(N * top_k / E *
     capacity_factor); overflow tokens are dropped (zero contribution).
+    ``dispatch`` picks the einsum (EP-shardable) or scatter (single-device
+    fast path) realization — same routing, same values (module docstring).
     """
     B, T, D = x.shape
     E = router_w.shape[-1]
     N = B * T
     C = max(1, int(N * top_k * capacity_factor / E))
+    if dispatch is None:
+        dispatch = _default_dispatch()
 
     xf = x.reshape(N, D)
     router_logits = jnp.einsum(
@@ -151,22 +177,43 @@ def moe_block(
     pos = jnp.sum(pos_in_expert * flat_assign, axis=-1).reshape(N, top_k)
     pos = pos.astype(jnp.int32)
     within_cap = pos < C
+    load = jnp.mean(jnp.sum(assign, axis=1), axis=0)               # [E]
+
+    if dispatch == "scatter":
+        # token scatter into per-expert queues. (expert, pos) pairs are
+        # unique by construction (pos = running count within its expert),
+        # so the set never collides; over-capacity choices target column C
+        # which mode="drop" discards.
+        e_idx = top_idx.reshape(-1)                                # [N*k]
+        c_idx = jnp.where(within_cap, pos, C).reshape(-1)
+        tok_rows = jnp.repeat(jnp.arange(N), top_k)                # [N*k]
+        xe = jnp.zeros((E, C, D), x.dtype).at[e_idx, c_idx].set(
+            xf[tok_rows], mode="drop")
+        g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, w_gate))
+        u = jnp.einsum("ecd,edf->ecf", xe, w_up)
+        ye = jnp.einsum("ecf,efd->ecd", g * u, w_down)             # [E, C, D]
+        # gather each (token, choice)'s result back, gate-weighted;
+        # over-capacity choices read a clamped row and are masked to zero
+        yk = ye[e_idx, jnp.minimum(c_idx, C - 1)]                  # [N*k, D]
+        yk = yk * (within_cap.reshape(-1)[:, None]
+                   * gates.reshape(-1)[:, None]).astype(x.dtype)
+        y = jnp.zeros((N, D), x.dtype).at[tok_rows].add(yk)
+        return y.reshape(B, T, D), load
 
     # dispatch [N, E, C] (0/1) and combine [N, E, C] (gate-weighted)
     pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)             # [N, k, C]
     disp_k = assign[:, :, :, None] * pos_oh[:, :, None, :]         # [N, k, E, C]
     disp_k = disp_k * within_cap[:, :, None, None]
-    dispatch = jnp.sum(disp_k, axis=1)                             # [N, E, C]
+    dispatch_t = jnp.sum(disp_k, axis=1)                           # [N, E, C]
     combine = jnp.sum(disp_k * gates[:, :, None, None], axis=1)    # [N, E, C]
 
     # expert-major compute (bf16 matmuls on the MXU)
-    xe = jnp.einsum("nd,nec->ecd", xf, dispatch.astype(x.dtype))   # [E, C, D]
+    xe = jnp.einsum("nd,nec->ecd", xf, dispatch_t.astype(x.dtype))  # [E, C, D]
     g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, w_gate))
     u = jnp.einsum("ecd,edf->ecf", xe, w_up)
     ye = jnp.einsum("ecf,efd->ecd", g * u, w_down)                 # [E, C, D]
     y = jnp.einsum("ecd,nec->nd", ye, combine.astype(x.dtype))
 
-    load = jnp.mean(jnp.sum(assign, axis=1), axis=0)               # [E]
     return y.reshape(B, T, D), load
 
 
@@ -180,6 +227,7 @@ def forward(
     positions: jnp.ndarray,
     cache: KVCache,
     logits_at: Optional[jnp.ndarray] = None,
+    moe_dispatch: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Forward pass; same contract as ``llama.forward`` (fp32 logits +
     updated cache, head-at-last-position via ``logits_at``), with
@@ -206,7 +254,7 @@ def forward(
         # scan contract and drops it here
         moe_out, _load = moe_block(
             h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.experts_per_token,
+            top_k=cfg.experts_per_token, dispatch=moe_dispatch,
         )
         x = x + moe_out
         return x, (ck, cv)
@@ -246,6 +294,7 @@ def forward_prefix_pages(
     pool_k: jnp.ndarray,        # [L, P, ps, Hkv, D]
     pool_v: jnp.ndarray,
     logits_at: Optional[jnp.ndarray] = None,
+    moe_dispatch: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefix-cache suffix prefill core (see ``llama.forward_prefix_pages``
     for the design); MoE FFN unchanged. Returns (fp32 logits, sfx_k,
@@ -281,7 +330,7 @@ def forward_prefix_pages(
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         moe_out, _load = moe_block(
             h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.experts_per_token,
+            top_k=cfg.experts_per_token, dispatch=moe_dispatch,
         )
         x = x + moe_out
         return x, (k.astype(kp.dtype), v.astype(vp.dtype))
@@ -332,6 +381,7 @@ def forward_paged_chunked(
     cache,                     # {"k","v","page_table"} — FROZEN this chunk
     chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],
     step: jnp.ndarray,
+    moe_dispatch: Optional[str] = None,
 ):
     """Two-segment chunked decode over the paged pool (see
     ``llama.forward_paged_chunked``); MoE FFN unchanged."""
@@ -363,7 +413,7 @@ def forward_paged_chunked(
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         moe_out, _load = moe_block(
             h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.experts_per_token,
+            top_k=cfg.experts_per_token, dispatch=moe_dispatch,
         )
         x = x + moe_out
         return x, (hk, hv)
@@ -386,6 +436,7 @@ def forward_chunked(
     cache: KVCache,            # FROZEN during the chunk
     chunk_kv: Tuple[jnp.ndarray, jnp.ndarray],
     step: jnp.ndarray,         # scalar int32
+    moe_dispatch: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Two-segment chunked decode step (see ``llama.forward_chunked``);
     MoE FFN unchanged."""
@@ -412,7 +463,7 @@ def forward_chunked(
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         moe_out, _load = moe_block(
             h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.experts_per_token,
+            top_k=cfg.experts_per_token, dispatch=moe_dispatch,
         )
         x = x + moe_out
         return x, (hk, hv)
@@ -449,6 +500,7 @@ def forward_paged(
     tokens: jnp.ndarray,     # [B, 1] — DECODE steps only
     positions: jnp.ndarray,  # [B, 1]
     cache,                   # {"k", "v", "page_table"}
+    moe_dispatch: Optional[str] = None,
 ):
     """Decode forward over the block-paged KV pool; MoE FFN unchanged.
     Same contract as ``llama.forward_paged``."""
@@ -476,7 +528,7 @@ def forward_paged(
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         moe_out, _load = moe_block(
             h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.experts_per_token,
+            top_k=cfg.experts_per_token, dispatch=moe_dispatch,
         )
         x = x + moe_out
         return x, (kp, vp)

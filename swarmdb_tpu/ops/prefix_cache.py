@@ -93,6 +93,14 @@ class PrefixLRU:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # per-LOOKUP counters (vs the per-page hits/misses above):
+        # a full-miss lookup on a prompt with cached-eligible pages is
+        # the anchor-jump signature — the window re-anchored and every
+        # previously cached page of the conversation went dark. The
+        # ratio full_misses/lookups is the number the sink-anchored
+        # window drives toward zero (PROFILE r6).
+        self.lookups = 0
+        self.full_misses = 0
         # pool generation (managed-free mode): bumped by reset(). Pages
         # held OUTSIDE the table (the serving layer's dense rolling-KV
         # registry acquires custody via acquire()) are only valid within
@@ -123,6 +131,10 @@ class PrefixLRU:
                 pages.append(page_id)
             self.hits += len(pages)
             self.misses += max(0, len(chains) - len(pages))
+            if chains:
+                self.lookups += 1
+                if not pages:
+                    self.full_misses += 1
         return pages
 
     # ------------------------------------------------------------ allocation
@@ -245,4 +257,6 @@ class PrefixLRU:
                 "page_size": self.page_size,
                 "hit_tokens": self.hits * self.page_size,
                 "miss_tokens": self.misses * self.page_size,
+                "lookups": self.lookups,
+                "full_misses": self.full_misses,
             }

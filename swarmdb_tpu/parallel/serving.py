@@ -115,6 +115,14 @@ def build_sharded_model(
     cache_sharding = NamedSharding(mesh, CACHE_SPEC)
     token_sharding = NamedSharding(mesh, TOKEN_SPEC)
 
+    # EP meshes need the einsum MoE dispatch: only the one-hot
+    # dispatch/combine einsums lower to all-to-alls over the sharded
+    # expert axis (the scatter fast path would leave GSPMD guessing at
+    # gather/scatter collectives). Everything else keeps the module
+    # default (scatter — models/mixtral.py module docstring).
+    moe_kw = ({"moe_dispatch": "einsum"}
+              if cfg.is_moe and mesh.shape.get("expert", 1) > 1 else {})
+
     def forward_fn(p, tokens, positions, cache):
         from ..ops.layers import pallas_disabled
 
@@ -128,7 +136,8 @@ def build_sharded_model(
                 lambda c: jax.lax.with_sharding_constraint(c, cache_sharding), cache
             )
         with pallas_disabled():
-            logits, cache = fam.forward(p, cfg, tokens, positions, cache)
+            logits, cache = fam.forward(p, cfg, tokens, positions, cache,
+                                        **moe_kw)
         if constrain:
             cache = jax.tree.map(
                 lambda c: jax.lax.with_sharding_constraint(c, cache_sharding), cache
@@ -157,7 +166,7 @@ def build_sharded_model(
         chunk_kv = _constrain_kv(chunk_kv)
         with pallas_disabled():
             logits, chunk_kv = fam.forward_chunked(
-                p, cfg, tokens, positions, cache, chunk_kv, step)
+                p, cfg, tokens, positions, cache, chunk_kv, step, **moe_kw)
         return logits, _constrain_kv(chunk_kv)
 
     def init_chunk_fn(batch: int, chunk: int):

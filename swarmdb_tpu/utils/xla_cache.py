@@ -23,6 +23,22 @@ logger = logging.getLogger("swarmdb_tpu.xla_cache")
 _ENABLED_DIR: Optional[str] = None
 
 
+def persistent_cache_programs(path: str) -> set:
+    """Distinct compiled-program keys in a persistent-cache directory.
+
+    The cache writes a ``<jit-name>-<hash>-cache`` / ``-atime`` file pair
+    per program; this strips the suffix so one program counts once. Used
+    by the precompile drift tests (compile-count == variant-count on a
+    warm start) and handy for eyeballing what a warmup actually added:
+    ``python -c "from swarmdb_tpu.utils.xla_cache import *; \
+      print(sorted(persistent_cache_programs('.jax_cache')))"``."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return set()
+    return {n.rsplit("-", 1)[0] for n in names}
+
+
 def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     """Point JAX's persistent compilation cache at ``path`` (or the
     SWARMDB_COMPILE_CACHE env var). Returns the directory in effect, or

@@ -10,9 +10,11 @@ step = jax.jit(lambda x: x + 1)
 
 
 def admit(batch):  # swarmlint: hot
-    # numpy on HOST data is the idiom (the transfer rides the dispatch)
-    rows = np.zeros((len(batch), 8), np.int32)
-    for i, item in enumerate(batch):
+    # numpy on HOST data is the idiom (the transfer rides the dispatch) —
+    # at a FIXED wave size: a len(batch)-shaped array would compile a new
+    # variant per distinct count (SWL204)
+    rows = np.zeros((16, 8), np.int32)
+    for i, item in enumerate(batch[:16]):
         rows[i, : len(item)] = item
     return step(rows)
 

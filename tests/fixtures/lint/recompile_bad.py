@@ -26,6 +26,17 @@ def call_sites(xs, n):
     decode(xs)                                       # fine: array argument
 
 
+def len_shaped_waves(pending):
+    # the "compile mine" class PROFILE r4 hit twice: the traced SHAPE
+    # tracks a runtime row count, so every distinct count recompiles
+    decode(np.zeros((len(pending), 8), np.int32))  # EXPECT: SWL204
+    rows = np.zeros((len(pending), 8), np.int32)  # EXPECT: SWL204
+    decode(rows)
+    decode(np.zeros((16, 8), np.int32))              # fine: fixed wave size
+    padded = np.zeros((16, 8), np.int32)
+    decode(padded)                                   # fine: fixed binding
+
+
 class MiniEngine:
     """Warmup covers `_decode` but not `_prefill`: the static twin of the
     precompile drift test must flag the gap."""

@@ -503,11 +503,11 @@ def test_precompile_cache_covers_warmup(tmp_path):
         )
         for eng in (dense, paged):
             eng.precompile(parallel=2)
-        before = {p.name for p in cache_dir.iterdir()}
+        before = xla_cache.persistent_cache_programs(str(cache_dir))
         assert before, "precompile wrote nothing to the persistent cache"
         for eng in (dense, paged):
             eng.warmup()
-        after = {p.name for p in cache_dir.iterdir()}
+        after = xla_cache.persistent_cache_programs(str(cache_dir))
         assert after == before, (
             f"warmup compiled {len(after - before)} programs precompile "
             f"missed — warmup_call_plan() drifted from warmup()")

@@ -337,14 +337,9 @@ def test_sharded_warmup_plan_covers_packed_variant(tmp_path):
     so packed-variant drift would ship silently). The plan must contain
     the packed prefill with spec args that LOWER against the real jitted
     fn — catching the shape/dtype/arg-order/donation drift class.
-
-    KNOWN GAP (pre-existing, affects prefix variants too, documented in
-    PROFILE r5): on mesh-placed engines the persistent-cache key of a
-    spec-lowered AOT compile does not match the eager call's, so the
-    stronger zero-new-cache-entries assertion of
-    test_precompile_cache_covers_warmup cannot hold here — sharded
-    parallel-precompile burns duplicate compiles instead of reusing
-    them. Sequential warmup() is unaffected."""
+    (The stronger zero-new-cache-entries property — PROFILE r5's KNOWN
+    GAP, closed by Engine._pin_slot_state — is asserted end-to-end by
+    test_sharded_precompile_cache_covers_warmup below.)"""
     engine, _sm = build_serving_engine(
         get_config("tiny-debug"),
         make_mesh(8, data=8, model=1, expert=1),
@@ -364,3 +359,49 @@ def test_sharded_warmup_plan_covers_packed_variant(tmp_path):
     assert not any(fn is engine._prefill_paged_fused for fn, _ in plan)
     for fn, specs in plan:
         fn.lower(*specs)  # type-checks shapes/dtypes/order for each
+
+
+def test_sharded_precompile_cache_covers_warmup(tmp_path):
+    """Sharded warm start: parallel AOT precompile writes EXACTLY one
+    persistent-cache program per warmup variant (compile-count ==
+    variant-count), and the subsequent warmup() adds ZERO new entries —
+    i.e. mesh-placed engines now REUSE the precompiled executables
+    instead of compiling every variant twice (VERDICT r5 #6 / PROFILE r5
+    finding d). The old failure mode: warmup's own decode call handed
+    the fed-token vectors back in a GSPMD-chosen P('data') sharding
+    where the plan's specs said replicated, so every later variant's
+    eager call was a different HLO; Engine._pin_slot_state +
+    place_state's canonical _state_sharding close it."""
+    import swarmdb_tpu.utils.xla_cache as xla_cache
+
+    engine, _sm = build_serving_engine(
+        get_config("tiny-debug"),
+        make_mesh(8, data=8, model=1, expert=1),
+        max_batch=16, max_seq=64, decode_chunk=4,
+        prefill_buckets=[16], paged=True, page_size=8,
+    )
+    assert engine._packed_active()
+    cache_dir = tmp_path / "xla"
+    prev_dir = xla_cache._ENABLED_DIR
+    assert xla_cache.enable_compile_cache(str(cache_dir)) == str(cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        engine.precompile(parallel=2)
+
+        def programs():
+            return xla_cache.persistent_cache_programs(str(cache_dir))
+
+        before = programs()
+        plan = engine.warmup_call_plan()
+        assert len(before) == len(plan), (
+            f"precompile wrote {len(before)} programs for {len(plan)} "
+            "plan variants")
+        engine.warmup()
+        after = programs()
+        assert after == before, (
+            f"sharded warmup compiled {len(after - before)} programs "
+            "precompile missed — state sharding drifted between variants")
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        xla_cache._ENABLED_DIR = prev_dir
