@@ -926,6 +926,104 @@ def bench_longctx(seconds: float) -> dict:
     return out
 
 
+def bench_ha(seconds: float) -> dict:
+    """HA failover drill (ISSUE 4): a 3-node in-process cluster under
+    concurrent producers, scripted leader kill mid-window, and the two
+    numbers the acceptance contract names — ``time_to_promote_s`` (kill
+    -> a new leader wins the epoch CAS) and ``acked_loss`` (acked-durable
+    records missing after failover; MUST be 0). CPU-only, no LLM
+    backend: what's under test is the control plane, not decode."""
+    os.environ.setdefault("SWARMDB_HA_HEARTBEAT_S", "0.05")
+    from swarmdb_tpu.broker.base import LeaderChangedError
+    from swarmdb_tpu.ha import build_local_cluster, wait_until
+
+    suspect_s = _env("SWARMDB_HA_SUSPECT_S", 0.3, float)
+    dead_s = _env("SWARMDB_HA_DEAD_S", 2 * suspect_s, float)
+    n_producers = _env("SWARMDB_BENCH_HA_PRODUCERS", 4, int)
+    harness, cluster, client = build_local_cluster(
+        ["ha-0", "ha-1", "ha-2"], suspect_s=suspect_s, dead_s=dead_s)
+    acked: list = []
+    acked_lock = threading.Lock()
+    retryable_raises = [0]
+    stop = threading.Event()
+    try:
+        wait_until(lambda: cluster.read()["leader"] == "ha-0", 5.0,
+                   what="bootstrap leader")
+        client.create_topic("bench_ha", 1)
+        wait_until(
+            lambda: len(harness.nodes["ha-0"].broker_facade.replicators) == 2,
+            5.0, what="followers adopted")
+
+        def produce(worker: int) -> None:
+            i = 0
+            while not stop.is_set():
+                payload = f"w{worker}-m{i}"
+                try:
+                    off = client.append("bench_ha", 0, payload.encode())
+                    if client.wait_durable("bench_ha", 0, off, 2.0):
+                        with acked_lock:
+                            acked.append(payload)
+                        i += 1
+                except LeaderChangedError:
+                    # the zero-loss contract: mid-failover writes fail
+                    # RETRYABLY; the producer re-sends the same payload
+                    retryable_raises[0] += 1
+                    stop.wait(0.02)
+
+        threads = [threading.Thread(target=produce, args=(w,), daemon=True)
+                   for w in range(n_producers)]
+        for t in threads:
+            t.start()
+        window = max(4.0, min(seconds, 30.0))
+        time.sleep(window / 3)  # steady state before the fault
+        with acked_lock:
+            acked_pre_kill = len(acked)
+        epoch_before = cluster.read()["epoch"]
+        t_kill = time.monotonic()
+        harness.kill("ha-0")
+        wait_until(lambda: cluster.read()["epoch"] > epoch_before,
+                   timeout_s=30.0, what="failover promotion")
+        time_to_promote = time.monotonic() - t_kill
+        time.sleep(window / 3)  # post-failover steady state
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        # zero-loss audit: every acked-durable payload must be readable
+        # from the NEW leader's log
+        survived = {r.value.decode()
+                    for r in client.fetch("bench_ha", 0, 0, 1_000_000)}
+        with acked_lock:
+            lost = [p for p in acked if p not in survived]
+        state = cluster.read()
+        promotions = [ev for ev in harness.flight.events()
+                      if ev.get("kind") == "ha.promoted"]
+        result = {
+            "metric": "ha_time_to_promote_s",
+            "value": round(time_to_promote, 3),
+            "unit": "seconds",
+            "mode": "ha",
+            "acked_loss": len(lost),
+            "acked_total": len(acked),
+            "acked_pre_kill": acked_pre_kill,
+            "retryable_raises": retryable_raises[0],
+            "detector_suspect_s": suspect_s,
+            "detector_dead_s": dead_s,
+            "detector_budget_s": round(dead_s + 2 * suspect_s, 3),
+            "promotions": len(promotions),  # bootstrap + exactly 1
+            "new_leader": state.get("leader"),
+            "epoch": state.get("epoch"),
+            "producers": n_producers,
+        }
+        if lost:
+            result["error"] = (f"ACKED LOSS: {len(lost)} acked-durable "
+                               f"records missing after failover")
+        return result
+    finally:
+        stop.set()
+        harness.stop()
+        client.close()
+
+
 _MODES = {
     "echo": bench_echo,
     "serve": bench_serve,
@@ -934,6 +1032,7 @@ _MODES = {
     "swarm100": bench_swarm100,
     "dpserve": bench_dpserve,
     "longctx": bench_longctx,
+    "ha": bench_ha,
 }
 
 # dpserve is NOT here: it is a virtual-CPU-device measurement by design
@@ -941,11 +1040,12 @@ _MODES = {
 _NEEDS_BACKEND = {"serve", "group", "tooluse", "swarm100", "longctx"}
 
 # what `mode=all` actually runs; the watchdog scales its limit by THIS
-# count, not len(_MODES). longctx runs LAST: it is the slowest warmup,
+# count, not len(_MODES). ha runs right after echo (CPU-only, seconds of
+# wall time, no backend); longctx runs LAST: it is the slowest warmup,
 # so a cold-container budget squeeze sheds the long-context line rather
 # than the headline serve/tooluse records
-_ALL_MODES = ("echo", "serve", "group", "tooluse", "swarm100", "dpserve",
-              "longctx")
+_ALL_MODES = ("echo", "ha", "serve", "group", "tooluse", "swarm100",
+              "dpserve", "longctx")
 
 
 def _force_cpu() -> None:
@@ -1016,6 +1116,7 @@ _SUMMARY_KEYS = (
     ("native", "native_broker_msgs_per_sec"),
     ("dpx", "dp_scaling_x"),
     ("ovh", "tracer_overhead_pct"),
+    ("loss", "acked_loss"),
 )
 
 

@@ -19,6 +19,11 @@ Directive grammar (comments beginning ``# swarmlint:``):
     On (or directly above) a ``def``: the function is a hot-path function —
     host syncs inside it are findings (hostsync.py). An identity decorator
     named ``hot`` works too.
+``# swarmlint: heartbeat``
+    On (or directly above) a ``def``: the function runs on a failure
+    detector's evaluation path — blocking I/O and lock acquisition inside
+    it are findings (heartbeat.py, SWL601/SWL602): a detector that can
+    stall turns a healthy leader into a "dead" one.
 ``# swarmlint: disable=<rule>[,<rule>] [-- reason]``
     Suppress the named rules (ids like ``SWL101`` or family names like
     ``host-sync``) on this line, or — when the comment is a standalone
@@ -95,6 +100,14 @@ RULES: Dict[str, Rule] = {
         Rule("SWL502", "span-discipline",
              "allocating span(...) context manager inside a hot-path "
              "function — use the span_begin/span_end ring writes"),
+        Rule("SWL601", "heartbeat-safety",
+             "blocking call inside `# swarmlint: heartbeat` code — a "
+             "stalled failure-detector evaluation reads as a dead peer "
+             "(false-positive failover)"),
+        Rule("SWL602", "heartbeat-safety",
+             "lock acquisition inside `# swarmlint: heartbeat` code — "
+             "detector evaluation must stay lock-free (a writer holding "
+             "the lock stalls the verdict)"),
     )
 }
 
@@ -159,6 +172,7 @@ class GuardDecl:
 @dataclass
 class Directives:
     hot_lines: Set[int] = field(default_factory=set)
+    heartbeat_lines: Set[int] = field(default_factory=set)
     # line -> None (suppress all) or set of rule ids
     disables: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
     comment_only_lines: Set[int] = field(default_factory=set)
@@ -172,6 +186,9 @@ def _parse_directive(body: str, line: int, out: Directives) -> None:
     body = body.strip()
     if body == "hot" or body.startswith("hot "):
         out.hot_lines.add(line)
+        return
+    if body == "heartbeat" or body.startswith("heartbeat "):
+        out.heartbeat_lines.add(line)
         return
     if body.startswith("disable"):
         rest = body[len("disable"):]
@@ -317,6 +334,19 @@ class SourceFile:
                 return True
         return False
 
+    def is_heartbeat(self, fn: ast.AST) -> bool:
+        """Heartbeat-path function: ``# swarmlint: heartbeat`` on the
+        decorator/def lines or directly above (same marker style as
+        ``hot``)."""
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        first = min([fn.lineno]
+                    + [d.lineno for d in fn.decorator_list]) - 1
+        for line in range(first, fn.body[0].lineno):
+            if line in self.directives.heartbeat_lines:
+                return True
+        return False
+
     def held_guards(self, fn: ast.AST) -> Set[str]:
         """Guards a ``# swarmlint: holds[...]`` directive on/above the
         def declares as already held by this function's callers."""
@@ -413,7 +443,7 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def analyze_file(path: str, select: Optional[Set[str]] = None,
                  text: Optional[str] = None) -> List[Finding]:
-    from . import hostsync, locks, recompile, spans, tracers
+    from . import heartbeat, hostsync, locks, recompile, spans, tracers
 
     try:
         src = SourceFile(path, text=text)
@@ -423,7 +453,7 @@ def analyze_file(path: str, select: Optional[Set[str]] = None,
         raise SyntaxError(f"{path}: {exc}") from None
     findings: List[Finding] = []
     for checker in (hostsync.check, recompile.check, locks.check,
-                    tracers.check, spans.check):
+                    tracers.check, spans.check, heartbeat.check):
         findings.extend(checker(src))
     out = []
     seen = set()

@@ -46,6 +46,11 @@ ADMIN_USERNAME = "admin"  # reference: "admin" is the authorization superuser
 DB_KEY: web.AppKey = web.AppKey("db", object)
 CONFIG_KEY: web.AppKey = web.AppKey("config", object)
 SERVING_KEY: web.AppKey = web.AppKey("serving", object)
+HA_KEY: web.AppKey = web.AppKey("ha_node", object)
+
+# /metrics role encoding (one gauge, stable codes — a flap shows up as a
+# step in the time series, not a relabel)
+_HA_ROLE_CODES = {"follower": 0, "leader": 1, "deposed": 2, "dead": 3}
 
 
 @dataclass
@@ -178,13 +183,17 @@ def create_app(
     config: Optional[ApiConfig] = None,
     serving: Optional[Any] = None,
     on_max_requests: Optional[Any] = None,
+    ha_node: Optional[Any] = None,
 ) -> web.Application:
     """Build the application. ``serving`` is an optional
     :class:`~swarmdb_tpu.backend.service.ServingService` that turns
     LLM-addressed messages into streamed replies. ``on_max_requests``
     fires ONCE when ``cfg.max_requests`` (+ random jitter) requests have
     been served — the worker-recycling hook (the server entry point exits
-    gracefully; its supervisor restarts a fresh process)."""
+    gracefully; its supervisor restarts a fresh process). ``ha_node`` is
+    an optional :class:`~swarmdb_tpu.ha.node.HANode` this process runs
+    under — it feeds the /health HA block, the ``GET /admin/ha`` status
+    route, and the ``swarmdb_ha_*`` /metrics gauges."""
     cfg = config or ApiConfig()
     limiter = RateLimiter(cfg.rate_limit_per_minute)
     recycle_at: Optional[int] = None
@@ -507,7 +516,10 @@ def create_app(
             status="sent", group_name=req.group_name, message_ids=ids))
 
     async def health(request: web.Request) -> web.Response:
-        """GET /health (reference `api.py:790-815`): live broker probe."""
+        """GET /health (reference `api.py:790-815`): live broker probe.
+        With an HA node attached the response carries its role/epoch and
+        detector verdict — the compose healthcheck and a load balancer
+        read the same surface."""
         ok = await _run_sync(db.broker.healthy)
         tpu_state = None
         if serving is not None and hasattr(serving, "health"):
@@ -515,12 +527,41 @@ def create_app(
                 tpu_state = await _run_sync(serving.health)
             except Exception as exc:
                 tpu_state = {"status": "error", "error": str(exc)}
+        ha_state = None
+        if ha_node is not None:
+            try:
+                full = await _run_sync(ha_node.status)
+                ha_state = {k: full.get(k) for k in
+                            ("node_id", "role", "epoch", "leader")}
+                if "detector" in full:
+                    ha_state["detector"] = full["detector"]["state"]
+            except Exception as exc:
+                ha_state = {"status": "error", "error": str(exc)}
         resp = schemas.HealthResponse(
             status="healthy" if ok else "degraded",
             broker_connected=ok,
             tpu=tpu_state,
+            ha=ha_state,
         )
         return _json(resp, 200 if ok else 503)
+
+    async def admin_ha(request: web.Request) -> web.Response:
+        """GET /admin/ha — full control-plane status: role, fencing
+        epoch, cluster map view, detector state, replication lag, plus
+        the recent HA events (promotions/deposals/detector transitions)
+        from the flight recorder's event ring."""
+        require_admin(current_agent(request))
+        if ha_node is None:
+            raise _error(503, "this process runs without an HA node")
+        out = await _run_sync(ha_node.status)
+        try:
+            out["events"] = [
+                ev for ev in await _run_sync(ha_node.flight.events)
+                if str(ev.get("kind", "")).startswith(("ha.", "chaos."))
+            ][-50:]
+        except Exception:
+            logger.exception("HA event ring read failed")
+        return web.json_response(out)
 
     async def stats(request: web.Request) -> web.Response:
         """GET /stats (reference `api.py:818-838`): admin only."""
@@ -633,6 +674,41 @@ def create_app(
                     lines.append(
                         f"swarmdb_replica_gapped_partitions{lbl} "
                         f"{f['gapped']}")
+        # HA control plane (ISSUE 4): role / fencing epoch / failure-
+        # detector verdict, the gauges an alerting rule pages on — a
+        # deposed leader (role=2) or a detector stuck SUSPECT (state=1)
+        # is an incident even while traffic still flows
+        if ha_node is not None:
+            try:
+                st = await _run_sync(ha_node.status)
+            except Exception:
+                logger.exception("HA status read failed")
+                st = None
+            if st is not None:
+                role_code = _HA_ROLE_CODES.get(st.get("role"), -1)
+                lines.append("# TYPE swarmdb_ha_role gauge")
+                lines.append(
+                    f'swarmdb_ha_role{{node="{st["node_id"]}",'
+                    f'role="{st.get("role")}"}} {role_code}')
+                lines.append("# TYPE swarmdb_ha_epoch gauge")
+                lines.append(f"swarmdb_ha_epoch {st.get('epoch', 0)}")
+                if st.get("cluster_epoch") is not None:
+                    lines.append("# TYPE swarmdb_ha_cluster_epoch gauge")
+                    lines.append(
+                        f"swarmdb_ha_cluster_epoch {st['cluster_epoch']}")
+                det = st.get("detector")
+                if det:
+                    # 0=alive 1=suspect 2=dead (DetectorState codes)
+                    lines.append("# TYPE swarmdb_ha_detector_state gauge")
+                    lines.append(
+                        f'swarmdb_ha_detector_state{{state='
+                        f'"{det["state"]}"}} {det["state_code"]}')
+                    lines.append(
+                        "# TYPE swarmdb_ha_detector_signal_age_seconds "
+                        "gauge")
+                    lines.append(
+                        f"swarmdb_ha_detector_signal_age_seconds "
+                        f"{det['signal_age_s']}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -798,6 +874,7 @@ def create_app(
     app[DB_KEY] = db
     app[CONFIG_KEY] = cfg
     app[SERVING_KEY] = serving
+    app[HA_KEY] = ha_node
     app.add_routes([
         web.post("/auth/token", auth_token),
         web.post("/agents/register", register_agent),
@@ -826,6 +903,7 @@ def create_app(
         web.post("/admin/profile/stop", profile_stop),
         web.get("/admin/trace/export", trace_export),
         web.get("/admin/flight", flight_record),
+        web.get("/admin/ha", admin_ha),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

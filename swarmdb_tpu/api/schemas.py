@@ -127,6 +127,9 @@ class HealthResponse(BaseModel):
     version: str = "0.1.0"
     # TPU extension: device liveness (SURVEY §5.3)
     tpu: Optional[Dict[str, Any]] = None
+    # HA extension (ISSUE 4): this node's role/epoch + detector verdict,
+    # present only when the process runs under the HA control plane
+    ha: Optional[Dict[str, Any]] = None
 
 
 class SystemStats(BaseModel):

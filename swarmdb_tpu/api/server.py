@@ -12,6 +12,17 @@ serving mesh, not API-process replication.
 Optional TPU serving: set SERVE_MODEL (e.g. ``llama3-8b``, ``tiny-debug``)
 to attach a generation backend; agent->backend routing then drives real
 decode on device.
+
+High availability (ISSUE 4): two mutually exclusive env modes —
+
+- ``SWARMDB_HA_NODE_ID`` (+ ``SWARMDB_HA_CLUSTER``): this process IS a
+  cluster node. An :class:`~swarmdb_tpu.ha.node.HANode` supervises the
+  broker (failure detection, fenced promotion); the runtime writes
+  through the node's role facade, and /health, /admin/ha and the
+  ``swarmdb_ha_*`` /metrics gauges expose the control plane.
+- ``SWARMDB_HA_CLUSTER`` alone: this process is a CLIENT of an external
+  HA cluster — SwarmDB binds a ClusterBroker that re-points to the
+  current leader on failover (handled in core/runtime.py).
 """
 
 from __future__ import annotations
@@ -26,7 +37,56 @@ from ..core.runtime import SwarmDB
 from .app import ApiConfig, create_app
 
 
-def build_db() -> SwarmDB:
+def build_ha_node():
+    """Embedded HA node, when this server process is a cluster member
+    (``SWARMDB_HA_NODE_ID`` + ``SWARMDB_HA_CLUSTER`` set). Returns the
+    started :class:`~swarmdb_tpu.ha.node.HANode` or None."""
+    node_id = os.environ.get("SWARMDB_HA_NODE_ID")
+    cluster_path = os.environ.get("SWARMDB_HA_CLUSTER")
+    if not node_id:
+        return None
+    if not cluster_path:
+        raise SystemExit(
+            "SWARMDB_HA_NODE_ID is set but SWARMDB_HA_CLUSTER is not — an "
+            "HA node needs the shared cluster-map path")
+    from ..broker.local import LocalBroker
+    from ..ha.cluster import FileClusterMap
+    from ..ha.node import HANode
+
+    log_dir = os.environ.get("BROKER_LOG_DIR") or "ha_broker_log"
+    impl = os.environ.get("BROKER_IMPL", "auto")
+    broker = None
+    if impl in ("auto", "native"):
+        try:
+            from ..broker.native import NativeBroker, native_available
+
+            if native_available():
+                broker = NativeBroker(log_dir=log_dir)
+        except Exception:
+            if impl == "native":
+                raise
+    if broker is None:
+        broker = LocalBroker(
+            snapshot_path=os.path.join(log_dir, "snapshot.json"))
+    listen = os.environ.get("SWARMDB_HA_LISTEN", "0.0.0.0:9444")
+    liveness = os.environ.get("SWARMDB_HA_LIVENESS", "0.0.0.0:9445")
+    data = os.environ.get("SWARMDB_HA_DATA", "0.0.0.0:9446")
+    host, _, port = listen.rpartition(":")
+    _, _, lport = liveness.rpartition(":")
+    _, _, dport = data.rpartition(":")
+    node = HANode(
+        node_id, broker, FileClusterMap(cluster_path),
+        listen_host=host or "0.0.0.0", replica_port=int(port),
+        liveness_port=int(lport),
+        data_port=None if dport == "off" else int(dport),
+        advertise_host=os.environ.get("SWARMDB_HA_ADVERTISE_HOST"),
+        log_dir=log_dir,
+    )
+    node.start(role=os.environ.get("SWARMDB_HA_ROLE", "follower"))
+    return node
+
+
+def build_db(ha_node=None) -> SwarmDB:
     cfg = BrokerConfig(
         bootstrap_servers=os.environ.get("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
         group_id=os.environ.get("KAFKA_GROUP_ID", "swarm_agents"),
@@ -34,11 +94,19 @@ def build_db() -> SwarmDB:
         log_dir=os.environ.get("BROKER_LOG_DIR") or None,
         implementation=os.environ.get("BROKER_IMPL", "auto"),
     )
+    broker = None
+    if ha_node is not None:
+        # the runtime writes through the node's CURRENT role facade:
+        # acks=all + fencing while leading, read-only mirror as follower
+        from ..ha.node import NodeBroker
+
+        broker = NodeBroker(ha_node)
     return SwarmDB(
         config=cfg,
         topic_name=os.environ.get("KAFKA_TOPIC", "swarm_messages"),
         save_dir=os.environ.get("SAVE_DIR", "message_history"),
         autosave_interval=float(os.environ.get("AUTOSAVE_INTERVAL", "300")),
+        broker=broker,
     )
 
 
@@ -148,7 +216,8 @@ def main() -> None:
         # worker (round-2/3 builds refused here; VERDICT #5).
         run_worker()
         return
-    db = build_db()
+    ha_node = build_ha_node()
+    db = build_db(ha_node=ha_node)
     serving = build_serving(db, distributed=distributed)
     cfg = ApiConfig.from_env()
     def _recycle() -> None:
@@ -170,7 +239,8 @@ def main() -> None:
             "API_MAX_REQUESTS ignored on a multi-host pod coordinator"
         )
         cfg = dataclasses.replace(cfg, max_requests=0)
-    app = create_app(db, cfg, serving=serving, on_max_requests=_recycle)
+    app = create_app(db, cfg, serving=serving, on_max_requests=_recycle,
+                     ha_node=ha_node)
     if serving is not None:
         serving.start()
     web.run_app(

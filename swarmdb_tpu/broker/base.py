@@ -57,11 +57,38 @@ DeliveryCallback = Callable[[Optional[str], Record], None]
 
 
 class BrokerError(Exception):
-    pass
+    #: True when retrying the same operation (possibly against a newly
+    #: resolved leader) is safe and likely to succeed. Callers that queue
+    #: work (the runtime's send path) use this to distinguish "try again"
+    #: from "give up".
+    retryable = False
 
 
 class UnknownTopicError(BrokerError):
     pass
+
+
+class FencedError(BrokerError):
+    """A deposed leader tried to write with a stale fencing epoch.
+
+    Raised by :class:`~swarmdb_tpu.broker.replica.ReplicatedBroker` once a
+    follower (or the cluster map) reports a higher epoch than this
+    leader's: its appends and mirror connections are refused so a
+    partitioned old leader coming back can never fork the replicated log.
+    NOT retryable — the process must rejoin as a follower (see the HA
+    runbook in the README)."""
+
+    retryable = False
+
+
+class LeaderChangedError(BrokerError):
+    """The cluster leader moved (failover in progress or completed).
+
+    Raised by :class:`~swarmdb_tpu.ha.client.ClusterBroker` when the node
+    it was bound to died or was deposed. Retryable: the next attempt
+    re-resolves the leader from the cluster map."""
+
+    retryable = True
 
 
 class Broker(abc.ABC):

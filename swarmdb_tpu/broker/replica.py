@@ -27,58 +27,137 @@ broker-replication layer, not inside the storage engine:
   follower returns and catches up — honest acks=all back-pressure, the
   same stall a Kafka producer sees when an ISR shrinks below min.insync.
 
-Failover is operational, not automatic (the reference's Kafka deployment
-config is single-broker — ` main.py:115-124` — so leader election parity
-is out of scope): on leader loss, point the runtime at the follower's
-log directory; every DELIVERED message is in it, fsynced.
+Failover is AUTOMATIC when the nodes run under the HA control plane
+(``swarmdb_tpu/ha/``): a failure detector watches the leader (heartbeat
+frames on this stream + an out-of-band liveness probe), a promotion
+coordinator promotes the most-caught-up follower under a **fencing
+epoch**, and clients re-point through a cluster-map handle
+(``ha.client.ClusterBroker``). The epoch machinery lives HERE because it
+is part of the wire contract:
 
-What failover does NOT preserve: only the record log is replicated.
-Consumer-group committed offsets (``commit_offset``) and retention trims
-(``trim_older_than``) are leader-local and never cross the stream, so a
-manual failover resets every consumer group to the log beginning — each
-group re-reads (and the runtime re-delivers) history it had already
-consumed — and the follower's log may retain records the leader had
-already trimmed. Consumers must be idempotent across a failover, or the
-operator must re-seed group offsets by hand before pointing traffic at
-the follower.
+- Every leader connection starts with an epoch announce (``E``). The
+  follower refuses (``F`` + its epoch) any leader whose epoch is lower
+  than the highest it has seen — "highest epoch wins", the strict
+  upgrade of the single-active-leader guard's last-writer-wins (a
+  deposed leader coming back can never interleave appends, and its
+  ``ReplicatedBroker`` turns the refusal into :class:`FencedError` on
+  every subsequent append).
+- Epochs are persisted in the segment log itself (``__swarmdb_ha``
+  topic, :func:`persist_epoch` / :func:`read_log_epoch`), so they
+  survive restarts and replicate to followers like any other record.
+- Consumer-group committed offsets (``C`` frames) and retention trims
+  (``X`` frames) now cross the stream too: a promoted follower serves
+  consumers from their replicated offsets, not the log beginning, and
+  its retention matches the leader's. (Commit replication is
+  best-effort/at-least-once: commits are idempotent latest-wins
+  metadata, a reconnect re-sends the full commit map, and a failover in
+  the commit-propagation window replays at most one commit interval.)
 
 Resync: on (re)connect the leader streams from the follower's end
 offset. If retention trimming has advanced the leader's begin offset
-past it, that partition can no longer be mirrored contiguously — the
-leader marks it GAPPED, keeps it out of the watermark (so nothing is
-falsely acked), and the operator re-seeds the follower from a copy of
-the leader's log directory.
+past it — or the follower is AHEAD of the leader (a deposed leader's
+un-acked divergent tail) — that partition can no longer be mirrored
+contiguously: the leader marks it GAPPED, keeps it out of the watermark
+(so nothing is falsely acked), and the operator re-seeds the follower
+from a copy of the leader's log directory.
 
 Wire format (all little-endian, one TCP stream per leader->follower
 pair): 1-byte frame type, fixed struct header, then payload bytes.
-  H  follower hello: u32 json_len + JSON {topic: {part: end_offset}}
+  E  leader epoch:   <q>      fencing epoch (first frame on connect)
+  F  fenced:         <q>      follower's higher epoch; stream refused
+  H  follower hello: u32 json_len + JSON {ends: {topic: {part: end}},
+                     epoch: highest_seen}
   T  ensure topic:   u32 json_len + JSON {name, parts, retention_ms}
   R  record:         <HHqdii> topic_len, partition, offset, timestamp,
                      key_len (-1 = null), val_len; + topic + key + value
   A  ack:            <HHq>    topic_len, partition, durable_end; + topic
+  P  heartbeat:      <q>      leader epoch (idle-stream liveness)
+  C  commit:         <HHHq>   group_len, topic_len, partition, offset;
+                     + group + topic
+  X  trim:           <Hd>     topic_len, cutoff_ts; + topic
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
+import os
 import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
-from .base import Broker, BrokerError, Record, TopicMeta
+from .base import Broker, BrokerError, FencedError, Record, TopicMeta
 
 logger = logging.getLogger("swarmdb_tpu.replica")
 
 _REC_HDR = struct.Struct("<HHqdii")
 _ACK_HDR = struct.Struct("<HHq")
 _LEN = struct.Struct("<I")
+_EPOCH = struct.Struct("<q")
+_CMT_HDR = struct.Struct("<HHHq")   # group_len, topic_len, partition, offset
+_TRIM_HDR = struct.Struct("<Hd")    # topic_len, cutoff_ts
 
 _POLL_S = 0.002          # follower ack / leader tail idle poll
 _RECONNECT_S = 0.5       # leader reconnect backoff
 _BATCH = 256             # records per fetch
+
+# Fencing epochs live in the segment log itself so they survive restarts
+# and replicate to followers like any record. One partition, effectively
+# no retention (an epoch record is ~80 bytes; losing history would let a
+# restarted deposed leader forget it was deposed).
+HA_EPOCH_TOPIC = "__swarmdb_ha"
+_EPOCH_RETENTION_MS = 10 * 365 * 24 * 3600 * 1000
+
+
+def _heartbeat_s() -> float:
+    try:
+        return float(os.environ.get("SWARMDB_HA_HEARTBEAT_S", "0.5"))
+    except ValueError:
+        return 0.5
+
+
+def read_log_epoch(broker: Broker) -> int:
+    """Highest fencing epoch persisted in this broker's segment log
+    (0 when the node has never been part of an epoch'd cluster)."""
+    try:
+        if HA_EPOCH_TOPIC not in broker.list_topics():
+            return 0
+        end = broker.end_offset(HA_EPOCH_TOPIC, 0)
+        if end <= 0:
+            return 0
+        recs = broker.fetch(HA_EPOCH_TOPIC, 0, end - 1, 1)
+        if not recs:
+            return 0  # trimmed/wiped — treat as unknown
+        return int(json.loads(recs[-1].value.decode("utf-8"))["epoch"])
+    except (BrokerError, ValueError, KeyError):
+        return 0
+
+
+def persist_epoch(broker: Broker, epoch: int, node_id: str) -> int:
+    """Append an epoch record to the segment log and force durability.
+
+    The fsync matters: a promotion that is not on disk before the new
+    leader takes writes could be forgotten by a crash-restart, and the
+    resurrected node would come back believing its pre-promotion epoch.
+    """
+    broker.create_topic(HA_EPOCH_TOPIC, 1, retention_ms=_EPOCH_RETENTION_MS)
+    payload = json.dumps(
+        {"epoch": int(epoch), "node": node_id, "ts": time.time()}
+    ).encode("utf-8")
+    off = broker.append(HA_EPOCH_TOPIC, 0, payload)
+    broker.flush()
+    return off
+
+
+class _FencedByFollower(Exception):
+    """Internal: a follower refused our epoch (carries its higher one)."""
+
+    def __init__(self, epoch: int) -> None:
+        super().__init__(f"fenced by follower at epoch {epoch}")
+        self.epoch = epoch
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -103,6 +182,17 @@ def _send_record(sock: socket.socket, rec: Record) -> None:
     )
 
 
+def _send_commit(sock: socket.socket, group: str, topic: str,
+                 part: int, offset: int) -> None:
+    g, t = group.encode(), topic.encode()
+    sock.sendall(b"C" + _CMT_HDR.pack(len(g), len(t), part, offset) + g + t)
+
+
+def _send_trim(sock: socket.socket, topic: str, cutoff_ts: float) -> None:
+    t = topic.encode()
+    sock.sendall(b"X" + _TRIM_HDR.pack(len(t), cutoff_ts) + t)
+
+
 class ReplicaServer:
     """Follower side: mirror a leader's log into a local broker.
 
@@ -114,8 +204,15 @@ class ReplicaServer:
     """
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, *,
+                 on_activity: Optional[Callable[[], None]] = None,
+                 gate: Optional[Callable[[], bool]] = None) -> None:
         self.broker = broker
+        # HA hooks: ``on_activity`` fires on every frame from the active
+        # leader (feeds the failure detector's beat); ``gate`` returning
+        # False refuses/drops connections (chaos partition injection).
+        self.on_activity = on_activity
+        self.gate = gate
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # a restarted follower re-binds its fixed port while the previous
@@ -134,15 +231,20 @@ class ReplicaServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
-        # single-active-leader (ADVICE r5 #1): the one connection allowed
-        # to mirror records. A second accept while one leader streams is
-        # split-brain or a leader restart racing its old socket — either
-        # way last-writer-wins: the NEW connection supersedes and the old
-        # stream is closed before the new hello snapshots local ends, so
-        # two leaders can never interleave appends into the mirror.
+        # single-active-leader (ADVICE r5 #1), epoch-aware since ISSUE 4:
+        # the one connection allowed to mirror records. A second accept is
+        # split-brain, a leader restart racing its old socket, or a NEW
+        # leader after a failover — HIGHEST EPOCH WINS: a connection whose
+        # announced epoch is >= the active stream's supersedes it (the
+        # stale stream is closed before the new hello snapshots local
+        # ends, so two leaders can never interleave appends into the
+        # mirror); a connection with a LOWER epoch than the highest ever
+        # seen is refused outright with an F frame (fencing).
         self._conn_lock = threading.Lock()
-        # swarmlint: guarded-by[self._conn_lock]: _active_conn
+        # swarmlint: guarded-by[self._conn_lock]: _active_conn, _conn_epochs, _highest_epoch
         self._active_conn: Optional[socket.socket] = None
+        self._conn_epochs: Dict[int, int] = {}  # id(conn) -> epoch
+        self._highest_epoch: int = read_log_epoch(broker)
 
     def start(self) -> "ReplicaServer":
         t = threading.Thread(target=self._accept_loop, daemon=True,
@@ -150,6 +252,31 @@ class ReplicaServer:
         t.start()
         self._threads.append(t)
         return self
+
+    @property
+    def highest_epoch(self) -> int:
+        with self._conn_lock:
+            return self._highest_epoch
+
+    def note_epoch(self, epoch: int) -> None:
+        """Raise the fencing floor (a promoted node fences every leader
+        below its new epoch, including the one it just replaced)."""
+        with self._conn_lock:
+            if epoch > self._highest_epoch:
+                self._highest_epoch = epoch
+
+    def drop_connections(self) -> None:
+        """Hard-close every leader stream (chaos partition / promotion)."""
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._active_conn = None
+        for sock in conns:
+            for op in (lambda s=sock: s.shutdown(socket.SHUT_RDWR),
+                       sock.close):
+                try:
+                    op()
+                except OSError:
+                    pass
 
     def stop(self) -> None:
         self._stop.set()
@@ -180,30 +307,36 @@ class ReplicaServer:
             # REUSEADDR on the accepted socket too: its eventual TIME_WAIT
             # must not block a restarted server's bind on this port
             conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if self.gate is not None and not self.gate():
+                # chaos partition: drop on the floor (no RST semantics
+                # needed — the leader sees EOF and reconnect-backs-off)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             with self._conn_lock:
-                stale = self._active_conn
-                self._active_conn = conn
                 self._conns.append(conn)
-            if stale is not None:
-                # last-writer-wins BEFORE the new _serve thread sends its
-                # hello: the stale _serve's next recv fails, so its append
-                # stream is dead by the time the new leader's cursor is
-                # anchored on the follower's end offsets
-                logger.warning(
-                    "replica: new leader connection from %s supersedes an "
-                    "active stream — closing the stale one "
-                    "(single-active-leader)", addr)
-                for op in (lambda: stale.shutdown(socket.SHUT_RDWR),
-                           stale.close):
-                    try:
-                        op()
-                    except OSError:
-                        pass
+            # supersede/refuse happens in _serve AFTER the epoch announce
+            # arrives: a stale-epoch connection must be fenced WITHOUT
+            # disturbing the active stream (last-writer-wins would let a
+            # flapping deposed leader repeatedly kill the live mirror)
             logger.info("replica: leader connected from %s", addr)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True, name="swarmdb-replica-conn")
             t.start()
             self._threads.append(t)
+
+    def _note_activity(self) -> None:
+        """Feed the failure detector (every frame from the active leader
+        is a liveness proof). Never lets a callback error kill the
+        mirror stream."""
+        if self.on_activity is None:
+            return
+        try:
+            self.on_activity()
+        except Exception:
+            logger.exception("replica on_activity hook failed")
 
     def _local_ends(self) -> Dict[str, Dict[str, int]]:
         ends: Dict[str, Dict[str, int]] = {}
@@ -264,14 +397,87 @@ class ReplicaServer:
 
         acker = None
         try:
-            hello = json.dumps(self._local_ends()).encode()
+            # -- fencing handshake (ISSUE 4) ------------------------------
+            # The leader's FIRST frame is its epoch announce; a silent or
+            # wedged peer must not hang this thread (timeout lifted once
+            # streaming starts).
+            conn.settimeout(30)
+            if _recv_exact(conn, 1) != b"E":
+                raise BrokerError("expected leader epoch announce")
+            (leader_epoch,) = _EPOCH.unpack(_recv_exact(conn, _EPOCH.size))
+            stale = None
+            refused: Optional[int] = None
+            with self._conn_lock:
+                active = self._active_conn
+                active_epoch = (self._conn_epochs.get(id(active), -1)
+                                if active is not None else -1)
+                if (leader_epoch < self._highest_epoch
+                        or leader_epoch < active_epoch):
+                    refused = max(self._highest_epoch, active_epoch)
+                else:
+                    self._highest_epoch = max(self._highest_epoch,
+                                              leader_epoch)
+                    self._conn_epochs[id(conn)] = leader_epoch
+                    self._active_conn = conn
+                    stale = active
+            if refused is not None:
+                logger.warning(
+                    "replica: fencing leader at stale epoch %d (highest "
+                    "seen %d)", leader_epoch, refused)
+                conn.sendall(b"F" + _EPOCH.pack(refused))
+                return
+            if stale is not None:
+                # highest-epoch-wins supersede, BEFORE the hello below
+                # snapshots local ends: the stale _serve's next recv
+                # fails, so its append stream is dead by the time the new
+                # leader's cursor is anchored on the follower's offsets
+                logger.warning(
+                    "replica: leader connection at epoch %d supersedes the "
+                    "active stream (epoch %d) — closing the stale one "
+                    "(single-active-leader)", leader_epoch, active_epoch)
+                for op in (lambda: stale.shutdown(socket.SHUT_RDWR),
+                           stale.close):
+                    try:
+                        op()
+                    except OSError:
+                        pass
+            self._note_activity()
+            hello = json.dumps({"ends": self._local_ends(),
+                                "epoch": self.highest_epoch}).encode()
             conn.sendall(b"H" + _LEN.pack(len(hello)) + hello)
+            conn.settimeout(None)
             acker = threading.Thread(target=ack_loop, daemon=True,
                                      name="swarmdb-replica-ack")
             acker.start()
             while not self._stop.is_set():
                 ftype = _recv_exact(conn, 1)
-                if ftype == b"T":
+                # a superseded stream needs no is-active re-check here: the
+                # supersede path closes this socket, so the next recv fails
+                self._note_activity()
+                if ftype == b"P":
+                    # heartbeat: liveness only, the activity note above is
+                    # the whole point
+                    _EPOCH.unpack(_recv_exact(conn, _EPOCH.size))
+                elif ftype == b"C":
+                    (glen, tlen, part, offset) = _CMT_HDR.unpack(
+                        _recv_exact(conn, _CMT_HDR.size))
+                    group = _recv_exact(conn, glen).decode()
+                    topic = _recv_exact(conn, tlen).decode()
+                    try:
+                        self.broker.commit_offset(group, topic, part, offset)
+                    except BrokerError:
+                        # commit for a topic not yet mirrored here — the
+                        # reconnect snapshot will re-send it
+                        pass
+                elif ftype == b"X":
+                    (tlen, cutoff) = _TRIM_HDR.unpack(
+                        _recv_exact(conn, _TRIM_HDR.size))
+                    topic = _recv_exact(conn, tlen).decode()
+                    try:
+                        self.broker.trim_older_than(topic, cutoff)
+                    except BrokerError:
+                        pass
+                elif ftype == b"T":
                     (jlen,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
                     spec = json.loads(_recv_exact(conn, jlen))
                     self.broker.create_topic(
@@ -351,6 +557,7 @@ class ReplicaServer:
             with self._conn_lock:
                 if self._active_conn is conn:
                     self._active_conn = None
+                self._conn_epochs.pop(id(conn), None)
                 try:
                     self._conns.remove(conn)
                 except ValueError:
@@ -363,10 +570,37 @@ class ReplicaServer:
 class Replicator:
     """Leader side: one streaming connection to one follower."""
 
-    def __init__(self, broker: Broker, target: str) -> None:
+    def __init__(self, broker: Broker, target: str, *,
+                 get_epoch: Optional[Callable[[], int]] = None,
+                 ctrl_snapshot: Optional[Callable[[], Tuple[Dict, Dict]]] = None,
+                 gate: Optional[Callable[[], bool]] = None,
+                 heartbeat_s: Optional[float] = None,
+                 on_fenced: Optional[Callable[[int], None]] = None) -> None:
         self.broker = broker
         host, _, port = target.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port))
+        # HA hooks (all optional; plain replication uses epoch 0):
+        # get_epoch — this leader's fencing epoch, announced on connect;
+        # ctrl_snapshot — full (commits, trims) maps re-sent on every
+        # (re)connect so control metadata lost to a disconnect converges;
+        # gate — False = chaos partition (refuse to connect / cut stream);
+        # on_fenced — fired once when a follower refuses our epoch.
+        self._get_epoch = get_epoch or (lambda: 0)
+        self._ctrl_snapshot = ctrl_snapshot
+        self.gate = gate
+        self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
+                            else _heartbeat_s())
+        self._on_fenced = on_fenced
+        # a follower reporting a higher epoch means THIS leader is deposed:
+        # stop reconnecting (the stream would be refused forever) and let
+        # ReplicatedBroker surface FencedError on writes
+        self.fenced = threading.Event()
+        self.fenced_epoch: Optional[int] = None
+        # control frames queued while streaming; bounded because the
+        # reconnect snapshot supersedes anything dropped here
+        # swarmlint: guarded-by[self._ctrl_lock]: _ctrl
+        self._ctrl_lock = threading.Lock()
+        self._ctrl: collections.deque = collections.deque(maxlen=4096)
         # tp -> follower durable end, written by recv_acks / clamped at
         # reconnect under the condition below
         # swarmlint: guarded-by[self._cv]: acked, _ack_advanced_at
@@ -394,6 +628,33 @@ class Replicator:
         # racing the close surfaces as a spurious UnknownTopicError +
         # reconnect-backoff log line at every shutdown
         self._thread.join(timeout=2.0)
+
+    def post_commit(self, group: str, topic: str, part: int,
+                    offset: int) -> None:
+        """Queue a consumer-group commit for the follower (best-effort;
+        the reconnect snapshot is the backstop)."""
+        if self.fenced.is_set():
+            return
+        with self._ctrl_lock:
+            self._ctrl.append(("C", group, topic, part, offset))
+
+    def post_trim(self, topic: str, cutoff_ts: float) -> None:
+        """Queue a retention trim for the follower (idempotent)."""
+        if self.fenced.is_set():
+            return
+        with self._ctrl_lock:
+            self._ctrl.append(("X", topic, cutoff_ts))
+
+    def _drain_ctrl(self, sock: socket.socket) -> int:
+        with self._ctrl_lock:
+            pending, self._ctrl = list(self._ctrl), collections.deque(
+                maxlen=self._ctrl.maxlen)
+        for frame in pending:
+            if frame[0] == "C":
+                _send_commit(sock, *frame[1:])
+            else:
+                _send_trim(sock, *frame[1:])
+        return len(pending)
 
     def acked_offset(self, topic: str, part: int) -> int:
         if (topic, part) in self.gapped:
@@ -428,6 +689,7 @@ class Replicator:
             "lag_seconds": round(stalest, 3),
             "connected": self.connected.is_set(),
             "gapped": len(self.gapped),
+            "fenced": self.fenced.is_set(),
         }
 
     def wait_acked(self, topic: str, part: int, offset: int,
@@ -437,15 +699,33 @@ class Replicator:
         with self._cv:
             while self.acked_offset(topic, part) <= offset:
                 left = deadline - time.time()
-                if left <= 0 or self._stop.is_set():
+                if (left <= 0 or self._stop.is_set()
+                        or self.fenced.is_set()):
                     return False
                 self._cv.wait(min(left, 0.05))
         return True
 
     def _run(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self.fenced.is_set():
             try:
                 self._stream_once()
+            except _FencedByFollower as exc:
+                # deposed: reconnecting would be refused forever. Park the
+                # thread and surface the epoch through fenced_epoch /
+                # ReplicatedBroker.FencedError.
+                self.fenced_epoch = exc.epoch
+                self.fenced.set()
+                with self._cv:
+                    self._cv.notify_all()  # release wait_acked parkers
+                logger.error(
+                    "replicator %s: FENCED — follower is at epoch %d, our "
+                    "epoch %d is stale (leader deposed; rejoin as follower)",
+                    self.addr, exc.epoch, self._get_epoch())
+                if self._on_fenced is not None:
+                    try:
+                        self._on_fenced(exc.epoch)
+                    except Exception:
+                        logger.exception("on_fenced hook failed")
             except (ConnectionError, OSError) as exc:
                 logger.info("replicator %s: %s; reconnecting", self.addr, exc)
             except Exception:
@@ -455,6 +735,8 @@ class Replicator:
             self._stop.wait(_RECONNECT_S)
 
     def _stream_once(self) -> None:
+        if self.gate is not None and not self.gate():
+            raise ConnectionError("partitioned (chaos gate)")
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         # REUSEADDR on the CLIENT socket: a closed self-connect (below)
         # parks in TIME_WAIT bound to the follower's port, and without
@@ -478,10 +760,21 @@ class Replicator:
         # hang the replicator (timeout lifted once streaming starts)
         sock.settimeout(30)
         try:
-            if _recv_exact(sock, 1) != b"H":
+            # fencing handshake: announce our epoch FIRST; the follower
+            # answers with its hello (accepted) or an F frame (we are
+            # deposed — a newer leader has a higher epoch)
+            epoch = self._get_epoch()
+            sock.sendall(b"E" + _EPOCH.pack(epoch))
+            ftype = _recv_exact(sock, 1)
+            if ftype == b"F":
+                (fence_epoch,) = _EPOCH.unpack(
+                    _recv_exact(sock, _EPOCH.size))
+                raise _FencedByFollower(fence_epoch)
+            if ftype != b"H":
                 raise BrokerError("expected follower hello")
             (jlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-            follower_ends = json.loads(_recv_exact(sock, jlen))
+            hello = json.loads(_recv_exact(sock, jlen))
+            follower_ends = hello["ends"]
             # clamp stale watermarks to what the follower ACTUALLY holds
             # (review r5 #3): a follower re-seeded or wiped between
             # connections reports lower end offsets, and keeping the old
@@ -536,13 +829,26 @@ class Replicator:
                                      name="swarmdb-replicator-ack")
             acker.start()
 
+            # reconnect snapshot: control metadata (consumer-group commits,
+            # retention trims) queued while disconnected was dropped — the
+            # full latest-wins maps converge the follower in one burst
+            if self._ctrl_snapshot is not None:
+                commits, trims = self._ctrl_snapshot()
+                for (group, topic, part), offset in commits.items():
+                    _send_commit(sock, group, topic, part, offset)
+                for topic, cutoff in trims.items():
+                    _send_trim(sock, topic, cutoff)
+
             known: Dict[str, TopicMeta] = {}
             cursors: Dict[Tuple[str, int], int] = {}
             idle_wait = _POLL_S
+            last_tx = time.monotonic()
             while not self._stop.is_set():
                 if dead.is_set():
                     raise ConnectionError("follower connection lost")
-                shipped = 0
+                if self.gate is not None and not self.gate():
+                    raise ConnectionError("partitioned (chaos gate)")
+                shipped = self._drain_ctrl(sock)
                 for name, meta in self.broker.list_topics().items():
                     prev = known.get(name)
                     if prev is None or prev.num_partitions < meta.num_partitions:
@@ -569,6 +875,19 @@ class Replicator:
                                     "re-seeding", name, part, begin, start)
                                 self.gapped.add(tp)
                                 continue
+                            if start > self.broker.end_offset(name, part):
+                                # follower AHEAD of us: a deposed leader's
+                                # un-acked divergent tail (its local
+                                # appends after it lost the cluster).
+                                # Streaming would silently fork the log —
+                                # mark gapped, operator re-seeds.
+                                logger.error(
+                                    "replication divergence %s[%d]: "
+                                    "follower end %d ahead of leader end; "
+                                    "partition needs re-seeding",
+                                    name, part, start)
+                                self.gapped.add(tp)
+                                continue
                             cursors[tp] = start
                         recs = self.broker.fetch(name, part, cursors[tp],
                                                  _BATCH)
@@ -584,10 +903,18 @@ class Replicator:
                     # tight under traffic without burning a quiet
                     # deployment's CPU on list_topics+fetch 500x/sec
                     # (review r5 #4)
+                    now = time.monotonic()
+                    if now - last_tx >= self.heartbeat_s:
+                        # heartbeat: an idle stream must still prove the
+                        # leader alive, or every quiet period reads as a
+                        # leader death to the follower's failure detector
+                        sock.sendall(b"P" + _EPOCH.pack(epoch))
+                        last_tx = now
                     self._stop.wait(idle_wait)
                     idle_wait = min(idle_wait * 2, 0.05)
                 else:
                     idle_wait = _POLL_S
+                    last_tx = time.monotonic()
         finally:
             try:
                 sock.close()
@@ -604,11 +931,80 @@ class ReplicatedBroker(Broker):
     watermark, so the Producer's acks=all delivery reports fire only for
     records that survive the loss of any single node."""
 
-    def __init__(self, broker: Broker, targets: List[str]) -> None:
-        if not targets:
+    def __init__(self, broker: Broker, targets: List[str], *,
+                 epoch: int = 0, allow_no_targets: bool = False,
+                 gate: Optional[Callable[[], bool]] = None,
+                 heartbeat_s: Optional[float] = None) -> None:
+        if not targets and not allow_no_targets:
+            # a degraded HA leader (last node standing) may run with zero
+            # followers — but only when the caller says so explicitly;
+            # plain replication_factor>1 config without followers stays a
+            # loud error (runtime.py refuses it earlier too)
             raise ValueError("ReplicatedBroker needs at least one target")
         self.inner = broker
-        self.replicators = [Replicator(broker, t) for t in targets]
+        self.epoch = epoch
+        self._gate = gate
+        self._heartbeat_s = heartbeat_s
+        # leader-side control metadata mirrors (latest-wins), re-sent in
+        # full on every follower (re)connect — the Broker ABC has no
+        # enumeration API, so the leader is the source of truth here
+        # swarmlint: guarded-by[self._ctrl_state_lock]: _commits, _trims
+        self._ctrl_state_lock = threading.Lock()
+        self._commits: Dict[Tuple[str, str, int], int] = {}
+        self._trims: Dict[str, float] = {}
+        # explicit deposal (the HA watch loop saw a higher epoch in the
+        # cluster map before any follower had the chance to send F)
+        self._fenced_override: Optional[int] = None
+        self.replicators = [self._make_replicator(t) for t in targets]
+
+    def _make_replicator(self, target: str) -> Replicator:
+        return Replicator(
+            self.inner, target,
+            get_epoch=lambda: self.epoch,
+            ctrl_snapshot=self._ctrl_snapshot,
+            gate=self._gate,
+            heartbeat_s=self._heartbeat_s,
+        )
+
+    def _ctrl_snapshot(self) -> Tuple[Dict, Dict]:
+        with self._ctrl_state_lock:
+            return dict(self._commits), dict(self._trims)
+
+    def add_target(self, target: str) -> bool:
+        """Attach a follower discovered after construction (HA: a node
+        joining the cluster map). False if already replicating to it."""
+        for r in self.replicators:
+            if f"{r.addr[0]}:{r.addr[1]}" == target:
+                return False
+        self.replicators.append(self._make_replicator(target))
+        return True
+
+    @property
+    def fenced_by(self) -> Optional[int]:
+        """Highest epoch that fenced us, or None while leading."""
+        epochs = [r.fenced_epoch for r in self.replicators
+                  if r.fenced.is_set() and r.fenced_epoch is not None]
+        if self._fenced_override is not None:
+            epochs.append(self._fenced_override)
+        return max(epochs) if epochs else None
+
+    def set_fenced(self, epoch: int) -> None:
+        """Depose this leader explicitly (cluster map moved past us)."""
+        self._fenced_override = max(epoch, self._fenced_override or 0)
+
+    def _check_fenced(self) -> None:
+        fenced = self.fenced_by
+        if fenced is not None:
+            raise FencedError(
+                f"leader deposed: our epoch {self.epoch} is fenced by a "
+                f"follower at epoch {fenced} — appends refused (rejoin as "
+                "a follower; see the HA runbook)")
+
+    def stop_replication(self) -> None:
+        """Stop the replicator threads WITHOUT closing the wrapped broker
+        (a deposed leader keeps its log readable for re-seeding)."""
+        for r in self.replicators:
+            r.stop()
 
     # -- replication-gated durability ---------------------------------------
 
@@ -662,6 +1058,11 @@ class ReplicatedBroker(Broker):
         return self.inner.create_partitions(name, new_total)
 
     def append(self, topic, partition, value, key=None, timestamp=None):
+        # the fencing check makes a deposed leader's writes fail FAST and
+        # LOUD (with the epoch in the error) instead of appending to a log
+        # no follower will ever ack — the local-only fork is what manual
+        # failover could never rule out
+        self._check_fenced()
         return self.inner.append(topic, partition, value, key=key,
                                  timestamp=timestamp)
 
@@ -678,13 +1079,26 @@ class ReplicatedBroker(Broker):
         return self.inner.wait_for_data(topic, partition, offset, timeout_s)
 
     def commit_offset(self, group, topic, partition, offset):
-        return self.inner.commit_offset(group, topic, partition, offset)
+        # consumer-group offsets cross the stream (ISSUE 4 satellite):
+        # a promoted follower serves every group from its replicated
+        # committed offset, not the log beginning
+        self.inner.commit_offset(group, topic, partition, offset)
+        with self._ctrl_state_lock:
+            self._commits[(group, topic, partition)] = offset
+        for r in self.replicators:
+            r.post_commit(group, topic, partition, offset)
 
     def committed_offset(self, group, topic, partition):
         return self.inner.committed_offset(group, topic, partition)
 
     def trim_older_than(self, topic, cutoff_ts):
-        return self.inner.trim_older_than(topic, cutoff_ts)
+        n = self.inner.trim_older_than(topic, cutoff_ts)
+        with self._ctrl_state_lock:
+            self._trims[topic] = max(cutoff_ts,
+                                     self._trims.get(topic, cutoff_ts))
+        for r in self.replicators:
+            r.post_trim(topic, cutoff_ts)
+        return n
 
     def flush(self) -> None:
         self.inner.flush()

@@ -120,6 +120,24 @@ class SwarmDB:
                     "replication_factor=1 (single-node group-commit fsync)."
                 )
 
+        # HA client mode (ISSUE 4): with SWARMDB_HA_CLUSTER pointing at a
+        # shared cluster-map file, this runtime is a CLIENT of an
+        # HA-supervised broker cluster (`python -m swarmdb_tpu.ha.node`
+        # services). The ClusterBroker binds to whichever node the map
+        # says is leader and re-points on failover: reads ride through,
+        # an in-flight send either lands acked-durable or raises the
+        # retryable LeaderChangedError — never silently lost.
+        ha_cluster = os.environ.get("SWARMDB_HA_CLUSTER") or None
+        if (broker is None and ha_cluster
+                and not os.environ.get("SWARMDB_HA_NODE_ID")):
+            # (a process with SWARMDB_HA_NODE_ID set IS a cluster node —
+            # server.py wires its broker through the HA node's facade
+            # instead of making it a client of itself)
+            from ..ha.client import ClusterBroker, data_plane_opener
+            from ..ha.cluster import FileClusterMap
+
+            broker = ClusterBroker(FileClusterMap(ha_cluster),
+                                   data_plane_opener())
         self.broker: Broker = broker if broker is not None else _default_broker(self.config)
         if replica_targets:
             from ..broker.replica import ReplicatedBroker
@@ -426,10 +444,18 @@ class SwarmDB:
             with self._lock:
                 self._set_status(msg, MessageStatus.FAILED)
                 msg.metadata["error"] = str(exc)
-            try:
-                self.producer.produce(self.error_topic, payload, key=key, partition=0)
-            except Exception:
-                logger.exception("error-topic produce failed for %s", msg.id)
+                if getattr(exc, "retryable", False):
+                    # mid-failover (LeaderChangedError): the message is
+                    # FAILED-resendable, and the caller's retry (or
+                    # resend_failed_messages) lands it on the new leader.
+                    # Skip the error-topic copy — it would go through the
+                    # same dead leader and double the failure.
+                    msg.metadata["retryable"] = True
+            if not getattr(exc, "retryable", False):
+                try:
+                    self.producer.produce(self.error_topic, payload, key=key, partition=0)
+                except Exception:
+                    logger.exception("error-topic produce failed for %s", msg.id)
             raise
 
         TRACER.span_end(t_pub, "broker.publish", cat="broker", rid=msg.id)

@@ -1,0 +1,40 @@
+"""HA control plane: failure detection, fenced promotion, client failover.
+
+Turns the leader/follower replication of ``broker/replica.py`` into an
+automatically recovering cluster (ISSUE 4). Pieces:
+
+- ``cluster``  — the cluster map (leader, fencing epoch, node registry)
+  with CAS promotion; in-memory and shared-file implementations.
+- ``detector`` — heartbeat + out-of-band-probe failure detector with a
+  lock-free, I/O-free evaluation path (swarmlint SWL601/SWL602).
+- ``node``     — HANode: the per-process role machine (follower ⇄
+  leader), promotion coordinator, and standalone CLI.
+- ``client``   — ClusterBroker: clients re-point to the new leader via
+  the cluster map; writes fail retryably mid-failover, reads ride
+  through.
+- ``dataplane`` — the Broker surface served over TCP, so cross-process
+  clients write through the leader node's acks=all + fencing facade
+  (never a second engine handle over its log dir).
+- ``chaos``    — deterministic fault injection (kill / partition /
+  delay on a scripted schedule) for the tests and ``bench.py``'s HA
+  mode.
+"""
+
+from .chaos import ChaosHarness, build_local_cluster, wait_until
+from .client import ClusterBroker, data_plane_opener
+from .cluster import (ClusterMap, FileClusterMap, InMemoryClusterMap,
+                      NodeInfo, persist_epoch, read_log_epoch)
+from .dataplane import DataPlaneServer, RemoteBroker
+from .detector import (DetectorState, FailureDetector, LivenessServer,
+                       probe_liveness)
+from .node import ClusterUnreachableError, HANode, NodeBroker
+
+__all__ = [
+    "ChaosHarness", "build_local_cluster", "wait_until",
+    "ClusterBroker", "data_plane_opener",
+    "DataPlaneServer", "RemoteBroker",
+    "ClusterMap", "FileClusterMap", "InMemoryClusterMap", "NodeInfo",
+    "persist_epoch", "read_log_epoch",
+    "DetectorState", "FailureDetector", "LivenessServer", "probe_liveness",
+    "ClusterUnreachableError", "HANode", "NodeBroker",
+]

@@ -1,0 +1,5 @@
+"""``python -m swarmdb_tpu.ha`` — alias for the HA node CLI."""
+
+from .node import main
+
+raise SystemExit(main())

@@ -1,0 +1,189 @@
+"""Cluster map: the HA control plane's tiny source of truth.
+
+One record answers "who is the leader, at what fencing epoch, and where
+does everyone live". Promotion is a compare-and-swap on the epoch —
+``try_promote(node, new_epoch)`` succeeds for exactly one caller per
+epoch, which is what makes a partition flap produce ONE new leader
+instead of a dueling pair. Two implementations:
+
+- :class:`InMemoryClusterMap` — single-process clusters (tests, the
+  bench HA mode, embedded deployments).
+- :class:`FileClusterMap` — a JSON file on shared storage (the compose
+  stack's shared volume), CAS'd under an ``fcntl`` lock. This plays the
+  role etcd/ZooKeeper would in a multi-rack deployment; the interface is
+  deliberately small enough to re-implement over either.
+
+A node that cannot reach the cluster map cannot promote itself — that is
+the quorum-ish guard: an isolated follower believing everyone else dead
+still has no way to win an epoch.
+
+Fencing epochs are ALSO persisted in each broker's own segment log
+(:func:`~swarmdb_tpu.broker.replica.persist_epoch`), so a restarted node
+remembers its last epoch even if the map is lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+from ..broker.replica import read_log_epoch, persist_epoch  # noqa: F401  (re-export)
+
+__all__ = ["NodeInfo", "ClusterMap", "InMemoryClusterMap", "FileClusterMap",
+           "read_log_epoch", "persist_epoch"]
+
+
+@dataclass
+class NodeInfo:
+    """One node's addresses as the rest of the cluster should dial them."""
+
+    node_id: str
+    replica_addr: str = ""    # host:port of the mirror listener (follower)
+    liveness_addr: str = ""   # host:port of the out-of-band liveness probe
+    data_addr: str = ""       # host:port of the client data plane
+    log_dir: str = ""         # segment-log dir (re-seed source)
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+def _empty_state() -> Dict[str, Any]:
+    return {"epoch": 0, "leader": None, "nodes": {}}
+
+
+class ClusterMap:
+    """Interface; see module docstring. All methods are thread-safe."""
+
+    def read(self) -> Dict[str, Any]:
+        """Snapshot: ``{"epoch": int, "leader": node_id|None,
+        "nodes": {node_id: NodeInfo-dict}}``."""
+        raise NotImplementedError
+
+    def register(self, info: NodeInfo) -> None:
+        """Upsert a node's addresses (does not change leadership)."""
+        raise NotImplementedError
+
+    def deregister(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def try_promote(self, node_id: str, new_epoch: int,
+                    expect_epoch: Optional[int] = None) -> bool:
+        """CAS: become leader at ``new_epoch`` iff it exceeds the current
+        epoch. Exactly one caller per epoch can win. ``expect_epoch``
+        tightens it to a true compare-and-swap: the promotion also fails
+        if the map's epoch is no longer the one the candidate ranked its
+        peers at — a coordinator whose probe round straddled someone
+        else's win must lose, not seat a second leader over the fresh
+        one (its own ``current_epoch()`` may have already absorbed the
+        winner's epoch, so "higher wins" alone is not enough)."""
+        raise NotImplementedError
+
+
+class InMemoryClusterMap(ClusterMap):
+    def __init__(self) -> None:
+        # swarmlint: guarded-by[self._lock]: _state
+        self._lock = threading.Lock()
+        self._state = _empty_state()
+
+    def read(self) -> Dict[str, Any]:
+        with self._lock:
+            return json.loads(json.dumps(self._state))  # deep copy
+
+    def register(self, info: NodeInfo) -> None:
+        with self._lock:
+            self._state["nodes"][info.node_id] = asdict(info)
+
+    def deregister(self, node_id: str) -> None:
+        with self._lock:
+            self._state["nodes"].pop(node_id, None)
+
+    def try_promote(self, node_id: str, new_epoch: int,
+                    expect_epoch: Optional[int] = None) -> bool:
+        with self._lock:
+            if new_epoch <= self._state["epoch"]:
+                return False
+            if (expect_epoch is not None
+                    and self._state["epoch"] != expect_epoch):
+                return False
+            self._state["epoch"] = int(new_epoch)
+            self._state["leader"] = node_id
+            return True
+
+
+class FileClusterMap(ClusterMap):
+    """JSON file + ``fcntl.flock`` sidecar lock on shared storage.
+
+    Every mutation (and the CAS) runs read-modify-write under the lock;
+    the write itself is tmp+rename so readers never see a torn file.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock_path = path + ".lock"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def _load(self) -> Dict[str, Any]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return _empty_state()
+        for key, default in _empty_state().items():
+            state.setdefault(key, default)
+        return state
+
+    def _store(self, state: Dict[str, Any]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, self.path)
+
+    def _locked(self):
+        import fcntl
+
+        class _Lock:
+            def __init__(self, path: str) -> None:
+                self._path = path
+                self._fd: Optional[int] = None
+
+            def __enter__(self) -> "_Lock":
+                self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+                fcntl.flock(self._fd, fcntl.LOCK_EX)
+                return self
+
+            def __exit__(self, *exc: Any) -> None:
+                if self._fd is not None:
+                    fcntl.flock(self._fd, fcntl.LOCK_UN)
+                    os.close(self._fd)
+
+        return _Lock(self._lock_path)
+
+    def read(self) -> Dict[str, Any]:
+        with self._locked():
+            return self._load()
+
+    def register(self, info: NodeInfo) -> None:
+        with self._locked():
+            state = self._load()
+            state["nodes"][info.node_id] = asdict(info)
+            self._store(state)
+
+    def deregister(self, node_id: str) -> None:
+        with self._locked():
+            state = self._load()
+            state["nodes"].pop(node_id, None)
+            self._store(state)
+
+    def try_promote(self, node_id: str, new_epoch: int,
+                    expect_epoch: Optional[int] = None) -> bool:
+        with self._locked():
+            state = self._load()
+            if new_epoch <= state["epoch"]:
+                return False
+            if expect_epoch is not None and state["epoch"] != expect_epoch:
+                return False
+            state["epoch"] = int(new_epoch)
+            state["leader"] = node_id
+            self._store(state)
+            return True

@@ -1,0 +1,702 @@
+"""HANode: one broker process under the HA control plane.
+
+A node wraps a :class:`~swarmdb_tpu.broker.base.Broker` and runs, per
+role:
+
+- **follower** — a :class:`~swarmdb_tpu.broker.replica.ReplicaServer`
+  mirroring the leader's log, a :class:`~swarmdb_tpu.ha.detector
+  .FailureDetector` watching the leader (fed by replication-frame beats
+  + the out-of-band liveness probe), and a promotion coordinator that
+  fires on confirmed leader death.
+- **leader** — a :class:`~swarmdb_tpu.broker.replica.ReplicatedBroker`
+  over every registered follower, exposed as :attr:`broker_facade` (the
+  acks=all write surface), plus a reconcile loop that picks up newly
+  registered followers and steps down if the cluster map moves past us.
+
+Every node runs a :class:`~swarmdb_tpu.ha.detector.LivenessServer` — the
+out-of-band probe endpoint, which also reports the node's fencing epoch
+and catch-up total (sum of end offsets) for candidate ranking.
+
+Promotion ("highest epoch wins", single winner):
+
+1. detector says DEAD (beats AND probes gone past ``dead_s``);
+2. the coordinator probes every other registered node and ranks live
+   candidates by ``(catch-up, node_id)`` — most-caught-up wins, id
+   breaks ties deterministically;
+3. the winner CASes the cluster map to ``epoch+1``
+   (:meth:`ClusterMap.try_promote` — exactly one caller can win an
+   epoch, so a partition flap can never seat two leaders);
+4. it persists the epoch into its own segment log
+   (:func:`~swarmdb_tpu.broker.replica.persist_epoch`) BEFORE taking
+   writes, then starts replicating to the surviving followers. The dead
+   leader is deregistered from the map; when it comes back it is fenced
+   (``F`` frames / :class:`~swarmdb_tpu.broker.base.FencedError`) until
+   re-seeded and restarted as a follower (see the README runbook).
+
+Deterministic fault injection for all of the above lives in
+``ha/chaos.py``; the node exposes the hooks it needs
+(:meth:`set_isolated`, :meth:`set_delay`, :meth:`kill`).
+
+Run standalone (the compose follower service)::
+
+    python -m swarmdb_tpu.ha.node --node-id follower-1 \
+        --log-dir /data/replica --cluster /data/ha/cluster.json \
+        --listen 0.0.0.0:9444 --liveness 0.0.0.0:9445
+
+Healthcheck probe (exit 0 iff the liveness endpoint answers)::
+
+    python -m swarmdb_tpu.ha.node --probe localhost:9445
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..broker.base import Broker
+from ..broker.replica import (ReplicaServer, ReplicatedBroker,
+                              persist_epoch, read_log_epoch)
+from ..obs import TRACER
+from ..obs.flight import FlightRecorder
+from .cluster import ClusterMap, NodeInfo
+from .detector import (DetectorState, FailureDetector, LivenessServer,
+                       dead_s_default, probe_liveness, suspect_s_default)
+
+logger = logging.getLogger("swarmdb_tpu.ha")
+
+__all__ = ["HANode", "NodeBroker", "ClusterUnreachableError", "main"]
+
+
+class ClusterUnreachableError(RuntimeError):
+    """The control-plane store cannot be reached (partition): promotion
+    and reconciliation must stall, never guess."""
+
+
+def _promotion_policy() -> str:
+    return os.environ.get("SWARMDB_HA_PROMOTION", "auto").strip() or "auto"
+
+
+class HANode:
+    def __init__(self, node_id: str, broker: Broker, cluster: ClusterMap, *,
+                 listen_host: str = "127.0.0.1", replica_port: int = 0,
+                 liveness_port: int = 0, data_port: Optional[int] = 0,
+                 advertise_host: Optional[str] = None,
+                 heartbeat_s: Optional[float] = None,
+                 suspect_s: Optional[float] = None,
+                 dead_s: Optional[float] = None,
+                 promotion: Optional[str] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 log_dir: str = "") -> None:
+        self.node_id = node_id
+        self.broker = broker
+        self.cluster = cluster
+        self._listen_host = listen_host
+        self._replica_port = replica_port
+        self._liveness_port = liveness_port
+        self._data_port = data_port  # None = no client data plane
+        self._advertise_host = advertise_host or listen_host
+        self.heartbeat_s = heartbeat_s
+        self.suspect_s = (suspect_s if suspect_s is not None
+                          else suspect_s_default())
+        self.dead_s = (dead_s if dead_s is not None
+                       else dead_s_default(self.suspect_s))
+        self.promotion = promotion or _promotion_policy()
+        self.flight = flight or FlightRecorder()
+        self.log_dir = log_dir
+
+        self._lock = threading.RLock()
+        # swarmlint: guarded-by[self._lock]: _role, _epoch, _leader_broker
+        self._role = "follower"
+        self._epoch = read_log_epoch(broker)
+        self._leader_broker: Optional[ReplicatedBroker] = None
+
+        # chaos hooks: benign racy flags (GIL-atomic bool/float stores)
+        self._isolated = False
+        self._delay = 0.0
+
+        self._stop = threading.Event()
+        self._promoting = threading.Event()  # one promotion attempt at a time
+        self._last_leader_seen: Optional[str] = None
+        self._threads: List[threading.Thread] = []
+
+        self._replica_server: Optional[ReplicaServer] = None
+        self._liveness: Optional[LivenessServer] = None
+        self._data_plane = None  # DataPlaneServer when data_port is set
+        self._detector: Optional[FailureDetector] = None
+
+    # ------------------------------------------------------------ chaos hooks
+
+    def _gate(self) -> bool:
+        """Connection-admission gate consulted by every server/stream this
+        node owns. False = chaos partition; a configured delay injects
+        latency before the verdict."""
+        if self._delay > 0:
+            time.sleep(min(self._delay, 0.5))
+        return not self._isolated
+
+    def set_isolated(self, isolated: bool) -> None:
+        self._isolated = bool(isolated)
+        if isolated and self._replica_server is not None:
+            # cut existing streams too, not just new ones
+            self._replica_server.drop_connections()
+        if isolated and self._data_plane is not None:
+            self._data_plane.drop_connections()
+        self._record("partition" if isolated else "heal", {})
+
+    def set_delay(self, seconds: float) -> None:
+        self._delay = max(0.0, float(seconds))
+        self._record("delay", {"seconds": self._delay})
+
+    def kill(self) -> None:
+        """Abrupt death (chaos): no graceful handover, broker closed."""
+        self._record("kill", {})
+        with self._lock:
+            # dead BEFORE teardown: from this instant every broker_facade
+            # access refuses, exactly like the sockets of a dead process
+            self._role = "dead"
+        self.stop()
+        try:
+            self.broker.close()
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self, role: str = "follower") -> "HANode":
+        self._liveness = LivenessServer(
+            self.current_epoch, self._catchup_total,
+            self._listen_host, self._liveness_port,
+            gate=self._gate).start()
+        self._replica_server = ReplicaServer(
+            self.broker, self._listen_host, self._replica_port,
+            on_activity=self._on_replica_activity, gate=self._gate).start()
+        data_addr = ""
+        if self._data_port is not None:
+            from .dataplane import DataPlaneServer
+
+            # per-request facade lookup: clients ride role transitions
+            # (and get FencedError from a deposed leader) with no rebind
+            self._data_plane = DataPlaneServer(
+                lambda: self.broker_facade, self._listen_host,
+                self._data_port, gate=self._gate).start()
+            data_addr = f"{self._advertise_host}:{self._data_plane.port}"
+        self.cluster.register(NodeInfo(
+            node_id=self.node_id,
+            replica_addr=f"{self._advertise_host}:{self._replica_server.port}",
+            liveness_addr=f"{self._advertise_host}:{self._liveness.port}",
+            data_addr=data_addr,
+            log_dir=self.log_dir,
+        ))
+        self._detector = FailureDetector(
+            self._leader_liveness_addr,
+            suspect_s=self.suspect_s, dead_s=self.dead_s,
+            on_state=self._on_detector_state,
+            name=self.node_id,
+        ).start()
+        if role == "leader":
+            state = self._read_map()
+            new_epoch = max(state["epoch"], self.current_epoch()) + 1
+            if not self.cluster.try_promote(self.node_id, new_epoch,
+                                            expect_epoch=state["epoch"]):
+                raise RuntimeError(
+                    f"bootstrap promotion lost: cluster already at epoch "
+                    f">= {new_epoch} (is another leader running?)")
+            self._become_leader(new_epoch, self._read_map(),
+                                deposed=None)
+        t = threading.Thread(target=self._watch_loop, daemon=True,
+                             name=f"swarmdb-ha-watch-{self.node_id}")
+        t.start()
+        self._threads.append(t)
+        self._record("start", {"role": self.role})
+        return self
+
+    def stop(self) -> None:
+        """Graceful stop: servers and threads down, broker left open
+        (the caller owns it)."""
+        self._stop.set()
+        if self._detector is not None:
+            self._detector.stop()
+        with self._lock:
+            lb = self._leader_broker
+            self._leader_broker = None
+        if lb is not None:
+            lb.stop_replication()
+        if self._replica_server is not None:
+            self._replica_server.stop()
+        if self._data_plane is not None:
+            self._data_plane.stop()
+        if self._liveness is not None:
+            self._liveness.stop()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def role(self) -> str:
+        with self._lock:
+            return self._role
+
+    def current_epoch(self) -> int:
+        """Highest epoch this node has seen: its own persisted/announced
+        epoch or any it learned from a connecting leader."""
+        with self._lock:
+            epoch = self._epoch
+        if self._replica_server is not None:
+            epoch = max(epoch, self._replica_server.highest_epoch)
+        return epoch
+
+    @property
+    def broker_facade(self) -> Broker:
+        """What clients write through: the replicated (acks=all) wrapper
+        while leading, the plain local broker otherwise (reads only —
+        ClusterBroker routes writes to the map leader). A killed node
+        raises — its real-deployment counterpart is a dead process whose
+        sockets refuse, and an in-process chaos kill must look the same
+        to a ClusterBroker (transient error -> re-resolve the leader)."""
+        with self._lock:
+            if self._role == "dead":
+                raise ConnectionError(f"node {self.node_id} is dead")
+            return self._leader_broker or self.broker
+
+    def status(self) -> Dict[str, Any]:
+        """Control-plane status (the /admin/ha + /metrics surface)."""
+        with self._lock:
+            role, epoch, lb = self._role, self._epoch, self._leader_broker
+        out: Dict[str, Any] = {
+            "node_id": self.node_id,
+            "role": role,
+            "epoch": epoch,
+            "promotion": self.promotion,
+            "isolated": self._isolated,
+        }
+        try:
+            state = self._read_map()
+            out["leader"] = state.get("leader")
+            out["cluster_epoch"] = state.get("epoch")
+            out["nodes"] = sorted(state.get("nodes", {}))
+        except ClusterUnreachableError:
+            out["leader"] = None
+            out["cluster_unreachable"] = True
+        if self._detector is not None and role == "follower":
+            out["detector"] = self._detector.status()
+        if lb is not None:
+            out["replication"] = lb.replication_stats()
+            out["fenced_by"] = lb.fenced_by
+        return out
+
+    def _catchup_total(self) -> int:
+        total = 0
+        try:
+            for name, meta in self.broker.list_topics().items():
+                for p in range(meta.num_partitions):
+                    total += self.broker.end_offset(name, p)
+        except Exception:
+            pass
+        return total
+
+    # ------------------------------------------------------------ map access
+
+    def _read_map(self) -> Dict[str, Any]:
+        if self._isolated:
+            # a partitioned node cannot see the control store — and
+            # therefore can never win an epoch (the no-dueling guard)
+            raise ClusterUnreachableError(self.node_id)
+        return self.cluster.read()
+
+    def _leader_liveness_addr(self) -> Optional[str]:
+        try:
+            state = self._read_map()
+        except ClusterUnreachableError:
+            return None
+        leader = state.get("leader")
+        if leader is None or leader == self.node_id:
+            return None
+        info = state.get("nodes", {}).get(leader)
+        return info.get("liveness_addr") if info else None
+
+    # ------------------------------------------------------------- callbacks
+
+    def _on_replica_activity(self) -> None:
+        if self._detector is not None:
+            self._detector.beat()
+
+    def _on_detector_state(self, old: DetectorState,
+                           new: DetectorState) -> None:
+        self._record("detector", {"from": old.name.lower(),
+                                  "to": new.name.lower()})
+        TRACER.instant("ha.detector", cat="ha",
+                       args={"node": self.node_id, "state": new.name.lower()})
+        if new is DetectorState.DEAD and self.promotion == "auto":
+            if self.role == "follower" and not self._promoting.is_set():
+                self._promoting.set()
+                t = threading.Thread(target=self._promotion_loop, daemon=True,
+                                     name=f"swarmdb-ha-promote-{self.node_id}")
+                t.start()
+                self._threads.append(t)
+
+    # -------------------------------------------------------------- promotion
+
+    def _promotion_loop(self) -> None:
+        """Runs until the cluster has a live leader again (us or a better
+        candidate) or the leader turns out to be alive after all."""
+        dead_leader: Optional[str] = None
+        try:
+            while not self._stop.is_set():
+                if (self._detector is None
+                        or self._detector.state is not DetectorState.DEAD
+                        or self.role != "follower"):
+                    return
+                try:
+                    state = self._read_map()
+                except ClusterUnreachableError:
+                    self._stop.wait(self.suspect_s)
+                    continue
+                if dead_leader is None:
+                    dead_leader = state.get("leader")
+                if dead_leader is None:
+                    return  # nothing to fail over from
+                if state.get("leader") != dead_leader:
+                    # someone else already won this failover: the leader
+                    # we judged dead is not the map's leader any more. Our
+                    # detector's DEAD verdict is about the OLD leader —
+                    # promoting on it now would depose the fresh winner
+                    # (the dueling-promotion bug). Give the new leader a
+                    # fresh grace period and stand down.
+                    if self._detector is not None:
+                        self._detector.reset()
+                    return
+                # rank live candidates by (catch-up, node_id); probes run
+                # on this thread — promotion is allowed to block
+                my_key = (self._catchup_total(), self.node_id)
+                best_key = my_key
+                peer_epoch_max = 0
+                for nid, info in state.get("nodes", {}).items():
+                    if nid in (dead_leader, self.node_id):
+                        continue
+                    addr = info.get("liveness_addr")
+                    if not addr:
+                        continue
+                    res = probe_liveness(addr, max(0.05, self.suspect_s / 2))
+                    if res is None:
+                        continue  # dead or partitioned: not a candidate
+                    epoch, catchup = res
+                    peer_epoch_max = max(peer_epoch_max, epoch)
+                    if (catchup, nid) > best_key:
+                        best_key = (catchup, nid)
+                if best_key == my_key:
+                    new_epoch = max(state["epoch"], self.current_epoch(),
+                                    peer_epoch_max) + 1
+                    try:
+                        # expect_epoch pins the CAS to the map we ranked
+                        # against: if anyone won while our probes ran,
+                        # we lose here and stand down on the next pass —
+                        # never promote over a freshly seated leader
+                        won = self.cluster.try_promote(
+                            self.node_id, new_epoch,
+                            expect_epoch=state["epoch"])
+                    except Exception:
+                        logger.exception("try_promote failed; retrying")
+                        won = False
+                    if won:
+                        self._become_leader(new_epoch, self._read_map(),
+                                            deposed=dead_leader)
+                        return
+                # not best, or lost the CAS: give the winner a beat, then
+                # re-read — a new leader resets our detector via the watch
+                # loop and this loop exits on its next pass
+                self._stop.wait(max(0.05, self.suspect_s / 2))
+        finally:
+            self._promoting.clear()
+
+    def _become_leader(self, new_epoch: int, map_state: Dict[str, Any],
+                       deposed: Optional[str]) -> None:
+        t0 = time.time()
+        # epoch on disk BEFORE the first write: a crash-restart between
+        # promotion and the first append must come back knowing it led
+        persist_epoch(self.broker, new_epoch, self.node_id)
+        targets = [
+            info.get("replica_addr")
+            for nid, info in map_state.get("nodes", {}).items()
+            if nid not in (self.node_id, deposed) and info.get("replica_addr")
+        ]
+        with self._lock:
+            self._role = "leader"
+            self._epoch = new_epoch
+            self._leader_broker = ReplicatedBroker(
+                self.broker, targets, epoch=new_epoch,
+                allow_no_targets=True, gate=self._gate,
+                heartbeat_s=self.heartbeat_s)
+        if self._replica_server is not None:
+            # the mirror listener stays up purely as a fencing endpoint:
+            # raising its floor turns any stale leader's connect into an
+            # F frame carrying our epoch
+            self._replica_server.note_epoch(new_epoch)
+            self._replica_server.drop_connections()
+        if deposed is not None:
+            # the dead leader leaves the map: it must re-register (after
+            # re-seeding) to rejoin, and until then the reconcile loop
+            # won't gate the acks=all watermark on a corpse
+            try:
+                self.cluster.deregister(deposed)
+            except Exception:
+                logger.exception("deregistering deposed leader failed")
+        logger.warning(
+            "ha: %s PROMOTED to leader at epoch %d (deposed=%s, "
+            "followers=%s)", self.node_id, new_epoch, deposed, targets)
+        TRACER.instant("ha.promoted", cat="ha",
+                       args={"node": self.node_id, "epoch": new_epoch,
+                             "deposed": deposed, "followers": len(targets)})
+        self._record("promoted", {"epoch": new_epoch, "deposed": deposed,
+                                  "followers": targets,
+                                  "elapsed_s": round(time.time() - t0, 4)})
+        self.flight.auto_dump("ha_promotion")
+
+    def _step_down(self, cluster_epoch: int,
+                   new_leader: Optional[str]) -> None:
+        with self._lock:
+            if self._role != "leader":
+                return
+            self._role = "deposed"
+            # the fenced ReplicatedBroker STAYS the facade: reads keep
+            # working (re-seeding needs the log) but every write raises
+            # FencedError with the epoch — a deposed leader must fail
+            # loud, not quietly fork a local-only log
+            lb = self._leader_broker
+        if lb is not None:
+            lb.set_fenced(cluster_epoch)
+            lb.stop_replication()
+        logger.error(
+            "ha: %s DEPOSED (cluster moved to epoch %d, leader %s) — "
+            "writes refused; re-seed and restart as follower",
+            self.node_id, cluster_epoch, new_leader)
+        TRACER.instant("ha.deposed", cat="ha",
+                       args={"node": self.node_id, "epoch": cluster_epoch,
+                             "new_leader": new_leader})
+        self._record("deposed", {"cluster_epoch": cluster_epoch,
+                                 "new_leader": new_leader})
+        self.flight.auto_dump("ha_deposed")
+
+    # -------------------------------------------------------------- reconcile
+
+    def _watch_loop(self) -> None:
+        poll = max(0.05, self.suspect_s / 2)
+        while not self._stop.is_set():
+            self._stop.wait(poll)
+            if self._stop.is_set():
+                return
+            try:
+                state = self._read_map()
+            except ClusterUnreachableError:
+                continue
+            except Exception:
+                logger.exception("cluster map read failed")
+                continue
+            leader = state.get("leader")
+            with self._lock:
+                role, epoch, lb = self._role, self._epoch, self._leader_broker
+            if role == "leader":
+                if (state.get("epoch", 0) > epoch
+                        or (leader is not None and leader != self.node_id)):
+                    self._step_down(state.get("epoch", 0), leader)
+                    continue
+                if lb is not None:
+                    if lb.fenced_by is not None:
+                        self._step_down(lb.fenced_by, leader)
+                        continue
+                    # adopt newly registered followers
+                    for nid, info in state.get("nodes", {}).items():
+                        if nid == self.node_id or not info.get("replica_addr"):
+                            continue
+                        lb.add_target(info["replica_addr"])
+            elif role == "follower":
+                if leader != self._last_leader_seen:
+                    # failover completed (or first leader appeared): judge
+                    # the NEW leader with a fresh grace period
+                    self._last_leader_seen = leader
+                    if self._detector is not None:
+                        self._detector.reset()
+                if self._replica_server is not None:
+                    # learn the cluster epoch as a fencing floor even
+                    # before the new leader's first mirror connect
+                    self._replica_server.note_epoch(state.get("epoch", 0))
+
+    # ------------------------------------------------------------------- obs
+
+    def _record(self, kind: str, detail: Dict[str, Any]) -> None:
+        try:
+            self.flight.record_event({
+                "t": time.time(), "node": self.node_id,
+                "kind": f"ha.{kind}", **detail,
+            })
+        except Exception:
+            pass
+
+
+class NodeBroker(Broker):
+    """Stable Broker handle over a node's CURRENT role facade.
+
+    A runtime embedding an HA node (``server.py`` with
+    ``SWARMDB_HA_NODE_ID`` set) holds one broker reference for its whole
+    life, but the node's write surface changes at every role transition:
+    plain local broker as follower, :class:`ReplicatedBroker` (acks=all +
+    fencing) as leader. This proxy re-reads :attr:`HANode.broker_facade`
+    per call, so a promotion/deposal takes effect on the very next
+    operation — including :class:`~swarmdb_tpu.broker.base.FencedError`
+    on a deposed leader's appends."""
+
+    def __init__(self, node: "HANode") -> None:
+        self.node = node
+
+    def _b(self) -> Broker:
+        return self.node.broker_facade
+
+    def create_topic(self, name, num_partitions,
+                     retention_ms=7 * 24 * 3600 * 1000):
+        return self._b().create_topic(name, num_partitions,
+                                      retention_ms=retention_ms)
+
+    def list_topics(self):
+        return self._b().list_topics()
+
+    def create_partitions(self, name, new_total):
+        return self._b().create_partitions(name, new_total)
+
+    def append(self, topic, partition, value, key=None, timestamp=None):
+        return self._b().append(topic, partition, value, key=key,
+                                timestamp=timestamp)
+
+    def fetch(self, topic, partition, offset, max_records=256):
+        return self._b().fetch(topic, partition, offset, max_records)
+
+    def end_offset(self, topic, partition):
+        return self._b().end_offset(topic, partition)
+
+    def begin_offset(self, topic, partition):
+        return self._b().begin_offset(topic, partition)
+
+    def wait_for_data(self, topic, partition, offset, timeout_s):
+        return self._b().wait_for_data(topic, partition, offset, timeout_s)
+
+    def commit_offset(self, group, topic, partition, offset):
+        return self._b().commit_offset(group, topic, partition, offset)
+
+    def committed_offset(self, group, topic, partition):
+        return self._b().committed_offset(group, topic, partition)
+
+    def trim_older_than(self, topic, cutoff_ts):
+        return self._b().trim_older_than(topic, cutoff_ts)
+
+    def durable_offset(self, topic, partition):
+        return self._b().durable_offset(topic, partition)
+
+    def wait_durable(self, topic, partition, offset, timeout_s):
+        return self._b().wait_durable(topic, partition, offset, timeout_s)
+
+    def flush(self):
+        return self._b().flush()
+
+    def close(self):
+        # the node owns its broker's lifecycle (stop() leaves it open for
+        # the caller; kill() closes it) — closing through the proxy would
+        # tear the log out from under an active role machine
+        pass
+
+    def healthy(self):
+        try:
+            return self._b().healthy()
+        except Exception:
+            return False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone HA node (the compose follower service) / probe CLI."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="swarmdb HA node")
+    ap.add_argument("--node-id", default=None)
+    ap.add_argument("--role", choices=("follower", "leader"),
+                    default="follower")
+    ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--cluster", default=None,
+                    help="path to the shared cluster-map JSON file")
+    ap.add_argument("--listen", default="0.0.0.0:9444",
+                    help="host:port for the replica mirror listener")
+    ap.add_argument("--liveness", default="0.0.0.0:9445",
+                    help="host:port for the liveness probe endpoint")
+    ap.add_argument("--data", default="0.0.0.0:9446",
+                    help="host:port for the client data plane "
+                         "(port 'off' disables it)")
+    ap.add_argument("--advertise-host", default=None,
+                    help="hostname peers should dial (default: $HOSTNAME)")
+    ap.add_argument("--broker", choices=("native", "local"), default="native")
+    ap.add_argument("--sync-interval-ms", type=int, default=5)
+    ap.add_argument("--probe", default=None, metavar="HOST:PORT",
+                    help="healthcheck mode: probe a liveness endpoint and "
+                         "exit 0 iff it answers")
+    args = ap.parse_args(argv)
+
+    if args.probe:
+        res = probe_liveness(args.probe, timeout_s=2.0)
+        if res is None:
+            print(json.dumps({"ok": False, "target": args.probe}))
+            return 1
+        print(json.dumps({"ok": True, "target": args.probe,
+                          "epoch": res[0], "catchup": res[1]}))
+        return 0
+
+    if not (args.node_id and args.log_dir and args.cluster):
+        ap.error("--node-id, --log-dir and --cluster are required "
+                 "(unless --probe)")
+    logging.basicConfig(level=logging.INFO)
+
+    from .cluster import FileClusterMap
+
+    if args.broker == "native":
+        from ..broker.native import NativeBroker
+
+        broker: Broker = NativeBroker(log_dir=args.log_dir,
+                                      sync_interval_ms=args.sync_interval_ms)
+    else:
+        from ..broker.local import LocalBroker
+
+        broker = LocalBroker(
+            snapshot_path=os.path.join(args.log_dir, "snapshot.json"))
+
+    host, _, port = args.listen.rpartition(":")
+    lhost, _, lport = args.liveness.rpartition(":")
+    _, _, dport = args.data.rpartition(":")
+    data_port = None if dport == "off" else int(dport)
+    advertise = (args.advertise_host
+                 or os.environ.get("SWARMDB_HA_ADVERTISE_HOST")
+                 or (host if host not in ("", "0.0.0.0") else
+                     __import__("socket").gethostname()))
+    node = HANode(
+        args.node_id, broker, FileClusterMap(args.cluster),
+        listen_host=host or "0.0.0.0", replica_port=int(port),
+        liveness_port=int(lport), data_port=data_port,
+        advertise_host=advertise, log_dir=args.log_dir,
+    ).start(role=args.role)
+    data = (f"{node._data_plane.host}:{node._data_plane.port}"
+            if node._data_plane is not None else "off")
+    print(f"HA_NODE_READY {args.node_id} "
+          f"replica={node._replica_server.host}:{node._replica_server.port} "
+          f"liveness={node._liveness.host}:{node._liveness.port} "
+          f"data={data}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+        broker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

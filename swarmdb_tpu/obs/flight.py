@@ -15,16 +15,22 @@ iteration:
 - **request records** — one per retirement: the request's timeline
   (submitted → admitted → first token → retired), priority, prompt
   length, generated count, finish reason.
+- **event records** — rare discrete facts from OTHER subsystems (HA
+  detector transitions, promotions, fencing, chaos injections): written
+  from arbitrary threads under a small lock (events are per-incident,
+  not per-step, so the lock never sits on a hot path).
 
-Both rings are written ONLY by the engine thread (no locks on the record
-path); readers snapshot racily, which at worst tears one record. Dumps
-are triggered automatically by :meth:`Engine.restart` (the watchdog
-path) and on demand via ``GET /admin/flight``; ``bench.py`` deposits one
-per mode under ``bench_logs/``.
+The step/request rings are written ONLY by the engine thread (no locks
+on the record path); readers snapshot racily, which at worst tears one
+record. Dumps are triggered automatically by :meth:`Engine.restart` (the
+watchdog path), by HA promotions/deposals, and on demand via ``GET
+/admin/flight``; ``bench.py`` deposits one per mode under
+``bench_logs/``.
 
 Knobs: ``SWARMDB_FLIGHT_STEPS`` (ring size, default 512),
-``SWARMDB_FLIGHT_REQUESTS`` (default 256), ``SWARMDB_FLIGHT_DIR``
-(where automatic dumps land; unset = in-memory ``last_dump`` only).
+``SWARMDB_FLIGHT_REQUESTS`` (default 256), ``SWARMDB_FLIGHT_EVENTS``
+(default 256), ``SWARMDB_FLIGHT_DIR`` (where automatic dumps land;
+unset = in-memory ``last_dump`` only).
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -74,13 +81,20 @@ class _DictRing:
 
 class FlightRecorder:
     def __init__(self, n_steps: Optional[int] = None,
-                 n_requests: Optional[int] = None) -> None:
+                 n_requests: Optional[int] = None,
+                 n_events: Optional[int] = None) -> None:
         if n_steps is None:
             n_steps = _env_int("SWARMDB_FLIGHT_STEPS", 512)
         if n_requests is None:
             n_requests = _env_int("SWARMDB_FLIGHT_REQUESTS", 256)
+        if n_events is None:
+            n_events = _env_int("SWARMDB_FLIGHT_EVENTS", 256)
         self._steps = _DictRing(max(8, n_steps))
         self._requests = _DictRing(max(8, n_requests))
+        self._events = _DictRing(max(8, n_events))
+        # events come from arbitrary threads (HA detector/promotion,
+        # chaos) — rare, so a lock is fine HERE and only here
+        self._events_lock = threading.Lock()
         # free-form identity (mesh shape, shard count, model) set by the
         # engine builder; rides every dump
         self.meta: Dict[str, Any] = {}
@@ -97,6 +111,12 @@ class FlightRecorder:
         """One completed/failed request timeline (engine thread only)."""
         self._requests.put(rec)
 
+    def record_event(self, rec: Dict[str, Any]) -> None:
+        """One discrete incident (HA transition, chaos injection) — any
+        thread; locked because events have no single owner."""
+        with self._events_lock:
+            self._events.put(rec)
+
     # -------------------------------------------------------------- reading
 
     def steps(self) -> List[Dict[str, Any]]:
@@ -105,6 +125,9 @@ class FlightRecorder:
     def requests(self) -> List[Dict[str, Any]]:
         return self._requests.snapshot()
 
+    def events(self) -> List[Dict[str, Any]]:
+        return self._events.snapshot()
+
     def dump(self, reason: str = "on_demand") -> Dict[str, Any]:
         return {
             "reason": reason,
@@ -112,6 +135,7 @@ class FlightRecorder:
             "meta": dict(self.meta),
             "steps": self.steps(),
             "requests": self.requests(),
+            "events": self.events(),
         }
 
     def dump_to(self, directory: str, reason: str = "on_demand") -> str:

@@ -34,3 +34,14 @@ def tmp_swarm(tmp_path):
     db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "history"))
     yield db
     db.close()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Expose each test's call-phase outcome on the item so teardown
+    fixtures can act on failure (the HA chaos tests dump their flight
+    rings to SWARMDB_FLIGHT_DIR for the CI artifact upload)."""
+    out = yield
+    rep = out.get_result()
+    if rep.when == "call":
+        item.rep_call = rep

@@ -1,0 +1,60 @@
+"""Seeded heartbeat-safety violations (SWL601/SWL602) — lint fixture.
+
+Not imported by anything; analyzed as text by tests/test_swarmlint.py.
+The shapes mirror the bugs `ha/detector.py`'s evaluation path must never
+grow: blocking I/O or a lock on the verdict path turns a healthy leader
+into a "dead" one.
+"""
+
+import socket
+import time
+
+
+class StallingDetector:
+    def __init__(self, lock, peer_addr):
+        self._lock = lock
+        self._peer = peer_addr
+        self._last_beat = 0.0
+
+    # swarmlint: heartbeat
+    def evaluate_with_lock(self, now):
+        with self._lock:  # EXPECT: SWL602
+            return now - self._last_beat
+
+    # swarmlint: heartbeat
+    def evaluate_with_probe(self, now):
+        sock = socket.create_connection(self._peer, 0.5)  # EXPECT: SWL601
+        sock.sendall(b"?")  # EXPECT: SWL601
+        time.sleep(0.01)  # EXPECT: SWL601
+        return now - self._last_beat
+
+    # swarmlint: heartbeat
+    def evaluate_with_acquire(self, now):
+        self._lock.acquire()  # EXPECT: SWL602
+        try:
+            return now - self._last_beat
+        finally:
+            self._lock.release()
+
+    # swarmlint: heartbeat
+    def evaluate_via_helper(self, now):
+        # the marker propagates into nested defs: same thread, same stall
+        def freshest():
+            with self._lock:  # EXPECT: SWL602
+                return self._last_beat
+
+        return now - freshest()
+
+    def probe_loop_ok(self):
+        # NOT marked heartbeat: blocking I/O on the probe thread is the
+        # sanctioned home for it — no finding
+        sock = socket.create_connection(self._peer, 0.5)
+        sock.sendall(b"?")
+        with self._lock:
+            self._last_beat = time.monotonic()
+
+    # swarmlint: heartbeat
+    def evaluate_clean(self, now):
+        # pure arithmetic over single-writer stamps — no finding
+        age = now - self._last_beat
+        return age > 2.0

@@ -1,9 +1,13 @@
 """Single-active-leader on the follower (ADVICE r5 #1 / ISSUE 1
-satellite): two simultaneous leader connections — split-brain, or a
-restarted leader racing its not-yet-dead old socket — must never
-interleave appends into the mirror. The ReplicaServer tracks the active
-mirroring connection and closes the stale stream on a new accept
-(last-writer-wins), BEFORE the new hello anchors the mirror cursor.
+satellite, epoch-aware since ISSUE 4): two simultaneous leader
+connections — split-brain, or a restarted leader racing its not-yet-dead
+old socket — must never interleave appends into the mirror. The
+ReplicaServer tracks the active mirroring connection and its fencing
+epoch: a connection announcing an epoch >= the active stream's
+supersedes it (the stale stream is closed BEFORE the new hello anchors
+the mirror cursor); a connection announcing a LOWER epoch than the
+highest ever seen is refused with an F frame (fencing — a deposed
+leader can never mirror again).
 
 Speaks the wire protocol over raw sockets against a LocalBroker-backed
 ReplicaServer (no native library needed), exactly like a leader would.
@@ -15,17 +19,18 @@ import time
 
 from swarmdb_tpu.broker.base import BrokerError
 from swarmdb_tpu.broker.local import LocalBroker
-from swarmdb_tpu.broker.replica import (_LEN, _REC_HDR, ReplicaServer,
-                                        _recv_exact)
+from swarmdb_tpu.broker.replica import (_EPOCH, _LEN, _REC_HDR,
+                                        ReplicaServer, _recv_exact)
 
 
-def _connect_and_hello(server):
+def _connect_and_hello(server, epoch=0):
     sock = socket.create_connection((server.host, server.port), timeout=5)
     sock.settimeout(5)
+    sock.sendall(b"E" + _EPOCH.pack(epoch))
     assert _recv_exact(sock, 1) == b"H"
     (jlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    ends = json.loads(_recv_exact(sock, jlen))
-    return sock, ends
+    hello = json.loads(_recv_exact(sock, jlen))
+    return sock, hello["ends"]
 
 
 def _send_topic(sock, name, parts=1):
@@ -82,6 +87,48 @@ def test_second_leader_supersedes_stale_stream():
         assert [r.value for r in broker.fetch("t", 0, 0, 10)] == [b"alive"]
         time.sleep(0.1)  # give any ghost append a beat to (not) land
         assert "ghost" not in broker.list_topics()
+    finally:
+        server.stop()
+        broker.close()
+
+
+def test_stale_epoch_leader_is_fenced_without_disturbing_active():
+    """ISSUE 4: highest-epoch-wins. A deposed leader reconnecting with a
+    stale epoch gets an F frame carrying the higher epoch and is refused
+    — and, unlike last-writer-wins, the ACTIVE stream keeps mirroring."""
+    broker = LocalBroker()
+    server = ReplicaServer(broker).start()
+    try:
+        active, _ = _connect_and_hello(server, epoch=5)
+        # stale leader (epoch 3 < 5): refused with the fencing epoch
+        stale = socket.create_connection((server.host, server.port),
+                                         timeout=5)
+        stale.settimeout(5)
+        stale.sendall(b"E" + _EPOCH.pack(3))
+        assert _recv_exact(stale, 1) == b"F"
+        (fence_epoch,) = _EPOCH.unpack(_recv_exact(stale, _EPOCH.size))
+        assert fence_epoch == 5
+        # ...and the refusal closed the stale stream
+        assert stale.recv(4096) == b""
+        stale.close()
+        # the active epoch-5 stream is undisturbed: records still mirror
+        _send_topic(active, "t")
+        _send_record(active, "t", 0, 0, b"still-leader")
+        deadline = time.time() + 5
+        while time.time() < deadline and _end_offset(broker, "t", 0) < 1:
+            time.sleep(0.01)
+        assert [r.value for r in broker.fetch("t", 0, 0, 10)] == \
+            [b"still-leader"]
+        # the floor is sticky: even after the active stream drops, epoch 3
+        # stays fenced (a restarted deposed leader is refused forever)
+        active.close()
+        time.sleep(0.1)
+        late = socket.create_connection((server.host, server.port),
+                                        timeout=5)
+        late.settimeout(5)
+        late.sendall(b"E" + _EPOCH.pack(3))
+        assert _recv_exact(late, 1) == b"F"
+        late.close()
     finally:
         server.stop()
         broker.close()
