@@ -169,14 +169,17 @@ def bench_echo(seconds: float) -> dict:
         "vs_baseline": round(value / TARGET_MSGS_PER_SEC, 4),
         "mode": "echo",
     }
-    # tracer-overhead A/B (acceptance: <= 5% msgs/sec, recorded here).
-    # Alternating on/off segments over ONE shared db: back-to-back whole
-    # runs drift by more than the effect being measured (observed ±5%
-    # between identical runs), while interleaving cancels warm-up and
-    # allocator drift. The engine modes amortize the same ring writes
-    # over far more work per message, so echo is the worst case.
+    # tracer+histogram overhead A/B (acceptance: <= 5% msgs/sec,
+    # recorded here). Alternating on/off segments over ONE shared db:
+    # back-to-back whole runs drift by more than the effect being
+    # measured (observed ±5% between identical runs), while interleaving
+    # cancels warm-up and allocator drift. The engine modes amortize the
+    # same ring writes over far more work per message, so echo is the
+    # worst case. Since ISSUE 6 the "on" segments also record the
+    # fixed-bucket /metrics histograms (HIST_PUBLISH sits on this exact
+    # path), so tracer_overhead_pct is the combined observability cost.
     try:
-        from swarmdb_tpu.obs import TRACER
+        from swarmdb_tpu.obs import HISTOGRAMS, TRACER
 
         was_enabled = TRACER.enabled
         if was_enabled:
@@ -188,12 +191,15 @@ def bench_echo(seconds: float) -> dict:
                                  autosave_interval=1e9)
                     for _ in range(2):
                         TRACER.set_enabled(True)
+                        HISTOGRAMS.set_enabled(True)
                         on_rate += _echo_loop(db, seg)
                         TRACER.set_enabled(False)
+                        HISTOGRAMS.set_enabled(False)
                         off_rate += _echo_loop(db, seg)
                     db.close()
             finally:
                 TRACER.set_enabled(True)
+                HISTOGRAMS.set_enabled(True)
             on_rate /= 2
             off_rate /= 2
             result["echo_tracer_on_msgs_per_sec"] = round(on_rate, 2)
@@ -437,7 +443,12 @@ def _deposit_obs_artifacts(service, mode: str) -> dict:
     ships the timelines that explain its numbers). Returns the artifact
     paths for the mode's JSON line; never raises. SWARMDB_BENCH_LOGS_DIR
     overrides the destination (tests point it at a tmp dir so harness
-    runs never dirty the repo's bench_logs/)."""
+    runs never dirty the repo's bench_logs/).
+
+    With ``--analyze`` (or SWARMDB_BENCH_ANALYZE=1 — mode=all children
+    inherit it through the env) the offline analyzer runs over the
+    just-written artifacts and its diagnosis rides the mode's record:
+    the ROADMAP-item-1 root-cause reading, repeatable every run."""
     out: dict = {}
     logs = os.environ.get("SWARMDB_BENCH_LOGS_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_logs")
@@ -453,6 +464,17 @@ def _deposit_obs_artifacts(service, mode: str) -> dict:
             logs, reason=f"bench_{mode}")
     except Exception as exc:  # noqa: BLE001 — artifacts must not kill a bench
         out["obs_artifact_error"] = repr(exc)[-200:]
+    if (os.environ.get("SWARMDB_BENCH_ANALYZE") == "1"
+            and out.get("trace_artifact")):
+        try:
+            from swarmdb_tpu.obs import analyze
+
+            paths = [out["trace_artifact"]]
+            if out.get("flight_artifact"):
+                paths.append(out["flight_artifact"])
+            out["diagnosis"] = analyze.analyze_files(paths)["diagnosis"]
+        except Exception as exc:  # noqa: BLE001
+            out["diagnosis_error"] = repr(exc)[-200:]
     return out
 
 
@@ -827,6 +849,12 @@ def bench_dpserve(seconds: float) -> dict:
     total_slots = slots_per * n
 
     def run(ndev: int) -> dict:
+        # both sub-runs share this process's tracer: without a reset the
+        # second deposit would export the FIRST run's spans too and
+        # poison the dp1-vs-dpN diagnosis
+        from swarmdb_tpu.obs import TRACER
+
+        TRACER.reset()
         mesh = make_mesh(ndev, data=ndev, model=1, expert=1)
         with tempfile.TemporaryDirectory() as tmp:
             db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
@@ -868,6 +896,21 @@ def bench_dpserve(seconds: float) -> dict:
     single = run(1)
     value = multi.pop("completed_per_sec")
     v1 = single["completed_per_sec"]
+    dp_diag = None
+    if os.environ.get("SWARMDB_BENCH_ANALYZE") == "1":
+        # the A/B this mode exists for, analyzed in-run: dp1 trace as
+        # base, dpN as test — the record then NAMES the scaling
+        # bottleneck (ROADMAP open item 1) instead of just scoring it
+        try:
+            from swarmdb_tpu.obs import analyze
+
+            paths = [p for p in (single.get("trace_artifact"),
+                                 multi.get("trace_artifact"),
+                                 single.get("flight_artifact"),
+                                 multi.get("flight_artifact")) if p]
+            dp_diag = analyze.analyze_files(paths)["diagnosis"]
+        except Exception as exc:  # noqa: BLE001
+            dp_diag = {"error": repr(exc)[-200:]}
     return {
         "metric": "dpserve_completed_messages_per_sec",
         "value": round(value, 2),
@@ -889,6 +932,7 @@ def bench_dpserve(seconds: float) -> dict:
         # devices (≈1.0 = the sharded program costs nothing extra; real
         # DP speedup needs real chips, which this harness cannot reach)
         "dp_scaling_x": round(value / v1, 2) if v1 else None,
+        **({"dp_diagnosis": dp_diag} if dp_diag is not None else {}),
         "note": ("virtual-CPU-device A/B of the sharded paged path at "
                  "equal total slots; not TPU perf"),
     }
@@ -1295,6 +1339,10 @@ def _run_all() -> None:
 
 
 def main() -> None:
+    if "--analyze" in sys.argv[1:]:
+        # env, not argv: mode=all children re-exec bench.py without
+        # arguments and must inherit the switch
+        os.environ["SWARMDB_BENCH_ANALYZE"] = "1"
     mode = _env("SWARMDB_BENCH_MODE", "all")
     seconds = _env("SWARMDB_BENCH_SECONDS", 20.0)
     if mode == "all":

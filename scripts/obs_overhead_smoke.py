@@ -1,15 +1,17 @@
 #!/usr/bin/env python
-"""Tracer-overhead smoke for CI (ISSUE 2 acceptance: <= 5% budget).
+"""Observability-overhead smoke for CI (ISSUE 2 acceptance: <= 5%
+budget; ISSUE 6 extends the A/B to the /metrics histograms).
 
-Runs the pure-routing echo loop with the span tracer enabled vs disabled
-in ALTERNATING segments (back-to-back whole runs drift more than the
-effect measured) and fails if the overhead exceeds the smoke bound.
-Stdlib + pydantic only — no jax, no aiohttp, no pytest — so the bare
-`lint` CI job can run it. The bound is 20%: CI boxes are noisy, and the
-point of the smoke is to catch a catastrophic regression (a lock or an
-O(n) walk landing on the record path), not to re-measure the tight
-number — bench.py's echo mode records that (`tracer_overhead_pct`).
-"""
+Runs the pure-routing echo loop with the span tracer AND the
+fixed-bucket histograms enabled vs disabled in ALTERNATING segments
+(back-to-back whole runs drift more than the effect measured) and fails
+if the combined overhead exceeds the smoke bound. Stdlib + pydantic
+only — no jax, no aiohttp, no pytest — so the bare `lint` CI job can
+run it. The bound is 20%: CI boxes are noisy, and the point of the
+smoke is to catch a catastrophic regression (a lock or an O(n) walk
+landing on the record path), not to re-measure the tight number —
+bench.py's echo mode records that (`tracer_overhead_pct`, which since
+ISSUE 6 also covers histogram recording)."""
 
 import os
 import sys
@@ -25,7 +27,7 @@ def main() -> int:
     import bench
     from swarmdb_tpu.broker.local import LocalBroker
     from swarmdb_tpu.core.runtime import SwarmDB
-    from swarmdb_tpu.obs import TRACER
+    from swarmdb_tpu.obs import HISTOGRAMS, TRACER
 
     on = off = 0.0
     with tempfile.TemporaryDirectory() as tmp:
@@ -34,17 +36,22 @@ def main() -> int:
         try:
             for _ in range(2):
                 TRACER.set_enabled(True)
+                HISTOGRAMS.set_enabled(True)
                 on += bench._echo_loop(db, SEG_S)
                 TRACER.set_enabled(False)
+                HISTOGRAMS.set_enabled(False)
                 off += bench._echo_loop(db, SEG_S)
         finally:
             TRACER.set_enabled(True)
+            HISTOGRAMS.set_enabled(True)
             db.close()
     overhead = max(0.0, (off - on) / off * 100.0) if off else 0.0
-    print(f"echo msgs/sec: tracer on {on / 2:.1f}, off {off / 2:.1f}, "
-          f"overhead {overhead:.2f}% (bound {BOUND:.0f}%)")
+    print(f"echo msgs/sec: tracer+histograms on {on / 2:.1f}, "
+          f"off {off / 2:.1f}, overhead {overhead:.2f}% "
+          f"(bound {BOUND:.0f}%)")
     if overhead > BOUND:
-        print("FAIL: tracer overhead above smoke bound", file=sys.stderr)
+        print("FAIL: observability overhead above smoke bound",
+              file=sys.stderr)
         return 1
     return 0
 

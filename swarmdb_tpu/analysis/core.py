@@ -100,6 +100,10 @@ RULES: Dict[str, Rule] = {
         Rule("SWL502", "span-discipline",
              "allocating span(...) context manager inside a hot-path "
              "function — use the span_begin/span_end ring writes"),
+        Rule("SWL503", "span-discipline",
+             "histogram allocated or looked up per observation inside a "
+             "hot-path function — bind it once and observe through the "
+             "bound object"),
         Rule("SWL601", "heartbeat-safety",
              "blocking call inside `# swarmlint: heartbeat` code — a "
              "stalled failure-detector evaluation reads as a dead peer "

@@ -16,6 +16,13 @@ contract the type system cannot enforce:
   the allocation-free ring writes (``span_begin``/``span_end``/
   ``span_at``/``instant``); a ``.span(...)`` context manager there
   allocates an object + frame per call on the decode path (SWL502).
+- Histograms (``obs/metrics.py`` and ``utils/metrics.py``) have the
+  same discipline: ``observe()`` is allocation-free only when the
+  histogram object was bound ONCE. A per-call registry/dict lookup
+  (``registry.get("x").observe(v)``, ``self.latencies["x"].observe``
+  — a defaultdict that ALLOCATES a histogram on a miss) or a per-call
+  ``Histogram(...)`` construction inside ``# swarmlint: hot`` code
+  puts a hash lookup/allocation on the decode path (SWL503).
 
 ``__enter__``/``__exit__`` pairs are exempt from SWL501 — the context-
 manager protocol balances them across two methods by design.
@@ -52,6 +59,20 @@ def _is_call_to(node: ast.AST, method: str) -> bool:
     return bool(name) and name.split(".")[-1] == method
 
 
+#: histogram types whose construction in a hot function is SWL503
+_HIST_TYPES = {"Histogram", "LatencyHistogram"}
+
+
+def _dynamic_receiver(node: ast.AST) -> bool:
+    """True when the expression contains a Subscript or Call — i.e. the
+    histogram is looked up (or allocated, for defaultdict registries)
+    per observation instead of being a pre-bound name/attribute."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Subscript, ast.Call)):
+            return True
+    return False
+
+
 def check(src: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     for fn in ast.walk(src.tree):
@@ -78,6 +99,25 @@ def check(src: SourceFile) -> List[Finding]:
                     f"allocating span(...) context manager inside "
                     f"hot-path function `{fn.name}` — use the "
                     f"span_begin/span_end ring writes"))
+            if src.is_hot(fn) and isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if (name and name.split(".")[-1] in _HIST_TYPES):
+                    findings.append(make_finding(
+                        src, "SWL503", node,
+                        f"histogram constructed inside hot-path "
+                        f"function `{fn.name}` — construct at init and "
+                        f"bind the object"))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "observe"
+                        and _dynamic_receiver(node.func.value)):
+                    findings.append(make_finding(
+                        src, "SWL503", node,
+                        f"per-call histogram lookup "
+                        f"(`{ast.unparse(node.func.value)}`) before "
+                        f".observe() inside hot-path function "
+                        f"`{fn.name}` — a registry/dict lookup (or a "
+                        f"defaultdict allocation) per observation; "
+                        f"bind the histogram once"))
         if (begins and ends == 0
                 and fn.name not in _BALANCE_EXEMPT):
             findings.append(make_finding(

@@ -35,7 +35,7 @@ from pydantic import ValidationError
 
 from ..core.messages import MessageStatus
 from ..core.runtime import SwarmDB
-from ..obs import TRACER
+from ..obs import HISTOGRAMS, TRACER, propagate
 from ..utils import jwt as jwt_util
 from . import schemas
 
@@ -646,6 +646,11 @@ def create_app(
                 if s.get(key) is not None:
                     lines.append(f'{n}{{quantile="{q}"}} {s[key]}')
             lines.append(f"{n}_count {int(s.get('count') or 0)}")
+        # fixed-bucket latency histograms (obs/metrics.py, ISSUE 6):
+        # TTFT, queue wait, decode chunk, data-plane RTT, replication
+        # commit — Prometheus histogram exposition with STABLE bucket
+        # boundaries, so p50/p99-over-time exist outside bench runs
+        lines.extend(HISTOGRAMS.render_prometheus())
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -712,15 +717,86 @@ def create_app(
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
+    def _trace_query(request: web.Request):
+        """Shared ?last_n= / ?trace_id= parsing for the trace routes."""
+        q = request.query
+        last_n = None
+        if q.get("last_n"):
+            try:
+                last_n = max(0, int(q["last_n"]))
+            except ValueError:
+                raise _error(422, f"bad last_n: {q['last_n']!r}")
+        return last_n, (q.get("trace_id") or None)
+
     async def trace_export(request: web.Request) -> web.Response:
         """GET /admin/trace/export — the span tracer's buffered events as
         Chrome trace-event JSON (load in https://ui.perfetto.dev or
         chrome://tracing). Covers every layer that records spans: API
         routes, runtime send/receive, broker publish, engine admission/
-        prefill/decode chunks/host syncs, and message stage marks."""
+        prefill/decode chunks/host syncs, and message stage marks.
+
+        BOUNDED (ISSUE 6 satellite): ``?trace_id=`` keeps one trace
+        (plus HA instants), ``?last_n=`` the newest N spans, and an
+        unconditional cap (``SWARMDB_TRACE_EXPORT_MAX``, default 50000
+        events) stops a long-lived node from returning an unbounded
+        body; ``metadata.truncated`` says when the cap bit."""
         require_admin(current_agent(request))
-        trace = await _run_sync(TRACER.to_chrome_trace)
+        last_n, trace_id = _trace_query(request)
+        trace = await _run_sync(
+            lambda: TRACER.to_chrome_trace(last_n=last_n, rid=trace_id))
         return web.json_response(trace)
+
+    async def cluster_trace(request: web.Request) -> web.Response:
+        """GET /admin/cluster/trace — ONE merged Perfetto-loadable trace
+        for the whole cluster (ISSUE 6 tentpole): fans out to every node
+        in the cluster map over the data plane's ``trace_export`` op,
+        merges the per-node rings by re-anchored wall clock, and dedups
+        (in-process clusters share a tracer). Dead/unreachable nodes are
+        skipped and listed in ``metadata.unreachable`` — a failover
+        trace must survive the dead leader it documents. Same
+        ``?last_n=`` / ``?trace_id=`` filters as /admin/trace/export;
+        with ``trace_id`` the merge keeps that trace's spans plus every
+        node's HA instants (promotion/fencing land in the timeline)."""
+        require_admin(current_agent(request))
+        last_n, trace_id = _trace_query(request)
+        # the local process is always a source (API + engine spans live
+        # here even when this process runs no HA node)
+        local = await _run_sync(
+            lambda: TRACER.to_chrome_trace(last_n=last_n, rid=trace_id))
+        sources = [(propagate.node_id(), local)]
+        unreachable = []
+        cluster = (ha_node.cluster if ha_node is not None
+                   else getattr(db.broker, "cluster", None))
+
+        def _fan_out():
+            from ..ha.dataplane import RemoteBroker
+
+            try:
+                state = cluster.read()
+            except Exception as exc:
+                unreachable.append({"node": "<cluster-map>",
+                                    "error": str(exc)})
+                return
+            for nid, info in sorted(state.get("nodes", {}).items()):
+                addr = (info or {}).get("data_addr")
+                if not addr:
+                    continue
+                rb = RemoteBroker(addr, timeout_s=2.0)
+                try:
+                    out = rb.trace_export(last_n=last_n, trace_id=trace_id)
+                    sources.append((out.get("node", nid), out["trace"]))
+                except Exception as exc:
+                    unreachable.append({"node": nid, "error": str(exc)})
+                finally:
+                    rb.close()
+
+        if cluster is not None:
+            await _run_sync(_fan_out)
+        merged = propagate.merge_chrome_traces(sources)
+        merged["metadata"]["unreachable"] = unreachable
+        if trace_id:
+            merged["metadata"]["trace_id"] = trace_id
+        return web.json_response(merged)
 
     async def flight_record(request: web.Request) -> web.Response:
         """GET /admin/flight — the engine flight recorder's current rings
@@ -902,6 +978,7 @@ def create_app(
         web.post("/admin/profile/start", profile_start),
         web.post("/admin/profile/stop", profile_stop),
         web.get("/admin/trace/export", trace_export),
+        web.get("/admin/cluster/trace", cluster_trace),
         web.get("/admin/flight", flight_record),
         web.get("/admin/ha", admin_ha),
     ])

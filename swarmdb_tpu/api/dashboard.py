@@ -55,6 +55,10 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     download Chrome trace</button>
   (open in <a href="https://ui.perfetto.dev" target="_blank">Perfetto</a>
   or chrome://tracing) &middot;
+  <button onclick="download('/admin/cluster/trace', 'cluster_trace.json')">
+    download CLUSTER trace</button>
+  (one merged timeline across every cluster-map node, promotion
+  instants included) &middot;
   <button onclick="download('/admin/flight', 'flight.json')">
     download flight record</button>
   (last engine steps + request timelines; auto-dumped on engine restart)

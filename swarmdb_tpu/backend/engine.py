@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import TRACER, FlightRecorder
+from ..obs.metrics import (HIST_DECODE_CHUNK, HIST_QUEUE_WAIT, HIST_TTFT)
 from ..utils.metrics import MetricsRegistry
 from .sampling import (SamplingParams, make_slot_keys,
                        sample_tokens, token_logprob)
@@ -193,6 +194,12 @@ class Engine:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.metrics = metrics or MetricsRegistry()
+        # latency sinks bound ONCE: hot-marked paths must never pay a
+        # defaultdict lookup — or allocate a fresh histogram — per
+        # observation (swarmlint SWL503)
+        self._lat_queue_wait = self.metrics.latencies["queue_wait_s"]
+        self._lat_prefill = self.metrics.latencies["prefill_s"]
+        self._lat_first_token = self.metrics.latencies["first_token_s"]
         # observability: request spans ride the process-global tracer;
         # the flight recorder (last-N engine steps + last-M request
         # timelines) is per-engine and auto-dumped on restart/error —
@@ -2581,7 +2588,8 @@ class Engine:
             # reused stays consistent across the prefix and resume paths
             self.metrics.counters["prompt_tokens"].inc(
                 len(req.prompt) + req.resume_len)
-            self.metrics.latencies["queue_wait_s"].observe(t0 - req.submitted_at)
+            self._lat_queue_wait.observe(t0 - req.submitted_at)
+            HIST_QUEUE_WAIT.observe(t0 - req.submitted_at)
             self.metrics.counters["phase_us_queue_wait"].inc(
                 max(0, int((t0 - req.submitted_at) * 1e6)))
             # retro-span: the wait was over before any tracer call site
@@ -2589,7 +2597,7 @@ class Engine:
             self.tracer.span_at("engine.admit", req.submitted_at, t0,
                                 cat="engine", rid=req.request_id)
         prefill_dt = time.time() - t0
-        self.metrics.latencies["prefill_s"].observe(prefill_dt)
+        self._lat_prefill.observe(prefill_dt)
         self.metrics.counters["phase_us_prefill"].inc(
             max(0, int(prefill_dt * 1e6)))
         for slot_id, req in batch:
@@ -2670,6 +2678,7 @@ class Engine:
             # overlap, so sums can exceed wall clock — documented)
             self.metrics.counters["phase_us_decode"].inc(
                 (t_sync1 - t_dispatch_ns) // 1000)
+            HIST_DECODE_CHUNK.observe((t_sync1 - t_dispatch_ns) / 1e9)
         block = np.asarray(block)
         lps = np.asarray(lps)
         now = time.time()
@@ -2714,7 +2723,8 @@ class Engine:
         now = now or time.time()
         if slot.first_token_at is None:
             slot.first_token_at = now
-            self.metrics.latencies["first_token_s"].observe(now - req.submitted_at)
+            self._lat_first_token.observe(now - req.submitted_at)
+            HIST_TTFT.observe(now - req.submitted_at)
 
         finished_reason = None
         if token == self.eos_id:

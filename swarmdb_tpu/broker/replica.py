@@ -75,6 +75,13 @@ pair): 1-byte frame type, fixed struct header, then payload bytes.
   C  commit:         <HHHq>   group_len, topic_len, partition, offset;
                      + group + topic
   X  trim:           <Hd>     topic_len, cutoff_ts; + topic
+  G  trace context:  u32 json_len + JSON {t: trace_id, s: span_id,
+                     o: origin} — the trace context of the most recent
+                     traced leader append (ISSUE 6): the follower marks
+                     a ``replica.apply`` instant under that trace id in
+                     its OWN span ring, so a cluster-merged trace shows
+                     the replication hop. Best-effort like C/X frames;
+                     consecutive duplicates are elided.
 """
 
 from __future__ import annotations
@@ -89,6 +96,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import TRACER, propagate
+from ..obs.metrics import HIST_REPLICATION_COMMIT
 from .base import Broker, BrokerError, FencedError, Record, TopicMeta
 
 logger = logging.getLogger("swarmdb_tpu.replica")
@@ -191,6 +200,11 @@ def _send_commit(sock: socket.socket, group: str, topic: str,
 def _send_trim(sock: socket.socket, topic: str, cutoff_ts: float) -> None:
     t = topic.encode()
     sock.sendall(b"X" + _TRIM_HDR.pack(len(t), cutoff_ts) + t)
+
+
+def _send_trace(sock: socket.socket, tc: Dict) -> None:
+    payload = json.dumps(tc).encode()
+    sock.sendall(b"G" + _LEN.pack(len(payload)) + payload)
 
 
 class ReplicaServer:
@@ -477,6 +491,18 @@ class ReplicaServer:
                         self.broker.trim_older_than(topic, cutoff)
                     except BrokerError:
                         pass
+                elif ftype == b"G":
+                    # trace-context announce (ISSUE 6): the follower's
+                    # replication hop joins the propagated trace
+                    (jlen,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                    ctx = propagate.extract(
+                        json.loads(_recv_exact(conn, jlen)))
+                    if ctx is not None:
+                        TRACER.instant(
+                            "replica.apply", cat="replica",
+                            rid=ctx.trace_id,
+                            args={"origin": ctx.origin,
+                                  "node": propagate.node_id()})
                 elif ftype == b"T":
                     (jlen,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
                     spec = json.loads(_recv_exact(conn, jlen))
@@ -598,9 +624,10 @@ class Replicator:
         self.fenced_epoch: Optional[int] = None
         # control frames queued while streaming; bounded because the
         # reconnect snapshot supersedes anything dropped here
-        # swarmlint: guarded-by[self._ctrl_lock]: _ctrl
+        # swarmlint: guarded-by[self._ctrl_lock]: _ctrl, _last_trace
         self._ctrl_lock = threading.Lock()
         self._ctrl: collections.deque = collections.deque(maxlen=4096)
+        self._last_trace: Optional[Dict] = None  # G-frame dedup
         # tp -> follower durable end, written by recv_acks / clamped at
         # reconnect under the condition below
         # swarmlint: guarded-by[self._cv]: acked, _ack_advanced_at
@@ -645,6 +672,19 @@ class Replicator:
         with self._ctrl_lock:
             self._ctrl.append(("X", topic, cutoff_ts))
 
+    def post_trace(self, tc: Dict) -> None:
+        """Queue a trace-context announce (ISSUE 6; best-effort —
+        tracing must never back-pressure replication). Consecutive
+        duplicates are elided so a burst of appends under one trace
+        costs one G frame."""
+        if self.fenced.is_set():
+            return
+        with self._ctrl_lock:
+            if tc == self._last_trace:
+                return
+            self._last_trace = tc
+            self._ctrl.append(("G", tc))
+
     def _drain_ctrl(self, sock: socket.socket) -> int:
         with self._ctrl_lock:
             pending, self._ctrl = list(self._ctrl), collections.deque(
@@ -652,6 +692,8 @@ class Replicator:
         for frame in pending:
             if frame[0] == "C":
                 _send_commit(sock, *frame[1:])
+            elif frame[0] == "G":
+                _send_trace(sock, frame[1])
             else:
                 _send_trim(sock, *frame[1:])
         return len(pending)
@@ -1015,6 +1057,7 @@ class ReplicatedBroker(Broker):
 
     def wait_durable(self, topic: str, partition: int, offset: int,
                      timeout_s: float) -> bool:
+        t0 = time.monotonic()
         deadline = time.time() + timeout_s
         if not self.inner.wait_durable(topic, partition, offset, timeout_s):
             return False
@@ -1022,6 +1065,9 @@ class ReplicatedBroker(Broker):
             if not r.wait_acked(topic, partition, offset,
                                 max(0.0, deadline - time.time())):
                 return False
+        # replication lag as writers experience it: append -> acks=all
+        # watermark passed it (histogram at /metrics, ISSUE 6)
+        HIST_REPLICATION_COMMIT.observe(time.monotonic() - t0)
         return True
 
     def replication_stats(self) -> List[Dict]:
@@ -1063,8 +1109,16 @@ class ReplicatedBroker(Broker):
         # no follower will ever ack — the local-only fork is what manual
         # failover could never rule out
         self._check_fenced()
-        return self.inner.append(topic, partition, value, key=key,
-                                 timestamp=timestamp)
+        off = self.inner.append(topic, partition, value, key=key,
+                                timestamp=timestamp)
+        tc = propagate.inject()
+        if tc is not None:
+            # announce the active trace to every follower stream so the
+            # replication hop lands in the cluster-merged trace (G
+            # frames dedup consecutive repeats; see post_trace)
+            for r in self.replicators:
+                r.post_trace(tc)
+        return off
 
     def fetch(self, topic, partition, offset, max_records=256):
         return self.inner.fetch(topic, partition, offset, max_records)

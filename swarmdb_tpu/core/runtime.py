@@ -35,7 +35,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.base import Broker, Consumer, Producer, Record
-from ..obs import TRACER
+from ..obs import TRACER, propagate
+from ..obs.metrics import HIST_PUBLISH
 from ..utils.hashing import stable_partition
 from ..utils.metrics import MetricsRegistry
 from .messages import (
@@ -421,23 +422,30 @@ class SwarmDB:
         payload = json.dumps(msg.to_dict()).encode("utf-8")
         key = msg.id.encode("utf-8")
         t_pub = TRACER.span_begin()
+        t_pub_mono = time.monotonic()
         try:
-            if receiver_id is not None:
-                self.producer.produce(
-                    self.topic_name,
-                    payload,
-                    key=key,
-                    partition=self._get_partition(receiver_id),
-                    on_delivery=self._delivery_callback,
-                )
-            else:
-                num = self.broker.list_topics()[self.topic_name].num_partitions
-                for p in range(num):
+            # trace context for the publish hop (ISSUE 6): trace id =
+            # message id, the same join key every local span already
+            # carries as rid — a ClusterBroker/data-plane/replication
+            # broker below this call propagates it across processes
+            with propagate.use(propagate.TraceContext(msg.id)):
+                if receiver_id is not None:
                     self.producer.produce(
-                        self.topic_name, payload, key=key, partition=p,
+                        self.topic_name,
+                        payload,
+                        key=key,
+                        partition=self._get_partition(receiver_id),
                         on_delivery=self._delivery_callback,
                     )
-            self.producer.poll(0)
+                else:
+                    num = self.broker.list_topics()[
+                        self.topic_name].num_partitions
+                    for p in range(num):
+                        self.producer.produce(
+                            self.topic_name, payload, key=key, partition=p,
+                            on_delivery=self._delivery_callback,
+                        )
+                self.producer.poll(0)
             self._poller_wake.set()  # un-park the delivery-report poller
         except Exception as exc:
             # failure path (reference :507-517): FAILED + copy to error topic
@@ -459,6 +467,7 @@ class SwarmDB:
             raise
 
         TRACER.span_end(t_pub, "broker.publish", cat="broker", rid=msg.id)
+        HIST_PUBLISH.observe(time.monotonic() - t_pub_mono)
         self.metrics.counters["messages_sent"].inc()
         self.metrics.rates["messages_sent"].mark()
         self._maybe_autosave()

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..broker.base import (Broker, BrokerError, FencedError,
                            LeaderChangedError, Record, TopicMeta,
                            UnknownTopicError)
+from ..obs import TRACER, propagate
 from .cluster import ClusterMap
 
 logger = logging.getLogger("swarmdb_tpu.ha")
@@ -123,9 +124,19 @@ class ClusterBroker(Broker):
                     f"leader {leader} is not registered in the cluster map")
             old = self._inner
             self._inner = self._open(leader, info)
+            prev_leader = self._leader_id
             self._leader_id, self._leader_epoch = leader, epoch
             logger.info("cluster broker: re-pointed to leader %s "
                         "(epoch %d)", leader, epoch)
+            # the re-point is a trace event: carried under the active
+            # trace context (if a send is in flight) so a failover shows
+            # up INSIDE the affected request's merged timeline
+            ctx = propagate.current()
+            TRACER.instant(
+                "cluster.repoint", cat="ha",
+                rid=ctx.trace_id if ctx else None,
+                args={"leader": leader, "epoch": epoch,
+                      "previous": prev_leader})
             if old is not None and self._owns_inner:
                 try:
                     old.close()
@@ -163,6 +174,13 @@ class ClusterBroker(Broker):
         except (_TRANSIENT + (BrokerError,)) as exc:
             bound = self.leader()
             self._invalidate()
+            ctx = propagate.current()
+            TRACER.instant(
+                "cluster.failover", cat="ha",
+                rid=ctx.trace_id if ctx else None,
+                args={"op": what,
+                      "leader": bound[0] if bound else None,
+                      "error": type(exc).__name__})
             raise LeaderChangedError(
                 f"{what} failed: leader "
                 f"{bound[0] if bound else '?'} unreachable or deposed "

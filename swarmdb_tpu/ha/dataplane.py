@@ -13,11 +13,23 @@ clients too.
 
 Protocol (one TCP stream per client connection, many requests):
 length-prefixed JSON both ways — ``<u32 len><json>``. Request
-``{"op": name, "a": {kwargs}}``; response ``{"ok": result}`` or
-``{"err": ExceptionName, "msg": str}``. Bytes travel base64; records as
-``[partition-invariant dicts]``. Blocking ops (``wait_for_data`` /
-``wait_durable``) block server-side on the connection's thread; the
-client stretches its socket deadline by the op's own timeout.
+``{"op": name, "a": {kwargs}, "tc": {trace-context}?}``; response
+``{"ok": result}`` or ``{"err": ExceptionName, "msg": str}``. Bytes
+travel base64; records as ``[partition-invariant dicts]``. Blocking ops
+(``wait_for_data`` / ``wait_durable``) block server-side on the
+connection's thread; the client stretches its socket deadline by the
+op's own timeout.
+
+Tracing (ISSUE 6): the client injects the thread's current trace
+context (``obs/propagate.py``) into each envelope and records the op's
+round-trip into the ``dataplane_rtt_seconds`` histogram plus — when a
+trace is active — a ``dataplane.call`` client span. The server
+activates the received context around the dispatch and records a
+``dataplane.<op>`` span in ITS process's ring, so one message id joins
+client and node-side spans across processes. The reserved
+``trace_export`` op returns the node's own bounded Chrome-trace export
+(``GET /admin/cluster/trace`` fans out over it to merge the cluster's
+rings into one timeline).
 
 Failure mapping keeps :class:`~swarmdb_tpu.ha.client.ClusterBroker`'s
 contract intact: a dead/partitioned node surfaces as ``ConnectionError``
@@ -33,11 +45,14 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..broker.base import (Broker, BrokerError, FencedError,
                            LeaderChangedError, Record, TopicMeta,
                            UnknownTopicError)
+from ..obs import TRACER, propagate
+from ..obs.metrics import HIST_DATAPLANE_RTT
 
 logger = logging.getLogger("swarmdb_tpu.ha")
 
@@ -112,9 +127,14 @@ class DataPlaneServer:
 
     def __init__(self, get_broker: Callable[[], Broker],
                  host: str = "127.0.0.1", port: int = 0, *,
-                 gate: Optional[Callable[[], bool]] = None) -> None:
+                 gate: Optional[Callable[[], bool]] = None,
+                 node_id: Optional[str] = None) -> None:
         self._get_broker = get_broker
         self.gate = gate
+        # identity stamped onto trace_export responses so the cluster
+        # merge can label each ring's source even when several
+        # in-process nodes share one tracer
+        self.node_id = node_id or propagate.node_id()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -198,8 +218,7 @@ class DataPlaneServer:
                 if self.gate is not None and not self.gate():
                     return  # mid-stream partition
                 try:
-                    result = self._dispatch(req.get("op", ""),
-                                            req.get("a", {}))
+                    result = self._traced_dispatch(req)
                 except ConnectionError:
                     return  # node is dead: look exactly like one
                 except BrokerError as exc:
@@ -225,7 +244,35 @@ class DataPlaneServer:
             except OSError:
                 pass
 
+    def _traced_dispatch(self, req: Dict[str, Any]) -> Any:
+        """Activate the caller's trace context (if any) for the dispatch
+        and record the node-side span: the cross-process half of the one
+        trace a message produces (rid = the propagated trace id)."""
+        op = req.get("op", "")
+        a = req.get("a", {})
+        ctx = propagate.extract(req.get("tc"))
+        if ctx is None:
+            return self._dispatch(op, a)
+        t0 = TRACER.span_begin()
+        try:
+            with propagate.use(ctx.child()):
+                return self._dispatch(op, a)
+        finally:
+            TRACER.span_end(t0, f"dataplane.{op}", cat="dataplane",
+                            rid=ctx.trace_id,
+                            args={"origin": ctx.origin,
+                                  "node": self.node_id})
+
     def _dispatch(self, op: str, a: Dict[str, Any]) -> Any:
+        if op == "trace_export":
+            # observability op: serves THIS node's span ring (bounded),
+            # labeled with the node id — never touches the broker, so it
+            # works on fenced/deposed nodes too (a failover post-mortem
+            # needs exactly those rings)
+            trace = TRACER.to_chrome_trace(
+                last_n=a.get("last_n"), rid=a.get("trace_id"),
+                max_events=a.get("max_events"))
+            return {"node": self.node_id, "trace": trace}
         b = self._get_broker()
         if op == "append":
             return b.append(a["topic"], a["partition"], _unb64(a["value"]),
@@ -314,10 +361,18 @@ class RemoteBroker(Broker):
 
     def _call(self, op: str, extra_deadline_s: float = 0.0,
               **kwargs: Any) -> Any:
+        envelope: Dict[str, Any] = {"op": op, "a": kwargs}
+        # propagate the active trace across the process boundary: the
+        # node records dataplane.<op> under the same trace id
+        tc = propagate.inject()
+        if tc is not None:
+            envelope["tc"] = tc
+        t0 = time.monotonic()
+        t_span = TRACER.span_begin() if tc is not None else 0
         sock = self._checkout()
         try:
             sock.settimeout(self.timeout_s + extra_deadline_s)
-            _send_frame(sock, {"op": op, "a": kwargs})
+            _send_frame(sock, envelope)
             resp = _recv_frame(sock)
         except (OSError, ValueError) as exc:
             try:
@@ -334,6 +389,13 @@ class RemoteBroker(Broker):
             raise ConnectionError(
                 f"data-plane {op}: node {self.addr} closed the stream")
         self._checkin(sock)
+        if extra_deadline_s == 0.0:
+            # plain ops only: the blocking waits' RTT is dominated by
+            # their own server-side timeout, not the wire
+            HIST_DATAPLANE_RTT.observe(time.monotonic() - t0)
+        if t_span:
+            TRACER.span_end(t_span, "dataplane.call", cat="dataplane",
+                            rid=tc["t"], args={"op": op, "addr": self.addr})
         if "err" in resp:
             raise _WIRE_ERRORS.get(resp["err"], BrokerError)(resp.get("msg"))
         return resp.get("ok")
@@ -411,6 +473,16 @@ class RemoteBroker(Broker):
 
     def flush(self) -> None:
         self._call("flush")
+
+    # -- observability -------------------------------------------------------
+
+    def trace_export(self, last_n: Optional[int] = None,
+                     trace_id: Optional[str] = None,
+                     max_events: Optional[int] = None) -> Dict[str, Any]:
+        """The node's bounded Chrome-trace export + its node id (the
+        /admin/cluster/trace fan-out unit)."""
+        return self._call("trace_export", last_n=last_n,
+                          trace_id=trace_id, max_events=max_events)
 
     def close(self) -> None:
         with self._pool_lock:

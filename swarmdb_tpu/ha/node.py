@@ -106,6 +106,9 @@ class HANode:
                        else dead_s_default(self.suspect_s))
         self.promotion = promotion or _promotion_policy()
         self.flight = flight or FlightRecorder()
+        # dump-file identity (obs/flight dump_to): first owner wins on a
+        # shared harness recorder — per-node recorders get their own id
+        self.flight.meta.setdefault("node_id", node_id)
         self.log_dir = log_dir
 
         self._lock = threading.RLock()
@@ -182,7 +185,8 @@ class HANode:
             # (and get FencedError from a deposed leader) with no rebind
             self._data_plane = DataPlaneServer(
                 lambda: self.broker_facade, self._listen_host,
-                self._data_port, gate=self._gate).start()
+                self._data_port, gate=self._gate,
+                node_id=self.node_id).start()
             data_addr = f"{self._advertise_host}:{self._data_plane.port}"
         self.cluster.register(NodeInfo(
             node_id=self.node_id,
@@ -654,6 +658,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("--node-id, --log-dir and --cluster are required "
                  "(unless --probe)")
     logging.basicConfig(level=logging.INFO)
+    # this process IS the node: trace exports, flight-dump filenames and
+    # propagated trace contexts all carry its id (obs/propagate.node_id)
+    os.environ.setdefault("SWARMDB_NODE_ID", args.node_id)
 
     from .cluster import FileClusterMap
 

@@ -5,12 +5,23 @@ spans in processes that never touch a device, and so swarmlint's CI job
 can import the package without the ML stack.
 
 - :mod:`.tracer` — per-thread ring-buffer span tracer, Chrome
-  trace-event export (``GET /admin/trace/export``).
+  trace-event export (``GET /admin/trace/export``, bounded).
 - :mod:`.flight` — fixed-size rings of engine-step and request records,
   dumped on watchdog restart and via ``GET /admin/flight``.
+- :mod:`.propagate` — cluster-wide trace context (carried on the data
+  plane / cluster-client / replication wires) and the per-node trace
+  merge behind ``GET /admin/cluster/trace``.
+- :mod:`.metrics` — lock-free fixed-bucket latency histograms exported
+  in Prometheus histogram format from ``/metrics``.
+- :mod:`.analyze` — offline trace/flight analyzer
+  (``python -m swarmdb_tpu.obs.analyze``): per-completion cost
+  decomposition and A/B regression attribution.
 """
 
+from . import propagate
 from .flight import FlightRecorder
+from .metrics import HISTOGRAMS, Histogram, HistogramRegistry
 from .tracer import TRACER, SpanTracer
 
-__all__ = ["FlightRecorder", "SpanTracer", "TRACER"]
+__all__ = ["FlightRecorder", "SpanTracer", "TRACER", "propagate",
+           "HISTOGRAMS", "Histogram", "HistogramRegistry"]

@@ -1,0 +1,435 @@
+"""Offline trace/flight analyzer (ISSUE 6 tentpole, part 3).
+
+``bench_logs/`` has held the dpserve dp1/dp8 Chrome traces and flight
+dumps since PR 2 — deposited precisely to explain the 0.22x dp8
+regression (ROADMAP open item 1) — and nothing read them. This module
+is the reader: it ingests span/trace exports (Chrome trace-event JSON,
+as written by ``SpanTracer.to_chrome_trace`` / ``/admin/trace/export`` /
+``/admin/cluster/trace``) and flight-recorder dumps, and produces a
+machine-readable diagnosis::
+
+    python -m swarmdb_tpu.obs.analyze bench_logs/dpserve_dp1_trace.json \
+        bench_logs/dpserve_dp8_trace.json
+
+With TWO traces the report is a comparison (first = base, second =
+test): the per-completion engine cost is decomposed by span category
+(queue wait / prefill / decode / host sync), the regression is
+attributed across named contributors whose **shares sum to 1**, and the
+dominant one is called out with numbers. With one trace it reports that
+run's own cost decomposition. Flight dumps passed alongside contribute
+the ring-only signals: per-shard occupancy imbalance, padding waste,
+and host-syncs per step.
+
+What each contributor means:
+
+- ``admission_serialization`` — queue-wait (``engine.admit``) growth:
+  requests sit admitted-nowhere while the engine loop serializes
+  admission waves (the flight ring's queued-depth plateau).
+- ``prefill_compute`` — ``engine.prefill`` span growth: each admission
+  wave's prefill program costs more (sharded program overhead, padding
+  waste).
+- ``per_shard_imbalance`` — the decode-cost growth attributable to
+  uneven ``active_by_shard`` occupancy (idle shards ride along at the
+  slowest shard's pace); needs flight dumps, else 0.
+- ``host_sync`` — sanctioned host<->device sync time growth.
+- ``decode`` — residual decode-chunk cost growth not explained by
+  imbalance.
+
+``bench.py --analyze`` runs this after every serving mode and embeds
+the diagnosis in the mode's record, so open item 1's root-cause reading
+is a repeatable artifact instead of a one-off. ``--self-check`` runs
+the pipeline on synthetic traces and verifies its own invariants (the
+CI lint job runs it; stdlib-only, no jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["analyze_files", "summarize_trace", "summarize_flight",
+           "diagnose", "self_check", "main"]
+
+#: span name -> cost category (everything engine-side that serializes
+#: the loop; routing spans are microseconds and excluded by design)
+SPAN_CATEGORIES = {
+    "engine.admit": "queue_wait",
+    "engine.prefill": "prefill",
+    "engine.decode_chunk": "decode",
+    "engine.host_sync": "host_sync",
+}
+
+#: diagnosis contributors, reported in this order; shares sum to ~1
+CONTRIBUTORS = ("admission_serialization", "prefill_compute",
+                "per_shard_imbalance", "host_sync", "decode")
+
+_WAVE_GAP_US = 2000.0  # prefill starts closer than this = same wave
+
+
+# ------------------------------------------------------------------ loading
+
+
+def load_file(path: str) -> Tuple[str, Any]:
+    """('trace', events) for Chrome trace JSON, ('flight', dump) for a
+    flight-recorder dump; raises ValueError for anything else."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "traceEvents" in data:
+        return "trace", [e for e in data["traceEvents"]
+                         if e.get("ph") == "X"]
+    if isinstance(data, dict) and "steps" in data and "requests" in data:
+        return "flight", data
+    raise ValueError(f"{path}: neither a Chrome trace export "
+                     "(traceEvents) nor a flight dump (steps/requests)")
+
+
+# --------------------------------------------------------------- summaries
+
+
+def summarize_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-category cost decomposition of one trace export."""
+    completed = sum(1 for e in events if e.get("name") == "stage.done")
+    if completed == 0:
+        completed = len({(e.get("args") or {}).get("rid")
+                         for e in events
+                         if e.get("name") == "engine.decode_chunk"})
+    completed = max(1, completed)
+    cost_ms: Dict[str, float] = {c: 0.0 for c in SPAN_CATEGORIES.values()}
+    count: Dict[str, int] = {c: 0 for c in SPAN_CATEGORIES.values()}
+    prefill_starts: List[float] = []
+    t_lo, t_hi = float("inf"), float("-inf")
+    for e in events:
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        t_lo = min(t_lo, ts)
+        t_hi = max(t_hi, ts + dur)
+        cat = SPAN_CATEGORIES.get(e.get("name", ""))
+        if cat is None:
+            continue
+        cost_ms[cat] += dur / 1e3
+        count[cat] += 1
+        if e["name"] == "engine.prefill":
+            prefill_starts.append(ts)
+    # admission-wave detection: prefill spans that start within the gap
+    # threshold are one wave (every slot in a prefill batch records the
+    # same window) — many small waves with long queue waits between
+    # them is the serialization signature
+    prefill_starts.sort()
+    waves: List[int] = []
+    prev = float("-inf")
+    for ts in prefill_starts:
+        if not waves or ts - prev > _WAVE_GAP_US:
+            waves.append(1)
+        else:
+            waves[-1] += 1
+        prev = ts
+    out: Dict[str, Any] = {
+        "completed": completed,
+        "wall_s": round(max(0.0, (t_hi - t_lo)) / 1e6, 3)
+        if t_hi > t_lo else 0.0,
+        "per_completion_ms": {
+            c: round(cost_ms[c] / completed, 3) for c in cost_ms},
+        "span_counts": count,
+        "mean_ms": {c: round(cost_ms[c] / count[c], 3) if count[c] else 0.0
+                    for c in cost_ms},
+        "admission_waves": len(waves),
+        "mean_wave_size": round(sum(waves) / len(waves), 2) if waves
+        else 0.0,
+    }
+    return out
+
+
+def summarize_flight(dump: Dict[str, Any]) -> Dict[str, Any]:
+    """Ring-only signals a trace cannot carry: per-shard occupancy
+    imbalance, padding waste, host-syncs per step, and the request-ring
+    median timeline decomposition."""
+    steps = dump.get("steps") or []
+    reqs = dump.get("requests") or []
+    imbalances: List[float] = []
+    for step in steps:
+        shards = step.get("active_by_shard") or {}
+        vals = [int(v) for v in shards.values()]
+        if len(vals) >= 2 and sum(vals) > 0:
+            mean = sum(vals) / len(vals)
+            imbalances.append((max(vals) - min(vals)) / max(1.0, mean))
+    first, last = (steps[0], steps[-1]) if steps else ({}, {})
+
+    def delta(key: str) -> int:
+        return int(last.get(key, 0)) - int(first.get(key, 0))
+
+    prompt = delta("prompt_tokens")
+    padding = delta("prefill_padding_tokens")
+
+    def med(values: List[float]) -> float:
+        if not values:
+            return 0.0
+        values = sorted(values)
+        return values[len(values) // 2]
+
+    queue = [r["admitted_at"] - r["submitted_at"] for r in reqs
+             if r.get("admitted_at") and r.get("submitted_at")]
+    ttft = [r["first_token_at"] - r["submitted_at"] for r in reqs
+            if r.get("first_token_at") and r.get("submitted_at")]
+    return {
+        "steps": len(steps),
+        "requests": len(reqs),
+        "shard_imbalance": round(med(imbalances), 4) if imbalances else 0.0,
+        "shards": len((steps[0].get("active_by_shard") or {})) if steps
+        else 0,
+        "padding_ratio": round(padding / prompt, 4) if prompt > 0 else 0.0,
+        "host_syncs_per_step": round(
+            delta("host_syncs") / max(1, len(steps) - 1), 3),
+        "p50_queue_wait_s": round(med(queue), 4),
+        "p50_ttft_s": round(med(ttft), 4),
+        "meta": dump.get("meta", {}),
+    }
+
+
+# --------------------------------------------------------------- diagnosis
+
+
+def _attribute(base: Dict[str, Any], test: Dict[str, Any],
+               base_flight: Optional[Dict[str, Any]],
+               test_flight: Optional[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-completion cost growth (ms), attributed per contributor."""
+    b = base["per_completion_ms"]
+    t = test["per_completion_ms"]
+    decode_delta = max(0.0, t["decode"] - b["decode"])
+    # imbalance-attributable decode growth: idle shards pace at the
+    # slowest shard, so the imbalance index bounds the decode fraction
+    # it can explain
+    imb = (test_flight or {}).get("shard_imbalance", 0.0)
+    imbalance_ms = min(decode_delta, decode_delta * min(1.0, float(imb)))
+    return {
+        "admission_serialization": max(0.0, t["queue_wait"]
+                                       - b["queue_wait"]),
+        "prefill_compute": max(0.0, t["prefill"] - b["prefill"]),
+        "per_shard_imbalance": imbalance_ms,
+        "host_sync": max(0.0, t["host_sync"] - b["host_sync"]),
+        "decode": decode_delta - imbalance_ms,
+    }
+
+
+def diagnose(base: Dict[str, Any], test: Dict[str, Any],
+             base_flight: Optional[Dict[str, Any]] = None,
+             test_flight: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, Any]:
+    """Name the dominant contributor to test-vs-base slowdown, with
+    shares that sum to ~1."""
+    deltas = _attribute(base, test, base_flight, test_flight)
+    total = sum(deltas.values())
+    regressed = total > 0.0
+    if regressed:
+        shares = {c: deltas[c] / total for c in CONTRIBUTORS}
+    else:
+        # no regression: shares describe the TEST run's own cost mix so
+        # the report stays schema-stable (and still sums to 1)
+        t = test["per_completion_ms"]
+        mix = {
+            "admission_serialization": t["queue_wait"],
+            "prefill_compute": t["prefill"],
+            "per_shard_imbalance": 0.0,
+            "host_sync": t["host_sync"],
+            "decode": t["decode"],
+        }
+        mix_total = sum(mix.values()) or 1.0
+        shares = {c: mix[c] / mix_total for c in CONTRIBUTORS}
+    dominant = max(CONTRIBUTORS, key=lambda c: shares[c])
+    b_cost = sum(base["per_completion_ms"].values())
+    t_cost = sum(test["per_completion_ms"].values())
+    slowdown = round(t_cost / b_cost, 2) if b_cost > 0 else None
+    explanation = (
+        f"per-completion engine cost {b_cost:.0f}ms -> {t_cost:.0f}ms "
+        f"({slowdown}x); dominant contributor: {dominant} "
+        f"({shares[dominant]:.0%} of the growth). "
+        f"queue_wait {base['per_completion_ms']['queue_wait']:.0f}ms -> "
+        f"{test['per_completion_ms']['queue_wait']:.0f}ms, "
+        f"prefill mean {base['mean_ms']['prefill']:.1f}ms -> "
+        f"{test['mean_ms']['prefill']:.1f}ms over "
+        f"{test['admission_waves']} admission waves "
+        f"(mean {test['mean_wave_size']:.1f} requests/wave)."
+        if regressed else
+        f"no per-completion regression ({b_cost:.0f}ms -> {t_cost:.0f}ms); "
+        f"shares describe the test run's own cost mix.")
+    return {
+        "regressed": regressed,
+        "dominant": dominant,
+        "shares": {c: round(shares[c], 4) for c in CONTRIBUTORS},
+        "slowdown_x": slowdown,
+        "delta_per_completion_ms": {c: round(deltas[c], 2)
+                                    for c in CONTRIBUTORS},
+        "explanation": explanation,
+    }
+
+
+def _solo_diagnosis(summary: Dict[str, Any],
+                    flight: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """One-run report (bench --analyze embeds this): where did this
+    run's per-completion engine time go?"""
+    t = summary["per_completion_ms"]
+    imb = (flight or {}).get("shard_imbalance", 0.0)
+    imbalance_ms = t["decode"] * min(1.0, float(imb))
+    mix = {
+        "admission_serialization": t["queue_wait"],
+        "prefill_compute": t["prefill"],
+        "per_shard_imbalance": imbalance_ms,
+        "host_sync": t["host_sync"],
+        "decode": t["decode"] - imbalance_ms,
+    }
+    total = sum(mix.values()) or 1.0
+    shares = {c: round(mix[c] / total, 4) for c in CONTRIBUTORS}
+    dominant = max(CONTRIBUTORS, key=lambda c: shares[c])
+    return {
+        "regressed": None,
+        "dominant": dominant,
+        "shares": shares,
+        "slowdown_x": None,
+        "delta_per_completion_ms": None,
+        "explanation": (
+            f"per-completion engine cost {total:.0f}ms; largest share: "
+            f"{dominant} ({shares[dominant]:.0%})."),
+    }
+
+
+# ------------------------------------------------------------------ driver
+
+
+def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
+    """Analyze trace/flight files. Two traces -> comparison diagnosis
+    (first is the base); one trace -> solo cost decomposition. Flight
+    dumps pair with the traces in the order given."""
+    traces: List[Tuple[str, Dict[str, Any]]] = []
+    flights: List[Tuple[str, Dict[str, Any]]] = []
+    inputs = []
+    for path in paths:
+        kind, data = load_file(path)
+        inputs.append({"path": path, "kind": kind})
+        if kind == "trace":
+            traces.append((path, summarize_trace(data)))
+        else:
+            flights.append((path, summarize_flight(data)))
+    if not traces:
+        raise ValueError("need at least one Chrome trace export")
+    report: Dict[str, Any] = {
+        "kind": "swarmdb.obs.analyze",
+        "version": 1,
+        "inputs": inputs,
+    }
+    base_flight = flights[0][1] if flights else None
+    test_flight = flights[-1][1] if flights else None
+    if len(traces) >= 2:
+        base, test = traces[0][1], traces[1][1]
+        report["base"] = {"path": traces[0][0], **base,
+                          "flight": base_flight}
+        report["test"] = {"path": traces[1][0], **test,
+                          "flight": test_flight}
+        report["diagnosis"] = diagnose(base, test, base_flight,
+                                       test_flight)
+    else:
+        summary = traces[0][1]
+        report["summary"] = {"path": traces[0][0], **summary,
+                             "flight": test_flight}
+        report["diagnosis"] = _solo_diagnosis(summary, test_flight)
+    return report
+
+
+# --------------------------------------------------------------- self-check
+
+
+def _synthetic_trace(queue_ms: float, prefill_ms: float, decode_ms: float,
+                     n: int = 16) -> List[Dict[str, Any]]:
+    events = []
+    t = 0.0
+    for i in range(n):
+        rid = f"r{i}"
+        events.append({"name": "engine.admit", "ph": "X", "ts": t,
+                       "dur": queue_ms * 1e3, "args": {"rid": rid}})
+        t += queue_ms * 1e3
+        events.append({"name": "engine.prefill", "ph": "X", "ts": t,
+                       "dur": prefill_ms * 1e3, "args": {"rid": rid}})
+        t += prefill_ms * 1e3 + 2 * _WAVE_GAP_US
+        events.append({"name": "engine.decode_chunk", "ph": "X", "ts": t,
+                       "dur": decode_ms * 1e3, "args": {"rid": rid}})
+        events.append({"name": "engine.host_sync", "ph": "X", "ts": t,
+                       "dur": 100.0, "args": None})
+        t += decode_ms * 1e3
+        events.append({"name": "stage.done", "ph": "X", "ts": t,
+                       "dur": 0.0, "args": {"rid": rid}})
+    return events
+
+
+def self_check() -> Dict[str, Any]:
+    """Run the pipeline on synthetic data and verify its invariants;
+    raises AssertionError on any violation (the CI lint job runs this)."""
+    base = summarize_trace(_synthetic_trace(5.0, 10.0, 20.0))
+    test = summarize_trace(_synthetic_trace(400.0, 80.0, 25.0))
+    verdict = diagnose(base, test)
+    shares_sum = sum(verdict["shares"].values())
+    assert abs(shares_sum - 1.0) < 1e-3, shares_sum  # 4dp rounding
+    assert verdict["dominant"] == "admission_serialization", verdict
+    assert verdict["regressed"] is True
+    assert set(verdict["shares"]) == set(CONTRIBUTORS)
+    # flat A/B: schema-stable, still sums to 1
+    flat = diagnose(base, base)
+    assert flat["regressed"] is False
+    assert abs(sum(flat["shares"].values()) - 1.0) < 1e-3
+    # flight summary invariants on a synthetic imbalanced dump
+    fl = summarize_flight({
+        "steps": [
+            {"active_by_shard": {"0": 8, "1": 0}, "prompt_tokens": 0,
+             "prefill_padding_tokens": 0, "host_syncs": 0},
+            {"active_by_shard": {"0": 8, "1": 0}, "prompt_tokens": 100,
+             "prefill_padding_tokens": 25, "host_syncs": 2},
+        ],
+        "requests": [{"submitted_at": 0.0, "admitted_at": 0.5,
+                      "first_token_at": 0.7, "retired_at": 1.0}],
+    })
+    assert fl["shard_imbalance"] == 2.0
+    assert fl["padding_ratio"] == 0.25
+    json.dumps(verdict)  # the whole report must be JSON-serializable
+    return {"ok": True, "synthetic_diagnosis": verdict}
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m swarmdb_tpu.obs.analyze",
+        description="Offline analyzer for swarmdb trace exports and "
+                    "flight dumps: per-completion cost decomposition, "
+                    "A/B regression attribution (shares sum to 1), "
+                    "shard imbalance / padding / host-sync signals.")
+    ap.add_argument("paths", nargs="*",
+                    help="trace exports and/or flight dumps; with two "
+                         "traces the first is the base of the A/B")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the full report to PATH")
+    ap.add_argument("--self-check", action="store_true",
+                    help="run the pipeline on synthetic data and verify "
+                         "its invariants (CI)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        result = self_check()
+        print(json.dumps(result["synthetic_diagnosis"], indent=2))
+        print("analyze self-check: ok")
+        return 0
+    if not args.paths:
+        ap.error("no input files (or use --self-check)")
+    try:
+        report = analyze_files(args.paths)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"analyze: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

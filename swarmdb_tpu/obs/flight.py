@@ -35,9 +35,11 @@ unset = in-memory ``last_dump`` only).
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -52,6 +54,12 @@ def _env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+# process-monotonic dump sequence, shared by every recorder instance:
+# itertools.count's __next__ is a single C call, so concurrent dumpers
+# can never draw the same number
+_DUMP_SEQ = itertools.count(1)
 
 
 class _DictRing:
@@ -138,12 +146,30 @@ class FlightRecorder:
             "events": self.events(),
         }
 
+    def _dump_identity(self) -> str:
+        """Node identity for dump filenames: the recorder's own meta (set
+        by HANode / the engine builder), else the process's configured
+        node id, else the pid — never empty, filename-safe."""
+        raw = (str(self.meta.get("node_id") or "")
+               or os.environ.get("SWARMDB_NODE_ID")
+               or f"p{os.getpid()}")
+        return re.sub(r"[^A-Za-z0-9_.-]", "_", raw)
+
     def dump_to(self, directory: str, reason: str = "on_demand") -> str:
-        """Write a dump file under ``directory`` and return its path."""
+        """Write a dump file under ``directory`` and return its path.
+
+        The filename carries the node identity and a process-monotonic
+        sequence number, not just the millisecond stamp: two
+        near-simultaneous dumpers (watchdog restart racing an HA
+        promotion, two nodes sharing SWARMDB_FLIGHT_DIR) used to
+        collide on the same millisecond and silently overwrite each
+        other's post-mortem (ISSUE 6 satellite)."""
         os.makedirs(directory, exist_ok=True)
         payload = self.dump(reason)
         path = os.path.join(
-            directory, f"flight_{int(payload['dumped_at'] * 1000)}_{reason}.json")
+            directory,
+            f"flight_{int(payload['dumped_at'] * 1000)}_"
+            f"{self._dump_identity()}_{next(_DUMP_SEQ)}_{reason}.json")
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f, indent=1)

@@ -1,0 +1,199 @@
+"""Fixed-bucket latency histograms for /metrics (ISSUE 6 tentpole, part 2).
+
+``utils/metrics.py``'s ``LatencyHistogram`` is a locked sample reservoir:
+good for bench-window percentiles, wrong for a scraped endpoint — the
+/metrics export was all gauges and summaries over a 4096-sample ring, so
+p50/p99 over time were invisible outside bench runs. This module is the
+Prometheus-native counterpart: **fixed bucket boundaries, cumulative
+counts, no locks on the record path**.
+
+Record-path contract (these run inside the engine decode loop and the
+data-plane client):
+
+- ``observe()`` is allocation-free: a C-level ``bisect`` over a static
+  boundary tuple plus two integer adds into a preallocated list. No
+  dict lookup, no string formatting, no lock.
+- Increments are deliberately unguarded. CPython's GIL makes each
+  ``counts[i] += 1`` a read-modify-write that can lose a count under
+  contention — at worst one observation, never a crash or a torn
+  bucket, matching the tracer's benign-racy-read stance. Scrapes read
+  a snapshot copy.
+- Histograms are bound ONCE (module constants below, or attributes set
+  at engine init) and the bound object is what hot paths call.
+  swarmlint SWL503 polices the anti-pattern: a per-call registry/dict
+  lookup (``REGISTRY.get("x").observe(v)``, ``latencies["x"].observe``)
+  or a per-call ``Histogram(...)`` inside ``# swarmlint: hot`` code.
+
+Bucket boundaries are STABLE — dashboards and recording rules key on
+``le`` values, so changing a ladder is a breaking change. Two ladders:
+
+- ``LADDER_FAST`` (0.1 ms … 2.5 s): decode-chunk latency, data-plane
+  RTT — things that should live in single-digit milliseconds.
+- ``LADDER_WIDE`` (1 ms … 60 s): TTFT, queue wait, replication commit
+  wait — things that legitimately stretch under load.
+
+``SWARMDB_HISTOGRAMS=0`` disables recording (the bench echo A/B flips
+this together with the tracer to measure the combined overhead against
+the ≤5% budget).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Histogram", "HistogramRegistry", "HISTOGRAMS",
+           "LADDER_FAST", "LADDER_WIDE",
+           "HIST_TTFT", "HIST_DECODE_CHUNK", "HIST_QUEUE_WAIT",
+           "HIST_DATAPLANE_RTT", "HIST_REPLICATION_COMMIT"]
+
+#: seconds; upper bounds of each bucket (an implicit +Inf bucket follows)
+LADDER_FAST: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5)
+LADDER_WIDE: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram:
+    """One fixed-bucket histogram; single-object record path."""
+
+    __slots__ = ("name", "help", "boundaries", "counts", "total", "sum_s",
+                 "enabled")
+
+    def __init__(self, name: str, boundaries: Tuple[float, ...],
+                 help_text: str = "") -> None:
+        self.name = name
+        self.help = help_text
+        self.boundaries = tuple(float(b) for b in boundaries)
+        if list(self.boundaries) != sorted(set(self.boundaries)):
+            raise ValueError(f"histogram {name}: boundaries must be "
+                             "strictly increasing")
+        # per-bucket (non-cumulative) counts + the +Inf bucket at [-1]
+        self.counts: List[int] = [0] * (len(self.boundaries) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+        self.enabled = True
+
+    def observe(self, seconds: float) -> None:
+        """Record one latency (hot path: no locks, no allocation beyond
+        CPython's arithmetic; a lost count under a write race is the
+        accepted failure mode)."""
+        if not self.enabled:
+            return
+        self.counts[bisect_left(self.boundaries, seconds)] += 1
+        self.total += 1
+        self.sum_s += seconds
+
+    # -------------------------------------------------------------- reading
+
+    def snapshot(self) -> Dict[str, object]:
+        counts = list(self.counts)  # one-shot copy; benign race
+        return {
+            "name": self.name,
+            "boundaries": list(self.boundaries),
+            "counts": counts,
+            "count": sum(counts),
+            "sum_s": self.sum_s,
+        }
+
+    def render_prometheus(self, prefix: str = "swarmdb_") -> List[str]:
+        """Prometheus text-exposition histogram block (cumulative
+        ``_bucket{le=...}`` counts + ``_sum`` + ``_count``)."""
+        n = f"{prefix}{self.name}"
+        lines = [f"# TYPE {n} histogram"]
+        snap = self.snapshot()
+        cum = 0
+        for bound, c in zip(self.boundaries, snap["counts"]):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{bound:g}"}} {cum}')
+        cum += snap["counts"][-1]
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{n}_sum {snap['sum_s']:.6f}")
+        lines.append(f"{n}_count {cum}")
+        return lines
+
+    def reset(self) -> None:
+        self.counts = [0] * (len(self.boundaries) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+
+
+class HistogramRegistry:
+    """Named histograms, registered once at import/init time (the
+    registration lock never sits on a record path — hot paths hold the
+    returned Histogram object)."""
+
+    def __init__(self, enabled: Optional[bool] = None) -> None:
+        if enabled is None:
+            enabled = os.environ.get("SWARMDB_HISTOGRAMS", "1") != "0"
+        self._lock = threading.Lock()
+        # swarmlint: guarded-by[self._lock]: _hists
+        self._hists: Dict[str, Histogram] = {}
+        self.enabled = bool(enabled)
+
+    def register(self, name: str, boundaries: Tuple[float, ...],
+                 help_text: str = "") -> Histogram:
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = Histogram(name, boundaries, help_text)
+                hist.enabled = self.enabled
+                self._hists[name] = hist
+            return hist
+
+    def get(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
+    def all(self) -> List[Histogram]:
+        with self._lock:
+            return list(self._hists.values())
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Flip recording everywhere (bench echo A/B; mirrors
+        ``SpanTracer.set_enabled``)."""
+        self.enabled = bool(enabled)
+        for hist in self.all():
+            hist.enabled = self.enabled
+
+    def render_prometheus(self, prefix: str = "swarmdb_") -> List[str]:
+        lines: List[str] = []
+        for hist in sorted(self.all(), key=lambda h: h.name):
+            lines.extend(hist.render_prometheus(prefix))
+        return lines
+
+    def reset(self) -> None:
+        for hist in self.all():
+            hist.reset()
+
+
+#: process-global registry, exported at /metrics next to the counters
+HISTOGRAMS = HistogramRegistry()
+
+# The serving-path histograms (README "Observability" documents the
+# ladders; tests pin them — treat boundary changes as breaking):
+HIST_TTFT = HISTOGRAMS.register(
+    "ttft_seconds", LADDER_WIDE,
+    "submit -> first emitted token, per engine request")
+HIST_QUEUE_WAIT = HISTOGRAMS.register(
+    "queue_wait_seconds", LADDER_WIDE,
+    "submit -> admission into a decode slot")
+HIST_DECODE_CHUNK = HISTOGRAMS.register(
+    "decode_chunk_seconds", LADDER_FAST,
+    "decode-chunk dispatch -> host-processed")
+HIST_DATAPLANE_RTT = HISTOGRAMS.register(
+    "dataplane_rtt_seconds", LADDER_FAST,
+    "data-plane client op round-trip (excludes server-side blocking "
+    "wait ops)")
+HIST_REPLICATION_COMMIT = HISTOGRAMS.register(
+    "replication_commit_seconds", LADDER_WIDE,
+    "append -> acks=all durable watermark passed it (replication lag "
+    "as writers experience it)")
+HIST_PUBLISH = HISTOGRAMS.register(
+    "broker_publish_seconds", LADDER_FAST,
+    "runtime send -> broker accepted the produce (the echo-mode record "
+    "path, so the bench A/B overhead budget covers histogram recording)")

@@ -1,0 +1,180 @@
+"""Cluster-wide trace-context propagation (ISSUE 6 tentpole, part 1).
+
+The PR 2 tracer is strictly process-local: a request that enters through
+``ha/client.py``, crosses the TCP data plane, and is served by a leader
+node produces disconnected span fragments in N process-local rings that
+nothing can stitch back together. This module defines the compact trace
+context that rides the wire so ONE agent message yields ONE trace:
+
+- ``TraceContext(trace_id, span_id, origin)`` — the trace id is the
+  join key (for messages it is the message id, so the propagated
+  context lines up with every rid-tagged span the layers already
+  record); ``origin`` names the node/process that started the trace.
+- a **thread-local current context** (``use()`` / ``current()``): the
+  runtime activates it around a send, and every wire client below it
+  (data-plane calls, ClusterBroker retries, replication appends) injects
+  it without threading an argument through the Broker ABC.
+- ``inject()`` / ``extract()`` — the wire form is a 3-key dict
+  ``{"t": trace_id, "s": span_id, "o": origin}`` small enough to ride
+  every data-plane envelope and an occasional replication ``G`` frame.
+- ``merge_chrome_traces()`` — stitches per-node Chrome-trace exports
+  into one Perfetto-loadable document by re-anchoring each export's
+  monotonic timestamps onto a shared wall-clock origin (every export
+  carries its ``anchor_epoch_s``). ``GET /admin/cluster/trace`` fans
+  out to the cluster map's nodes and returns this merge.
+
+Stdlib-only, like the rest of ``swarmdb_tpu/obs``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["TraceContext", "current", "use", "inject", "extract",
+           "node_id", "merge_chrome_traces"]
+
+_local = threading.local()
+_span_seq = itertools.count(1)  # C-level next(): thread-safe enough
+
+
+def node_id() -> str:
+    """This process's identity in exported traces: the HA node id when
+    the process runs one (``SWARMDB_NODE_ID``, set by HANode/CLI), else
+    a pid-derived fallback that is still stable for the process life."""
+    return os.environ.get("SWARMDB_NODE_ID") or f"pid-{os.getpid()}"
+
+
+class TraceContext:
+    """One hop's view of a distributed trace (immutable once built)."""
+
+    __slots__ = ("trace_id", "span_id", "origin")
+
+    def __init__(self, trace_id: str, span_id: Optional[str] = None,
+                 origin: Optional[str] = None) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id or f"{os.getpid():x}.{next(_span_seq):x}"
+        self.origin = origin or node_id()
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id, THIS process as the hop origin —
+        what a server activates for work done on behalf of a caller."""
+        return TraceContext(self.trace_id, origin=node_id())
+
+    def __repr__(self) -> str:  # debugging / log lines only
+        return (f"TraceContext({self.trace_id!r}, span={self.span_id!r}, "
+                f"origin={self.origin!r})")
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_local, "ctx", None)
+
+
+@contextmanager
+def use(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    """Activate ``ctx`` for the calling thread (None = no-op passthrough,
+    so call sites need no branching)."""
+    if ctx is None:
+        yield None
+        return
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _local.ctx = prev
+
+
+def inject(ctx: Optional[TraceContext] = None) -> Optional[Dict[str, str]]:
+    """Wire form of ``ctx`` (or the current context); None when there is
+    nothing to propagate — callers simply omit the envelope key."""
+    ctx = ctx if ctx is not None else current()
+    if ctx is None:
+        return None
+    return {"t": ctx.trace_id, "s": ctx.span_id, "o": ctx.origin}
+
+
+def extract(wire: Any) -> Optional[TraceContext]:
+    """Parse a wire dict back into a context; tolerant of anything (a
+    malformed envelope must never kill a data-plane connection)."""
+    if not isinstance(wire, dict):
+        return None
+    trace_id = wire.get("t")
+    if not isinstance(trace_id, str) or not trace_id:
+        return None
+    span_id = wire.get("s")
+    origin = wire.get("o")
+    return TraceContext(trace_id,
+                        span_id=span_id if isinstance(span_id, str) else None,
+                        origin=origin if isinstance(origin, str) else None)
+
+
+# ---------------------------------------------------------------- merging
+
+
+def _anchor_of(trace: Dict[str, Any]) -> float:
+    try:
+        return float(trace.get("metadata", {}).get("anchor_epoch_s", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def merge_chrome_traces(
+        sources: List[Tuple[str, Dict[str, Any]]]) -> Dict[str, Any]:
+    """Merge per-node Chrome-trace exports into one document.
+
+    ``sources`` is ``[(node_label, chrome_trace_dict), ...]``. Each
+    export's ``ts`` values are microseconds relative to that process's
+    own monotonic anchor; its ``metadata.anchor_epoch_s`` maps them to
+    wall time. The merge re-bases every event onto the EARLIEST anchor
+    so one Perfetto timeline shows true cross-node ordering (modulo
+    host clock skew — wall clocks are the only shared reference).
+
+    In-process clusters share one tracer, so the same event can arrive
+    from several "nodes": events are deduplicated on their full
+    identity (pid, tid, ts, name, dur).
+    """
+    anchors = [a for a in (_anchor_of(t) for _, t in sources) if a > 0]
+    base = min(anchors) if anchors else 0.0
+    events: List[Dict[str, Any]] = []
+    seen = set()
+    nodes: List[str] = []
+    for label, trace in sources:
+        nodes.append(label)
+        shift_us = (_anchor_of(trace) - base) * 1e6 if base else 0.0
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                # metadata rows (process/thread names) need no shift and
+                # must keep one copy per (pid, tid)
+                key = ("M", ev.get("name"), ev.get("pid"), ev.get("tid"),
+                       str(ev.get("args")))
+                if key in seen:
+                    continue
+                seen.add(key)
+                out = dict(ev)
+                if ev.get("name") == "process_name":
+                    out = dict(ev)
+                    out["args"] = {"name": f"swarmdb_tpu:{label}"}
+                events.append(out)
+                continue
+            key = (ev.get("pid"), ev.get("tid"), ev.get("ts"),
+                   ev.get("name"), ev.get("dur"))
+            if key in seen:
+                continue
+            seen.add(key)
+            out = dict(ev)
+            out["ts"] = float(ev.get("ts", 0.0)) + shift_us
+            events.append(out)
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "anchor_epoch_s": base,
+            "clock": "monotonic_ns re-anchored to the earliest node anchor",
+            "nodes": nodes,
+        },
+    }

@@ -120,6 +120,13 @@ class SpanTracer:
 
     # ------------------------------------------------------------ recording
 
+    #: dead-thread rings retained (newest first) — a short-lived thread's
+    #: events (an HA promotion thread's "ha.promoted" instant, a one-shot
+    #: chaos injector) must survive into the next export, or a failover
+    #: trace loses exactly the instants it exists to show. The cap still
+    #: bounds the registry under thread churn.
+    _MAX_DEAD_RINGS = 32
+
     def _ring(self) -> _Ring:
         ring = getattr(self._local, "ring", None)
         if ring is None:
@@ -127,15 +134,19 @@ class SpanTracer:
             ring = _Ring(self.capacity, t.ident or 0, t.name)
             self._local.ring = ring
             with self._reg_lock:
-                # prune rings whose owner thread is gone (bounds the
-                # registry under thread churn; their events are dropped,
-                # which matches the ring's own overwrite semantics)
-                alive = []
+                # bound the registry under thread churn WITHOUT dropping
+                # recently dead threads' events: live rings always stay,
+                # dead rings are kept newest-first up to the cap
+                alive, dead = [], []
                 for r, wr in self._rings:
                     owner = wr()
                     if owner is not None and owner.is_alive():
                         alive.append((r, wr))
-                self._rings = alive
+                    else:
+                        dead.append((r, wr))
+                if len(dead) > self._MAX_DEAD_RINGS:
+                    dead = dead[-self._MAX_DEAD_RINGS:]
+                self._rings = alive + dead
                 self._rings.append((ring, weakref.ref(t)))
         return ring
 
@@ -211,41 +222,78 @@ class SpanTracer:
         """One request's timeline (spans recorded with this rid)."""
         return [e for e in self.snapshot() if e["rid"] == rid]
 
-    def to_chrome_trace(self) -> Dict[str, Any]:
+    def to_chrome_trace(self, last_n: Optional[int] = None,
+                        rid: Optional[str] = None,
+                        max_events: Optional[int] = None) -> Dict[str, Any]:
         """Chrome trace-event JSON (Perfetto / chrome://tracing loadable):
         complete ("ph": "X") events, microsecond timestamps relative to
-        the tracer's clock anchor, one named track per source thread."""
+        the tracer's clock anchor, one named track per source thread.
+
+        The export is BOUNDED (ISSUE 6 satellite): a long-lived node's
+        rings can hold ``threads x SWARMDB_TRACE_RING`` events, and an
+        unbounded ``/admin/trace/export`` response body took the API
+        worker down with it. ``rid`` keeps only one trace's events
+        (plus ``cat="ha"`` instants — promotions/fencing belong in
+        every failover trace regardless of which request they cut
+        across); ``last_n`` keeps the newest N span events; both are
+        further capped at ``max_events`` (default
+        ``SWARMDB_TRACE_EXPORT_MAX``, 50000). Truncation is by age —
+        oldest dropped first — and is declared in the metadata."""
+        if max_events is None:
+            try:
+                max_events = int(os.environ.get(
+                    "SWARMDB_TRACE_EXPORT_MAX", "50000"))
+            except ValueError:
+                max_events = 50000
         pid = os.getpid()
         with self._reg_lock:
             rings = [r for r, _ in self._rings]
-        events: List[Dict[str, Any]] = [{
-            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-            "args": {"name": "swarmdb_tpu"},
-        }]
+        spans: List[Dict[str, Any]] = []
+        tracks: List[Dict[str, Any]] = []
         for ring in rings:
-            events.append({
+            tracks.append({
                 "name": "thread_name", "ph": "M", "pid": pid,
                 "tid": ring.tid, "args": {"name": ring.name},
             })
-            for name, cat, rid, t0, t1, args in ring.snapshot():
+            for name, cat, ev_rid, t0, t1, args in ring.snapshot():
+                if rid is not None and ev_rid != rid and cat != "ha":
+                    continue
                 ev: Dict[str, Any] = {
                     "name": name, "cat": cat, "ph": "X", "pid": pid,
                     "tid": ring.tid,
                     "ts": (t0 - self._anchor_mono_ns) / 1e3,
                     "dur": max(0.0, (t1 - t0) / 1e3),
                 }
-                if rid is not None or args:
+                if ev_rid is not None or args:
                     a: Dict[str, Any] = dict(args or {})
-                    if rid is not None:
-                        a["rid"] = rid
+                    if ev_rid is not None:
+                        a["rid"] = ev_rid
                     ev["args"] = a
-                events.append(ev)
+                spans.append(ev)
+        spans.sort(key=lambda e: e["ts"])
+        total = len(spans)
+        keep = total
+        if last_n is not None:
+            keep = min(keep, max(0, int(last_n)))
+        if max_events and max_events > 0:
+            keep = min(keep, max_events)
+        if keep < total:
+            spans = spans[total - keep:]
+        events: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "swarmdb_tpu"},
+        }]
+        events.extend(tracks)
+        events.extend(spans)
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "metadata": {
                 "anchor_epoch_s": self._anchor_epoch,
                 "clock": "monotonic_ns relative to anchor",
+                "span_events": len(spans),
+                "total_span_events": total,
+                "truncated": keep < total,
             },
         }
 

@@ -1,0 +1,49 @@
+"""Seeded histogram-discipline violations (SWL503) — lint fixture.
+
+Not imported by anything; analyzed as text by tests/test_swarmlint.py.
+The rule: inside ``# swarmlint: hot`` code a histogram must be a
+pre-bound object — never constructed per call, never reached through a
+per-observation registry/dict lookup (``utils/metrics.py``'s latencies
+registry is a defaultdict, so a hot-path miss ALLOCATES).
+"""
+
+from swarmdb_tpu.obs.metrics import HISTOGRAMS, Histogram
+
+BOUND = HISTOGRAMS.register("fixture_seconds", (0.1, 1.0))
+
+
+# swarmlint: hot
+def hot_constructs_per_call(v):
+    h = Histogram("per_call_seconds", (0.1, 1.0))  # EXPECT: SWL503
+    h.observe(v)
+
+
+# swarmlint: hot
+def hot_registry_lookup_per_call(v):
+    HISTOGRAMS.get("fixture_seconds").observe(v)  # EXPECT: SWL503
+
+
+# swarmlint: hot
+def hot_dict_lookup_per_call(metrics, v):
+    metrics.latencies["first_token_s"].observe(v)  # EXPECT: SWL503
+
+
+# swarmlint: hot
+def hot_bound_ok(v):
+    # the sanctioned form: module/init-bound object, one observe call
+    BOUND.observe(v)
+
+
+def warm_lookup_ok(metrics, v):
+    # warm paths may look histograms up per call — only hot code is held
+    # to the bound-object discipline
+    metrics.latencies["send_to_done_s"].observe(v)
+
+
+class Engine:
+    def __init__(self, metrics):
+        self._lat = metrics.latencies["queue_wait_s"]
+
+    # swarmlint: hot
+    def hot_bound_attr_ok(self, v):
+        self._lat.observe(v)

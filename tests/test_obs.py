@@ -5,6 +5,7 @@ replication-lag /metrics gauges (ISSUE 2)."""
 import asyncio
 import json
 import tempfile
+import threading
 import time
 
 import pytest
@@ -88,6 +89,109 @@ def test_tracer_span_context_manager_and_reset():
     assert t.snapshot() == []
 
 
+def test_trace_export_is_bounded_and_filterable():
+    """ISSUE 6 satellite: /admin/trace/export must never return an
+    unbounded body — last_n / trace_id filters plus a hard event cap,
+    truncation declared in metadata, oldest dropped first."""
+    t = SpanTracer(capacity_per_thread=256, enabled=True)
+    for i in range(100):
+        t.span_end(t.span_begin(), f"s{i}", rid=f"r{i % 4}")
+    t.instant("ha.promoted", cat="ha")  # HA instants ride every filter
+
+    def span_events(trace):
+        return [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+
+    full = t.to_chrome_trace()
+    assert len(span_events(full)) == 101
+    assert full["metadata"]["truncated"] is False
+
+    last = t.to_chrome_trace(last_n=10)
+    evs = span_events(last)
+    assert len(evs) == 10
+    assert evs[-1]["name"] == "ha.promoted"  # newest kept
+    assert last["metadata"]["truncated"] is True
+    assert last["metadata"]["total_span_events"] == 101
+
+    capped = t.to_chrome_trace(max_events=7)
+    assert len(span_events(capped)) == 7
+    assert capped["metadata"]["truncated"] is True
+
+    one = t.to_chrome_trace(rid="r2")
+    names = {e["name"] for e in span_events(one)}
+    assert names == {f"s{i}" for i in range(100) if i % 4 == 2} | {
+        "ha.promoted"}
+    for e in span_events(one):
+        assert (e.get("args", {}).get("rid") == "r2"
+                or e.get("cat") == "ha")
+
+
+def test_tracer_ring_wrap_under_concurrent_export():
+    """ISSUE 6 satellite: N threads emitting spans past ring capacity
+    while exports run concurrently must always yield a parseable export
+    with no torn spans (the lock-free claim, exercised)."""
+    t = SpanTracer(capacity_per_thread=64, enabled=True)
+    stop = threading.Event()
+    errors = []
+
+    def writer(n):
+        i = 0
+        while not stop.is_set():
+            t0 = t.span_begin()
+            t.span_end(t0, f"w{n}.{i % 200}", rid=f"r{i % 8}",
+                       args={"i": i})
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(n,), daemon=True)
+               for n in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        deadline = time.time() + 2.0
+        exports = 0
+        while time.time() < deadline:
+            trace = t.to_chrome_trace()
+            payload = json.dumps(trace)  # parseable
+            parsed = json.loads(payload)
+            for e in parsed["traceEvents"]:
+                if e.get("ph") != "X":
+                    continue
+                # no torn spans: every exported event is well-formed
+                if not isinstance(e["name"], str) or e["dur"] < 0:
+                    errors.append(e)
+            exports += 1
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=5.0)
+    assert exports > 0
+    assert errors == []
+    # every live writer thread's ring is bounded at capacity
+    final = [e for e in t.to_chrome_trace()["traceEvents"]
+             if e.get("ph") == "X"]
+    assert len(final) <= 4 * 64 + 64  # writers + this thread's slack
+
+
+def test_tracer_retains_dead_thread_rings():
+    """A short-lived thread's events (an HA promotion thread's instant)
+    must survive thread churn into later exports."""
+    t = SpanTracer(capacity_per_thread=32, enabled=True)
+
+    def promote():
+        t.instant("ha.promoted", cat="ha", args={"epoch": 2})
+
+    th = threading.Thread(target=promote)
+    th.start()
+    th.join()
+    # churn: many short-lived threads register fresh rings afterwards
+    for i in range(8):
+        th = threading.Thread(
+            target=lambda: t.span_end(t.span_begin(), "churn"))
+        th.start()
+        th.join()
+    names = [e["name"] for e in t.snapshot()]
+    assert "ha.promoted" in names
+
+
 def test_runtime_spans_cover_send_and_receive(tmp_path):
     TRACER.reset()
     db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "h"))
@@ -108,8 +212,10 @@ def test_tracer_overhead_smoke(tmp_path):
     pure-routing echo loop. The bound is deliberately loose (CI boxes are
     noisy); bench.py records the tight alternating-segment number, this
     test catches catastrophic regressions (an accidental lock or O(n)
-    walk on the record path)."""
+    walk on the record path). Histograms toggle with the tracer since
+    ISSUE 6 — the budget covers the combined observability cost."""
     import bench
+    from swarmdb_tpu.obs import HISTOGRAMS
 
     db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "h"),
                  autosave_interval=1e9)
@@ -118,11 +224,14 @@ def test_tracer_overhead_smoke(tmp_path):
         on = off = 0.0
         for _ in range(2):
             TRACER.set_enabled(True)
+            HISTOGRAMS.set_enabled(True)
             on += bench._echo_loop(db, 1.0)
             TRACER.set_enabled(False)
+            HISTOGRAMS.set_enabled(False)
             off += bench._echo_loop(db, 1.0)
     finally:
         TRACER.set_enabled(was)
+        HISTOGRAMS.set_enabled(True)
         db.close()
     assert on > 0 and off > 0
     overhead = max(0.0, (off - on) / off)
@@ -150,6 +259,38 @@ def test_flight_recorder_rings_and_dump(tmp_path):
     # auto_dump never raises, even on an unwritable directory
     assert fr.auto_dump("boom", "/proc/definitely/not/writable") is None
     assert fr.last_dump["reason"] == "boom"
+
+
+def test_flight_concurrent_dumps_both_land(tmp_path):
+    """ISSUE 6 satellite: dumps used to be named by millisecond stamp
+    alone, so two near-simultaneous dumpers (watchdog restart + HA
+    promotion) could overwrite each other. Node id + a monotonic
+    sequence in the filename make every dump land."""
+    a = FlightRecorder(n_steps=8)
+    a.meta["node_id"] = "node-a"
+    b = FlightRecorder(n_steps=8)
+    b.meta["node_id"] = "node-a"  # same identity, same instant: worst case
+    a.record_step({"i": 1})
+    b.record_step({"i": 2})
+    barrier = threading.Barrier(2)
+    paths = [None, None]
+
+    def dump(idx, fr):
+        barrier.wait()
+        paths[idx] = fr.dump_to(str(tmp_path), reason="race")
+
+    threads = [threading.Thread(target=dump, args=(0, a)),
+               threading.Thread(target=dump, args=(1, b))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert all(paths) and paths[0] != paths[1]
+    dumps = sorted(tmp_path.glob("flight_*_race.json"))
+    assert len(dumps) == 2, [p.name for p in dumps]
+    for p in dumps:
+        assert "node-a" in p.name
+        assert json.loads(p.read_text())["reason"] == "race"
 
 
 # ------------------------------------------------- end-to-end acceptance
@@ -319,5 +460,79 @@ def test_metrics_without_replication_has_no_replica_gauges(tmp_path):
         r = await client.get("/metrics")
         assert r.status == 200
         assert "swarmdb_replica_" not in await r.text()
+
+    api_drive(drive, tmp_path)
+
+
+# ---------------------------------------------------- latency histograms
+
+
+# the ladders are the wire contract — recording rules key on `le` values
+EXPECTED_HISTOGRAMS = {
+    "swarmdb_ttft_seconds": "0.001",
+    "swarmdb_queue_wait_seconds": "0.001",
+    "swarmdb_decode_chunk_seconds": "0.0001",
+    "swarmdb_dataplane_rtt_seconds": "0.0001",
+    "swarmdb_replication_commit_seconds": "0.001",
+    "swarmdb_broker_publish_seconds": "0.0001",
+}
+
+
+def test_histogram_observe_and_prometheus_rendering():
+    from swarmdb_tpu.obs.metrics import Histogram
+
+    h = Histogram("unit_seconds", (0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    lines = h.render_prometheus()
+    assert lines[0] == "# TYPE swarmdb_unit_seconds histogram"
+    assert 'swarmdb_unit_seconds_bucket{le="0.01"} 1' in lines
+    assert 'swarmdb_unit_seconds_bucket{le="0.1"} 3' in lines  # cumulative
+    assert 'swarmdb_unit_seconds_bucket{le="1"} 4' in lines
+    assert 'swarmdb_unit_seconds_bucket{le="+Inf"} 5' in lines
+    assert "swarmdb_unit_seconds_count 5" in lines
+    # boundary membership: an observation exactly on a bound lands in
+    # that bound's bucket (Prometheus `le` semantics)
+    h2 = Histogram("edge_seconds", (0.1, 1.0))
+    h2.observe(0.1)
+    assert h2.counts[0] == 1
+    # disabled recording is a no-op
+    h2.enabled = False
+    h2.observe(0.2)
+    assert sum(h2.counts) == 1
+
+
+def test_metrics_exports_histograms(tmp_path):
+    """ISSUE 6 acceptance: /metrics exposes >= 4 Prometheus histograms
+    with stable bucket boundaries, and a recorded observation shows up
+    in the cumulative buckets."""
+    from swarmdb_tpu.obs.metrics import HIST_TTFT
+
+    HIST_TTFT.observe(0.021)
+
+    async def drive(client, db):
+        # the echo path itself feeds broker_publish_seconds
+        db.send_message("a", "b", "hello")
+        r = await client.get("/metrics")
+        assert r.status == 200
+        text = await r.text()
+        histogram_families = {
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# TYPE") and line.endswith("histogram")}
+        assert len(histogram_families) >= 4, histogram_families
+        for family, first_bucket in EXPECTED_HISTOGRAMS.items():
+            assert family in histogram_families, family
+            assert f'{family}_bucket{{le="{first_bucket}"}}' in text, family
+            assert f'{family}_bucket{{le="+Inf"}}' in text
+            assert f"{family}_count" in text
+        # the TTFT observation above landed at le=0.025 and is cumulative
+        ttft_lines = [l for l in text.splitlines()
+                      if l.startswith("swarmdb_ttft_seconds_bucket")]
+        inf = int(ttft_lines[-1].rsplit(" ", 1)[1])
+        assert inf >= 1
+        # the publish histogram observed this request's send
+        pub = [l for l in text.splitlines()
+               if l.startswith("swarmdb_broker_publish_seconds_count")]
+        assert pub and int(pub[0].rsplit(" ", 1)[1]) >= 1
 
     api_drive(drive, tmp_path)
