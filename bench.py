@@ -169,15 +169,19 @@ def bench_echo(seconds: float) -> dict:
         "vs_baseline": round(value / TARGET_MSGS_PER_SEC, 4),
         "mode": "echo",
     }
-    # tracer+histogram overhead A/B (acceptance: <= 5% msgs/sec,
-    # recorded here). Alternating on/off segments over ONE shared db:
-    # back-to-back whole runs drift by more than the effect being
-    # measured (observed ±5% between identical runs), while interleaving
-    # cancels warm-up and allocator drift. The engine modes amortize the
-    # same ring writes over far more work per message, so echo is the
-    # worst case. Since ISSUE 6 the "on" segments also record the
-    # fixed-bucket /metrics histograms (HIST_PUBLISH sits on this exact
-    # path), so tracer_overhead_pct is the combined observability cost.
+    # tracer+histogram+sentinel+exemplar overhead A/B (acceptance:
+    # <= 5% msgs/sec, recorded here). Alternating on/off segments over
+    # ONE shared db: back-to-back whole runs drift by more than the
+    # effect being measured (observed ±5% between identical runs), while
+    # interleaving cancels warm-up and allocator drift. The engine modes
+    # amortize the same ring writes over far more work per message, so
+    # echo is the worst case. Since ISSUE 6 the "on" segments also
+    # record the fixed-bucket /metrics histograms (HIST_PUBLISH sits on
+    # this exact path); since ISSUE 7 they additionally retain bucket
+    # exemplars (HIST_PUBLISH gets the message id per send) and run the
+    # SLO sentinel with a short window so several window closes land
+    # inside each segment — tracer_overhead_pct is the combined
+    # observability cost of all four.
     try:
         from swarmdb_tpu.obs import HISTOGRAMS, TRACER
 
@@ -189,17 +193,26 @@ def bench_echo(seconds: float) -> dict:
                 with tempfile.TemporaryDirectory() as tmp:
                     db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
                                  autosave_interval=1e9)
+                    # several sentinel windows per segment, so the tick
+                    # AND the close path are inside the measurement
+                    db.sentinel.config.window_s = max(0.25, seg / 4)
                     for _ in range(2):
                         TRACER.set_enabled(True)
                         HISTOGRAMS.set_enabled(True)
+                        HISTOGRAMS.set_exemplars_enabled(True)
+                        db.sentinel.set_enabled(True)
                         on_rate += _echo_loop(db, seg)
                         TRACER.set_enabled(False)
                         HISTOGRAMS.set_enabled(False)
+                        HISTOGRAMS.set_exemplars_enabled(False)
+                        db.sentinel.set_enabled(False)
                         off_rate += _echo_loop(db, seg)
                     db.close()
             finally:
                 TRACER.set_enabled(True)
                 HISTOGRAMS.set_enabled(True)
+                HISTOGRAMS.set_exemplars_enabled(
+                    os.environ.get("SWARMDB_EXEMPLARS", "1") != "0")
             on_rate /= 2
             off_rate /= 2
             result["echo_tracer_on_msgs_per_sec"] = round(on_rate, 2)
@@ -1175,6 +1188,14 @@ def _mode_summary(r: dict) -> dict:
     for short, long in _SUMMARY_KEYS:
         if r.get(long) is not None:
             out[short] = r[long]
+    # compact phase shares (q=queue_wait p=prefill d=decode h=host_sync
+    # r=reply_emit, 2dp): scripts/bench_trend.py attributes a
+    # mode-vs-mode regression from these with the analyzer's
+    # contributor model, so the checked-in driver records carry enough
+    # signal to NAME a regression's dominant phase
+    shares = r.get("phase_shares")
+    if shares:
+        out["ph"] = {k[:1]: round(v, 2) for k, v in shares.items()}
     if r.get("tpu_error"):
         out["pl"] = "cpu-fallback"
     return out
@@ -1202,6 +1223,7 @@ def _compact_summary(results: dict, error: str | None = None) -> dict:
         # number from masquerading as a TPU perf claim in the record
         keep = {"v", "pl", "native"}
         for mode_sum in line["modes"].values():
+            mode_sum.pop("ph", None)
             for short, _ in _SUMMARY_KEYS:
                 if short not in keep:
                     mode_sum.pop(short, None)

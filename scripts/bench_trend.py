@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Bench-trend regression gate (ISSUE 7 tentpole, leg 3).
+
+The repo checks in one ``BENCH_r*.json`` driver record per round — an
+archive nobody reads until a human diffs two of them by hand. This
+script turns the trajectory into a gate: it compares the latest pair
+(or any two records given explicitly), flags every mode whose
+throughput regressed beyond the threshold, and — using the SAME
+contributor model as ``swarmdb_tpu.obs.analyze`` — names the dominant
+contributor from the per-mode phase shares that ``bench.py`` now embeds
+in the compact summary (``ph``: q=queue_wait p=prefill d=decode
+h=host_sync r=reply_emit).
+
+Report-only by default (CI runs it that way first — the checked-in
+records predate the ``ph`` field and several known regressions, dpserve
+dpx=0.22 among them, are already on the books); ``--enforce`` makes
+regressions fail the job once the trend is clean.
+
+Usage::
+
+    python scripts/bench_trend.py                 # latest pair in repo root
+    python scripts/bench_trend.py A.json B.json   # explicit base, test
+    python scripts/bench_trend.py --threshold 0.1 --enforce
+
+Stdlib + the analyzer only (no jax), so the bare CI lint job can run it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmdb_tpu.obs import analyze  # noqa: E402
+
+#: compact-summary phase key -> analyzer cost category (reply_emit is
+#: service-side emission; it serializes completions exactly like decode
+#: host work, so it folds into decode for attribution)
+_PH_KEYS = {"q": "queue_wait", "p": "prefill", "d": "decode",
+            "h": "host_sync", "r": "decode"}
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    """Accept either a driver record ({n, cmd, rc, tail, parsed}) or a
+    raw bench summary line ({metric, value, mode, modes, ...})."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: no per-mode summary (need a bench "
+                         "driver record or a mode=all summary line)")
+    if "modes" not in data and data.get("mode") and \
+            isinstance(data.get("value"), (int, float)):
+        # single-mode record (the pre-mode=all driver rounds): lift it
+        # into a one-entry modes map so serve-vs-serve still compares
+        rec: Dict[str, Any] = {"v": data["value"]}
+        for short, long in (("p50", "p50_send_to_first_token_s"),
+                            ("hit", "prefix_hit_rate"),
+                            ("tok", "tokens_per_sec"),
+                            ("pl", "platform")):
+            if data.get(long) is not None:
+                rec[short] = data[long]
+        shares = data.get("phase_shares")
+        if shares:
+            rec["ph"] = {k[:1]: round(v, 2) for k, v in shares.items()}
+        data = {"modes": {data["mode"]: rec}}
+    if "modes" not in data:
+        raise ValueError(f"{path}: no per-mode summary (need a bench "
+                         "driver record or a mode=all summary line)")
+    return data
+
+
+def discover_pair(root: str) -> Tuple[str, str, List[str]]:
+    """Latest two LOADABLE records (newest-last). Records whose
+    ``parsed`` is null (the BENCH_r04 truncated-tail incident) are
+    skipped and reported, not fatal — the gate compares the newest
+    usable pair."""
+    records = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    usable: List[str] = []
+    skipped: List[str] = []
+    for path in reversed(records):
+        try:
+            load_record(path)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            skipped.append(str(exc))
+            continue
+        usable.append(path)
+        if len(usable) == 2:
+            break
+    if len(usable) < 2:
+        raise ValueError(f"need >= 2 loadable BENCH_r*.json under {root} "
+                         f"(skipped: {skipped or 'none'})")
+    return usable[1], usable[0], skipped
+
+
+def _phase_summary(mode_rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Synthesize an analyzer-compatible summary from a compact mode
+    record: per-completion cost per category = phase share x the mode's
+    per-completion second (1/v). Proportions are exact — the attributor
+    only differences these, so a shared scale factor cancels out of the
+    shares."""
+    ph = mode_rec.get("ph")
+    v = mode_rec.get("v")
+    if not ph or not isinstance(v, (int, float)) or v <= 0:
+        return None
+    per_completion_ms = {c: 0.0 for c in ("queue_wait", "prefill",
+                                          "decode", "host_sync")}
+    for key, share in ph.items():
+        cat = _PH_KEYS.get(key)
+        if cat is not None:
+            per_completion_ms[cat] += float(share) * 1000.0 / float(v)
+    per_completion_ms = {c: round(x, 3)
+                         for c, x in per_completion_ms.items()}
+    return {
+        "per_completion_ms": per_completion_ms,
+        "mean_ms": dict(per_completion_ms),
+        "admission_waves": 0,
+        "mean_wave_size": 0.0,
+        "completed": mode_rec.get("completed", 0),
+    }
+
+
+def _signals(base: Dict[str, Any], test: Dict[str, Any]) -> Dict[str, Any]:
+    """Fallback evidence when a record pair predates the ``ph`` field:
+    the p50 send->first-token and prefix hit-rate deltas still narrow a
+    regression down even without a full attribution."""
+    out: Dict[str, Any] = {}
+    for key, label in (("p50", "p50_send_to_first_token_s"),
+                       ("hit", "prefix_hit_rate"),
+                       ("tok", "tokens_per_sec"),
+                       ("dpx", "dp_scaling_x")):
+        b, t = base.get(key), test.get(key)
+        if b is not None or t is not None:
+            out[label] = {"base": b, "test": t}
+    return out
+
+
+def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
+                  threshold: float) -> List[Dict[str, Any]]:
+    verdicts: List[Dict[str, Any]] = []
+    base_modes = base.get("modes", {})
+    test_modes = test.get("modes", {})
+    for mode in sorted(set(base_modes) & set(test_modes)):
+        b, t = base_modes[mode], test_modes[mode]
+        bv, tv = b.get("v"), t.get("v")
+        if not isinstance(bv, (int, float)) or not \
+                isinstance(tv, (int, float)) or bv <= 0:
+            verdicts.append({"mode": mode, "comparable": False,
+                             "reason": "no numeric throughput on both "
+                                       "sides"})
+            continue
+        ratio = tv / bv
+        entry: Dict[str, Any] = {
+            "mode": mode,
+            "comparable": True,
+            "base_msgs_per_sec": bv,
+            "test_msgs_per_sec": tv,
+            "ratio": round(ratio, 3),
+            "regressed": ratio < (1.0 - threshold),
+        }
+        if entry["regressed"]:
+            bs, ts = _phase_summary(b), _phase_summary(t)
+            if bs is not None and ts is not None:
+                diag = analyze.diagnose(bs, ts)
+                entry["attribution"] = diag
+                entry["dominant"] = diag["dominant"]
+            else:
+                entry["attribution"] = None
+                entry["dominant"] = None
+                entry["signals"] = _signals(b, t)
+                entry["note"] = ("record pair lacks phase shares "
+                                 "('ph'); rerun bench.py to attribute")
+        verdicts.append(entry)
+    return verdicts
+
+
+def build_report(base_path: str, test_path: str,
+                 threshold: float) -> Dict[str, Any]:
+    base = load_record(base_path)
+    test = load_record(test_path)
+    verdicts = compare_modes(base, test, threshold)
+    regressed = [v for v in verdicts if v.get("regressed")]
+    return {
+        "kind": "swarmdb.bench_trend",
+        "version": 1,
+        "base": base_path,
+        "test": test_path,
+        "threshold": threshold,
+        "modes": verdicts,
+        "regressed_modes": [v["mode"] for v in regressed],
+        "summary": (
+            "no mode regressed beyond threshold" if not regressed else
+            "; ".join(
+                f"{v['mode']} {v['base_msgs_per_sec']} -> "
+                f"{v['test_msgs_per_sec']} msgs/sec "
+                f"({v['ratio']}x)"
+                + (f", dominant {v['dominant']} "
+                   f"({v['attribution']['shares'][v['dominant']]:.0%})"
+                   if v.get("dominant") else ", unattributed")
+                for v in regressed)),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/bench_trend.py",
+        description="Compare two checked-in bench records; flag and "
+                    "attribute per-mode throughput regressions.")
+    ap.add_argument("paths", nargs="*",
+                    help="two records (base, test); default: the latest "
+                         "BENCH_r*.json pair in the repo root")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative throughput drop that counts as a "
+                         "regression (default 0.15 = 15%%)")
+    ap.add_argument("--enforce", action="store_true",
+                    help="exit 1 on regression (default: report-only)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the report to PATH")
+    args = ap.parse_args(argv)
+
+    skipped: List[str] = []
+    try:
+        if len(args.paths) == 2:
+            base_path, test_path = args.paths
+        elif not args.paths:
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            base_path, test_path, skipped = discover_pair(root)
+        else:
+            ap.error("pass exactly two records, or none to auto-discover")
+        report = build_report(base_path, test_path, args.threshold)
+        if skipped:
+            report["skipped_records"] = skipped
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"bench_trend: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    if report["regressed_modes"]:
+        print(f"bench_trend: REGRESSED: {report['summary']}"
+              f"{'' if args.enforce else ' (report-only)'}",
+              file=sys.stderr)
+        return 1 if args.enforce else 0
+    print("bench_trend: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

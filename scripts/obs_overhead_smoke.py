@@ -1,17 +1,21 @@
 #!/usr/bin/env python
 """Observability-overhead smoke for CI (ISSUE 2 acceptance: <= 5%
-budget; ISSUE 6 extends the A/B to the /metrics histograms).
+budget; ISSUE 6 extended the A/B to the /metrics histograms; ISSUE 7
+extends it to bucket exemplars and the online SLO sentinel).
 
-Runs the pure-routing echo loop with the span tracer AND the
-fixed-bucket histograms enabled vs disabled in ALTERNATING segments
-(back-to-back whole runs drift more than the effect measured) and fails
-if the combined overhead exceeds the smoke bound. Stdlib + pydantic
-only — no jax, no aiohttp, no pytest — so the bare `lint` CI job can
-run it. The bound is 20%: CI boxes are noisy, and the point of the
-smoke is to catch a catastrophic regression (a lock or an O(n) walk
-landing on the record path), not to re-measure the tight number —
-bench.py's echo mode records that (`tracer_overhead_pct`, which since
-ISSUE 6 also covers histogram recording)."""
+Runs the pure-routing echo loop with the span tracer, the fixed-bucket
+histograms, exemplar retention, AND the SLO sentinel enabled vs
+disabled in ALTERNATING segments (back-to-back whole runs drift more
+than the effect measured) and fails if the combined overhead exceeds
+the smoke bound. The sentinel runs with a sub-second window so several
+window closes land inside each "on" segment — the tick probe and the
+close path are both inside the measurement. Stdlib + pydantic only —
+no jax, no aiohttp, no pytest — so the bare `lint` CI job can run it.
+The bound is 20%: CI boxes are noisy, and the point of the smoke is to
+catch a catastrophic regression (a lock or an O(n) walk landing on the
+record path), not to re-measure the tight number — bench.py's echo
+mode records that (`tracer_overhead_pct`, which covers all four
+toggles since ISSUE 7)."""
 
 import os
 import sys
@@ -33,21 +37,27 @@ def main() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
                      autosave_interval=1e9)
+        db.sentinel.config.window_s = max(0.25, SEG_S / 4)
         try:
             for _ in range(2):
                 TRACER.set_enabled(True)
                 HISTOGRAMS.set_enabled(True)
+                HISTOGRAMS.set_exemplars_enabled(True)
+                db.sentinel.set_enabled(True)
                 on += bench._echo_loop(db, SEG_S)
                 TRACER.set_enabled(False)
                 HISTOGRAMS.set_enabled(False)
+                HISTOGRAMS.set_exemplars_enabled(False)
+                db.sentinel.set_enabled(False)
                 off += bench._echo_loop(db, SEG_S)
         finally:
             TRACER.set_enabled(True)
             HISTOGRAMS.set_enabled(True)
+            HISTOGRAMS.set_exemplars_enabled(True)
             db.close()
     overhead = max(0.0, (off - on) / off * 100.0) if off else 0.0
-    print(f"echo msgs/sec: tracer+histograms on {on / 2:.1f}, "
-          f"off {off / 2:.1f}, overhead {overhead:.2f}% "
+    print(f"echo msgs/sec: tracer+histograms+exemplars+sentinel on "
+          f"{on / 2:.1f}, off {off / 2:.1f}, overhead {overhead:.2f}% "
           f"(bound {BOUND:.0f}%)")
     if overhead > BOUND:
         print("FAIL: observability overhead above smoke bound",

@@ -104,6 +104,11 @@ RULES: Dict[str, Rule] = {
              "histogram allocated or looked up per observation inside a "
              "hot-path function — bind it once and observe through the "
              "bound object"),
+        Rule("SWL504", "span-discipline",
+             "per-observation allocation (dict/list/set/str "
+             "construction, comprehension, f-string) in hot exemplar/"
+             "sentinel record-path code — exemplar retention must be an "
+             "in-place slot write"),
         Rule("SWL601", "heartbeat-safety",
              "blocking call inside `# swarmlint: heartbeat` code — a "
              "stalled failure-detector evaluation reads as a dead peer "

@@ -23,6 +23,17 @@ contract the type system cannot enforce:
   — a defaultdict that ALLOCATES a histogram on a miss) or a per-call
   ``Histogram(...)`` construction inside ``# swarmlint: hot`` code
   puts a hash lookup/allocation on the decode path (SWL503).
+- Exemplar retention and the SLO sentinel's tick (ISSUE 7) are record
+  paths with an even stricter contract: the per-observation work is an
+  in-place SLOT WRITE into preallocated parallel lists. Inside
+  ``# swarmlint: hot`` code that belongs to exemplar/sentinel classes
+  (``Histogram``/``*Sentinel*``, or any function touching
+  ``exemplar``/``_ex_`` attributes), building a dict/list/set/str —
+  displays, comprehensions, f-strings, ``dict()``/``list()``/
+  ``str()``/``.format()`` calls — per observation is SWL504. The
+  engine's hot step records (``_flight_step``) legitimately build one
+  dict per STEP, so the rule is scoped to the per-observation exemplar
+  and sentinel paths rather than every hot function.
 
 ``__enter__``/``__exit__`` pairs are exempt from SWL501 — the context-
 manager protocol balances them across two methods by design.
@@ -31,7 +42,7 @@ manager protocol balances them across two methods by design.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
 from .core import Finding, SourceFile, dotted_name, make_finding
 
@@ -61,6 +72,48 @@ def _is_call_to(node: ast.AST, method: str) -> bool:
 
 #: histogram types whose construction in a hot function is SWL503
 _HIST_TYPES = {"Histogram", "LatencyHistogram"}
+
+#: builtins whose call in hot exemplar/sentinel code allocates (SWL504)
+_ALLOC_BUILTINS = {"dict", "list", "set", "str"}
+
+#: allocation-expression nodes for SWL504 (displays + comprehensions +
+#: f-strings; GeneratorExp excluded — lazily evaluated, not a container)
+_ALLOC_NODES = (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.SetComp,
+                ast.DictComp, ast.JoinedStr)
+
+
+def _exemplar_scope(src: SourceFile, fn: ast.AST) -> bool:
+    """True when a hot function is exemplar/sentinel record-path code:
+    a method of a ``Histogram``/``*Sentinel*`` class, or any function
+    touching ``exemplar``/``_ex_`` attributes. Scopes SWL504 so the
+    engine's legitimate one-dict-per-step hot records stay clean."""
+    cls = src.enclosing_scope(fn.lineno, classes_only=True)
+    if cls is not None and ("Sentinel" in cls.name
+                            or "Histogram" in cls.name):
+        return True
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Attribute) and (
+                "exemplar" in node.attr or node.attr.startswith("_ex_")):
+            return True
+    return False
+
+
+def _alloc_desc(node: ast.AST) -> Optional[str]:
+    """Human name of the allocation ``node`` performs, or None."""
+    if isinstance(node, _ALLOC_NODES):
+        return {ast.Dict: "dict display", ast.List: "list display",
+                ast.Set: "set display", ast.ListComp: "list comprehension",
+                ast.SetComp: "set comprehension",
+                ast.DictComp: "dict comprehension",
+                ast.JoinedStr: "f-string"}[type(node)]
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _ALLOC_BUILTINS:
+            return f"{name}() call"
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "format":
+            return ".format() call"
+    return None
 
 
 def _dynamic_receiver(node: ast.AST) -> bool:
@@ -118,6 +171,16 @@ def check(src: SourceFile) -> List[Finding]:
                         f"`{fn.name}` — a registry/dict lookup (or a "
                         f"defaultdict allocation) per observation; "
                         f"bind the histogram once"))
+        if src.is_hot(fn) and _exemplar_scope(src, fn):
+            for node in _own_nodes(fn):
+                desc = _alloc_desc(node)
+                if desc is not None:
+                    findings.append(make_finding(
+                        src, "SWL504", node,
+                        f"per-observation allocation ({desc}) inside "
+                        f"hot exemplar/sentinel function `{fn.name}` — "
+                        f"retention must be an in-place slot write into "
+                        f"preallocated lists"))
         if (begins and ends == 0
                 and fn.name not in _BALANCE_EXEMPT):
             findings.append(make_finding(

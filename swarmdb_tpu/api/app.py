@@ -649,8 +649,17 @@ def create_app(
         # fixed-bucket latency histograms (obs/metrics.py, ISSUE 6):
         # TTFT, queue wait, decode chunk, data-plane RTT, replication
         # commit — Prometheus histogram exposition with STABLE bucket
-        # boundaries, so p50/p99-over-time exist outside bench runs
-        lines.extend(HISTOGRAMS.render_prometheus())
+        # boundaries, so p50/p99-over-time exist outside bench runs.
+        # Buckets that retained a trace-id exemplar carry it in
+        # OpenMetrics exemplar syntax (ISSUE 7): the id resolves via
+        # /admin/trace/export?trace_id= (links on /admin/slo).
+        lines.extend(HISTOGRAMS.render_prometheus(exemplars=True))
+        # online SLO sentinel gauges (obs/sentinel.py): breached flag,
+        # window p95s, per-completion cost by category — the pageable
+        # surface; /admin/slo carries the attributed alerts
+        sentinel = getattr(db, "sentinel", None)
+        if sentinel is not None:
+            lines.extend(await _run_sync(sentinel.prometheus_lines))
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -797,6 +806,24 @@ def create_app(
         if trace_id:
             merged["metadata"]["trace_id"] = trace_id
         return web.json_response(merged)
+
+    async def admin_slo(request: web.Request) -> web.Response:
+        """GET /admin/slo — the online SLO sentinel (ISSUE 7): config
+        and learned baseline, the last closed window's per-completion
+        cost decomposition and p95s, the attributed alert ring (each
+        alert names the dominant contributor, shares summing to 1, and
+        points at its auto-dumped flight + trace files), and the
+        histogram exemplars with ready-made ``?trace_id=`` export links
+        so a tail bucket opens a real request timeline. ``?tick=1``
+        forces a window-close check first (freshness for pollers on an
+        otherwise idle node)."""
+        require_admin(current_agent(request))
+        sentinel = getattr(db, "sentinel", None)
+        if sentinel is None:
+            raise _error(503, "this runtime has no SLO sentinel")
+        if request.query.get("tick"):
+            await _run_sync(sentinel.maybe_tick)
+        return web.json_response(await _run_sync(sentinel.status))
 
     async def flight_record(request: web.Request) -> web.Response:
         """GET /admin/flight — the engine flight recorder's current rings
@@ -980,6 +1007,7 @@ def create_app(
         web.get("/admin/trace/export", trace_export),
         web.get("/admin/cluster/trace", cluster_trace),
         web.get("/admin/flight", flight_record),
+        web.get("/admin/slo", admin_slo),
         web.get("/admin/ha", admin_ha),
     ])
 

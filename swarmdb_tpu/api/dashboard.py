@@ -49,6 +49,13 @@ DASHBOARD_HTML = """<!DOCTYPE html>
 <h2>Messages</h2><div id="messages">-</div>
 <h2>Latencies</h2><div id="latencies">-</div>
 <h2>Agents</h2><div id="agents">-</div>
+<h2>SLO sentinel</h2><div id="slo">-</div>
+<p class="muted">
+  <button onclick="download('/admin/slo', 'slo.json')">
+    download SLO status</button>
+  (baseline, windows, attributed alerts, histogram exemplars with
+  trace-export links) &middot; admin token required
+</p>
 <h2>Tracing &amp; flight recorder</h2>
 <p class="muted">
   <button onclick="download('/admin/trace/export', 'trace.json')">
@@ -154,6 +161,27 @@ async function refresh() {
           [k, fmt(v.p50), fmt(v.p95), fmt(v.p99), fmt(v.count)]),
           ["metric", "p50", "p95", "p99", "n"])
       : '<span class="muted">none yet</span>';
+    // SLO sentinel (admin): its own try so a 503 (no sentinel) or 403
+    // doesn't blank the rest of the page
+    try {
+      const slo = await getJSON("/admin/slo?tick=1");
+      const rows = [["breached", slo.breached],
+                    ["windows", slo.windows_total],
+                    ["alerts", slo.alerts_total],
+                    ["baseline", slo.baseline ? "learned" : "warming up"]];
+      const last = (slo.alerts || [])[slo.alerts.length - 1];
+      if (last) rows.push(
+        ["last alert", `${last.id}: dominant ${last.dominant}`]);
+      const w = slo.last_window || {};
+      if (w.p95_ttft_s != null) rows.push(["p95 TTFT (s)", fmt(w.p95_ttft_s)]);
+      if (w.cost_growth_x != null) rows.push(["cost growth x", fmt(w.cost_growth_x)]);
+      const sdiv = document.getElementById("slo");
+      sdiv.innerHTML = table(rows);
+      sdiv.className = slo.breached ? "bad" : "ok";
+    } catch (err) {
+      document.getElementById("slo").innerHTML =
+        '<span class="muted">' + esc(String(err)) + "</span>";
+    }
     const agents = Object.entries(stats.messages_by_agent || {});
     document.getElementById("agents").innerHTML = agents.length
       ? table(agents.map(([k, v]) => [k, v.sent, v.received]),

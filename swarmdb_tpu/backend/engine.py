@@ -208,6 +208,11 @@ class Engine:
         self.flight = FlightRecorder()
         self._flight_dir = flight_dir
         self._flight_last_had_work = False
+        # online SLO sentinel (obs/sentinel.py): the runtime owns it (one
+        # per process, shared metrics registry); ServingService points it
+        # here so the engine loop drives window closes and breach dumps
+        # read THIS engine's flight rings. None = unmonitored engine.
+        self.sentinel = None
         # priority aging (anti-starvation, see _age_queue): seconds a
         # queued request waits per effective-priority-class bump; <= 0
         # disables (strict priority, LOW can starve under saturation)
@@ -1704,6 +1709,10 @@ class Engine:
         """One flight-recorder step record per engine-loop iteration that
         has work (idle iterations are skipped so the ring's last-N steps
         describe the crash window, not hours of quiet)."""
+        if self.sentinel is not None:
+            # window-close probe: one compare per engine step (the close
+            # itself is rare and runs off the sentinel's own snapshot)
+            self.sentinel.maybe_tick()
         with self._cv:
             queued = len(self._queue)
             by_prio: Dict[int, int] = {}
@@ -2588,8 +2597,14 @@ class Engine:
             # reused stays consistent across the prefix and resume paths
             self.metrics.counters["prompt_tokens"].inc(
                 len(req.prompt) + req.resume_len)
+            # admission accounting for the SLO sentinel's window
+            # summaries: requests admitted + one wave per _activate call
+            # (the offline analyzer derives the same two numbers from
+            # prefill-span clustering; online they are two counter incs)
+            self.metrics.counters["engine_admitted"].inc()
             self._lat_queue_wait.observe(t0 - req.submitted_at)
-            HIST_QUEUE_WAIT.observe(t0 - req.submitted_at)
+            HIST_QUEUE_WAIT.observe(t0 - req.submitted_at,
+                                    req.request_id)
             self.metrics.counters["phase_us_queue_wait"].inc(
                 max(0, int((t0 - req.submitted_at) * 1e6)))
             # retro-span: the wait was over before any tracer call site
@@ -2598,6 +2613,7 @@ class Engine:
                                 cat="engine", rid=req.request_id)
         prefill_dt = time.time() - t0
         self._lat_prefill.observe(prefill_dt)
+        self.metrics.counters["engine_admission_waves"].inc()
         self.metrics.counters["phase_us_prefill"].inc(
             max(0, int(prefill_dt * 1e6)))
         for slot_id, req in batch:
@@ -2678,7 +2694,12 @@ class Engine:
             # overlap, so sums can exceed wall clock — documented)
             self.metrics.counters["phase_us_decode"].inc(
                 (t_sync1 - t_dispatch_ns) // 1000)
-            HIST_DECODE_CHUNK.observe((t_sync1 - t_dispatch_ns) / 1e9)
+            # exemplar rid: the chunk covers every snapshot slot; tag it
+            # with the first one so a tail decode-chunk bucket opens a
+            # representative trace (tuple indexing, no allocation)
+            HIST_DECODE_CHUNK.observe(
+                (t_sync1 - t_dispatch_ns) / 1e9,
+                snapshot[0][1].request_id if snapshot else None)
         block = np.asarray(block)
         lps = np.asarray(lps)
         now = time.time()
@@ -2724,7 +2745,7 @@ class Engine:
         if slot.first_token_at is None:
             slot.first_token_at = now
             self._lat_first_token.observe(now - req.submitted_at)
-            HIST_TTFT.observe(now - req.submitted_at)
+            HIST_TTFT.observe(now - req.submitted_at, req.request_id)
 
         finished_reason = None
         if token == self.eos_id:

@@ -163,6 +163,12 @@ class ServingService:
         self.tokenizer = tokenizer
         self.backend_id = backend_id
         self.poll_interval = poll_interval
+        # point the runtime's SLO sentinel at THIS engine: breach alerts
+        # auto-dump the engine's flight rings + the process trace, and
+        # the engine loop drives window closes even when no sends flow
+        db.sentinel.bind(flight=engine.flight, tracer=engine.tracer,
+                         flight_dir=engine._flight_dir)
+        engine.sentinel = db.sentinel
         self._consumer_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # Reply emission (tokenizer decode + send_message + persistence

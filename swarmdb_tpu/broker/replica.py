@@ -1066,8 +1066,12 @@ class ReplicatedBroker(Broker):
                                 max(0.0, deadline - time.time())):
                 return False
         # replication lag as writers experience it: append -> acks=all
-        # watermark passed it (histogram at /metrics, ISSUE 6)
-        HIST_REPLICATION_COMMIT.observe(time.monotonic() - t0)
+        # watermark passed it (histogram at /metrics, ISSUE 6); the
+        # waiting message's trace context tags the bucket exemplar
+        tc = propagate.current()
+        HIST_REPLICATION_COMMIT.observe(
+            time.monotonic() - t0,
+            tc.trace_id if tc is not None else None)
         return True
 
     def replication_stats(self) -> List[Dict]:

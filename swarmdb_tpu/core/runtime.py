@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 from ..broker.base import Broker, Consumer, Producer, Record
 from ..obs import TRACER, propagate
 from ..obs.metrics import HIST_PUBLISH
+from ..obs.sentinel import SLOSentinel
 from ..utils.hashing import stable_partition
 from ..utils.metrics import MetricsRegistry
 from .messages import (
@@ -95,6 +96,12 @@ class SwarmDB:
         self.max_messages_per_file = max_messages_per_file
         self.token_counter = token_counter
         self.metrics = metrics or MetricsRegistry()
+        # online SLO sentinel (obs/sentinel.py, GET /admin/slo): one per
+        # runtime, watching the SHARED metrics registry — the serving
+        # engine records its phase counters into the same registry, so
+        # the sentinel sees the whole path. The send path and the engine
+        # loop both drive window closes; SWARMDB_SENTINEL=0 disables.
+        self.sentinel = SLOSentinel(metrics=self.metrics)
 
         # replication_factor > 1 = the reference's Kafka acks=all durability
         # class (` main.py:118,196-197`): a DELIVERED report survives the
@@ -467,11 +474,14 @@ class SwarmDB:
             raise
 
         TRACER.span_end(t_pub, "broker.publish", cat="broker", rid=msg.id)
-        HIST_PUBLISH.observe(time.monotonic() - t_pub_mono)
+        HIST_PUBLISH.observe(time.monotonic() - t_pub_mono, msg.id)
         self.metrics.counters["messages_sent"].inc()
         self.metrics.rates["messages_sent"].mark()
         self._maybe_autosave()
         TRACER.span_end(t_send, "runtime.send", cat="runtime", rid=msg.id)
+        # SLO window probe (one compare; closes are rare): broker-only
+        # deployments get sentinel windows without an engine loop
+        self.sentinel.maybe_tick()
         return msg.id
 
     def broadcast_message(

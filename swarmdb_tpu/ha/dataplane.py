@@ -391,8 +391,11 @@ class RemoteBroker(Broker):
         self._checkin(sock)
         if extra_deadline_s == 0.0:
             # plain ops only: the blocking waits' RTT is dominated by
-            # their own server-side timeout, not the wire
-            HIST_DATAPLANE_RTT.observe(time.monotonic() - t0)
+            # their own server-side timeout, not the wire. The active
+            # trace id rides as the bucket exemplar so a tail RTT links
+            # to the merged cluster trace of that request.
+            HIST_DATAPLANE_RTT.observe(time.monotonic() - t0,
+                                       tc["t"] if tc is not None else None)
         if t_span:
             TRACER.span_end(t_span, "dataplane.call", cat="dataplane",
                             rid=tc["t"], args={"op": op, "addr": self.addr})

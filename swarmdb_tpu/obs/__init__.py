@@ -12,16 +12,23 @@ can import the package without the ML stack.
   plane / cluster-client / replication wires) and the per-node trace
   merge behind ``GET /admin/cluster/trace``.
 - :mod:`.metrics` — lock-free fixed-bucket latency histograms exported
-  in Prometheus histogram format from ``/metrics``.
+  in Prometheus histogram format from ``/metrics``, with per-bucket
+  trace-id exemplars in OpenMetrics syntax.
 - :mod:`.analyze` — offline trace/flight analyzer
   (``python -m swarmdb_tpu.obs.analyze``): per-completion cost
   decomposition and A/B regression attribution.
+- :mod:`.sentinel` — the ONLINE counterpart (``GET /admin/slo``):
+  rolling-window SLO monitor that learns a baseline, runs the analyzer's
+  attributor in-process on breach, and auto-dumps flight + trace
+  evidence tagged with the alert id.
 """
 
 from . import propagate
 from .flight import FlightRecorder
 from .metrics import HISTOGRAMS, Histogram, HistogramRegistry
+from .sentinel import SLOConfig, SLOSentinel
 from .tracer import TRACER, SpanTracer
 
 __all__ = ["FlightRecorder", "SpanTracer", "TRACER", "propagate",
-           "HISTOGRAMS", "Histogram", "HistogramRegistry"]
+           "HISTOGRAMS", "Histogram", "HistogramRegistry",
+           "SLOConfig", "SLOSentinel"]

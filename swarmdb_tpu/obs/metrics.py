@@ -35,14 +35,27 @@ Bucket boundaries are STABLE — dashboards and recording rules key on
 ``SWARMDB_HISTOGRAMS=0`` disables recording (the bench echo A/B flips
 this together with the tracer to measure the combined overhead against
 the ≤5% budget).
+
+**Exemplars** (ISSUE 7): each bucket optionally retains the trace id of
+the most recent observation that landed in it, so a tail bucket links
+directly to a real request timeline (``/admin/trace/export?trace_id=``,
+or the merged cluster trace). The retention is three preallocated
+parallel slot lists written in-place — no dict, no tuple, no string
+built per observation (swarmlint SWL504 polices this in
+``# swarmlint: hot`` exemplar/sentinel code). Rendered in OpenMetrics
+exemplar syntax (``... # {trace_id="..."} <value> <ts>``) appended to
+the affected ``_bucket`` lines, and surfaced with export links at
+``GET /admin/slo``. ``SWARMDB_EXEMPLARS=0`` disables retention without
+touching the counts.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 from bisect import bisect_left
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["Histogram", "HistogramRegistry", "HISTOGRAMS",
            "LADDER_FAST", "LADDER_WIDE",
@@ -62,7 +75,8 @@ class Histogram:
     """One fixed-bucket histogram; single-object record path."""
 
     __slots__ = ("name", "help", "boundaries", "counts", "total", "sum_s",
-                 "enabled")
+                 "enabled", "exemplars_enabled",
+                 "_ex_rids", "_ex_vals", "_ex_ts")
 
     def __init__(self, name: str, boundaries: Tuple[float, ...],
                  help_text: str = "") -> None:
@@ -77,16 +91,32 @@ class Histogram:
         self.total = 0
         self.sum_s = 0.0
         self.enabled = True
+        # per-bucket exemplar slots (most recent rid to land in each
+        # bucket): three parallel preallocated lists so retention is a
+        # slot write, never a dict/tuple build per observation
+        self.exemplars_enabled = (
+            os.environ.get("SWARMDB_EXEMPLARS", "1") != "0")
+        n = len(self.counts)
+        self._ex_rids: List[Optional[str]] = [None] * n
+        self._ex_vals: List[float] = [0.0] * n
+        self._ex_ts: List[float] = [0.0] * n
 
-    def observe(self, seconds: float) -> None:
+    # swarmlint: hot
+    def observe(self, seconds: float, rid: Optional[str] = None) -> None:
         """Record one latency (hot path: no locks, no allocation beyond
         CPython's arithmetic; a lost count under a write race is the
-        accepted failure mode)."""
+        accepted failure mode). ``rid`` — the observation's trace id —
+        is retained as that bucket's exemplar (in-place slot write)."""
         if not self.enabled:
             return
-        self.counts[bisect_left(self.boundaries, seconds)] += 1
+        i = bisect_left(self.boundaries, seconds)
+        self.counts[i] += 1
         self.total += 1
         self.sum_s += seconds
+        if rid is not None and self.exemplars_enabled:
+            self._ex_rids[i] = rid
+            self._ex_vals[i] = seconds
+            self._ex_ts[i] = time.time()
 
     # -------------------------------------------------------------- reading
 
@@ -100,18 +130,52 @@ class Histogram:
             "sum_s": self.sum_s,
         }
 
-    def render_prometheus(self, prefix: str = "swarmdb_") -> List[str]:
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """Retained bucket exemplars, tail-first (highest bucket first —
+        the slow requests are the ones worth opening). Each entry names
+        the bucket's ``le`` bound, the trace id, the observed value, and
+        its age; the caller turns the trace id into an export link."""
+        now = time.time()
+        out: List[Dict[str, Any]] = []
+        for i in range(len(self.counts) - 1, -1, -1):
+            rid = self._ex_rids[i]
+            if rid is None:
+                continue
+            le = ("+Inf" if i == len(self.boundaries)
+                  else f"{self.boundaries[i]:g}")
+            out.append({
+                "le": le,
+                "trace_id": rid,
+                "value_s": round(self._ex_vals[i], 6),
+                "age_s": round(max(0.0, now - self._ex_ts[i]), 3),
+            })
+        return out
+
+    def render_prometheus(self, prefix: str = "swarmdb_",
+                          exemplars: bool = False) -> List[str]:
         """Prometheus text-exposition histogram block (cumulative
-        ``_bucket{le=...}`` counts + ``_sum`` + ``_count``)."""
+        ``_bucket{le=...}`` counts + ``_sum`` + ``_count``). With
+        ``exemplars=True``, buckets that retained one get the
+        OpenMetrics exemplar suffix
+        (``# {trace_id="..."} <value> <timestamp>``)."""
         n = f"{prefix}{self.name}"
         lines = [f"# TYPE {n} histogram"]
         snap = self.snapshot()
+
+        def _ex(i: int) -> str:
+            if not exemplars or self._ex_rids[i] is None:
+                return ""
+            return (f' # {{trace_id="{self._ex_rids[i]}"}} '
+                    f"{self._ex_vals[i]:.6g} {self._ex_ts[i]:.3f}")
+
         cum = 0
-        for bound, c in zip(self.boundaries, snap["counts"]):
+        for i, (bound, c) in enumerate(zip(self.boundaries,
+                                           snap["counts"])):
             cum += c
-            lines.append(f'{n}_bucket{{le="{bound:g}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="{bound:g}"}} {cum}{_ex(i)}')
         cum += snap["counts"][-1]
-        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}'
+                     f"{_ex(len(self.boundaries))}")
         lines.append(f"{n}_sum {snap['sum_s']:.6f}")
         lines.append(f"{n}_count {cum}")
         return lines
@@ -120,6 +184,10 @@ class Histogram:
         self.counts = [0] * (len(self.boundaries) + 1)
         self.total = 0
         self.sum_s = 0.0
+        n = len(self.counts)
+        self._ex_rids = [None] * n
+        self._ex_vals = [0.0] * n
+        self._ex_ts = [0.0] * n
 
 
 class HistogramRegistry:
@@ -160,10 +228,29 @@ class HistogramRegistry:
         for hist in self.all():
             hist.enabled = self.enabled
 
-    def render_prometheus(self, prefix: str = "swarmdb_") -> List[str]:
+    def set_exemplars_enabled(self, enabled: bool) -> None:
+        """Flip exemplar retention everywhere (the bench echo A/B
+        toggles this together with the tracer/histograms/sentinel so the
+        ≤5% overhead budget covers the slot writes too)."""
+        for hist in self.all():
+            hist.exemplars_enabled = bool(enabled)
+
+    def exemplars(self) -> Dict[str, List[Dict[str, Any]]]:
+        """name -> tail-first exemplar list, omitting empty histograms
+        (the ``/admin/slo`` exemplar surface)."""
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for hist in sorted(self.all(), key=lambda h: h.name):
+            ex = hist.exemplars()
+            if ex:
+                out[hist.name] = ex
+        return out
+
+    def render_prometheus(self, prefix: str = "swarmdb_",
+                          exemplars: bool = False) -> List[str]:
         lines: List[str] = []
         for hist in sorted(self.all(), key=lambda h: h.name):
-            lines.extend(hist.render_prometheus(prefix))
+            lines.extend(hist.render_prometheus(prefix,
+                                                exemplars=exemplars))
         return lines
 
     def reset(self) -> None:

@@ -246,10 +246,33 @@ class SpanTracer:
             except ValueError:
                 max_events = 50000
         pid = os.getpid()
+        dead_rings: List[_Ring] = []
         with self._reg_lock:
             rings = [r for r, _ in self._rings]
+            for r, wr in self._rings:
+                owner = wr()
+                if owner is None or not owner.is_alive():
+                    dead_rings.append(r)
         spans: List[Dict[str, Any]] = []
         tracks: List[Dict[str, Any]] = []
+        # dead-thread ring accounting (ISSUE 7 satellite): consumers of a
+        # failover/short-lived-thread trace need to know whether those
+        # threads' spans are still retained or already evicted by the
+        # _MAX_DEAD_RINGS cap — count them and stamp the newest event's
+        # age so "the promotion instant is missing" is distinguishable
+        # from "it was never recorded"
+        newest_end_ns = 0
+        for ring in dead_rings:
+            for ev in ring.snapshot():
+                if ev[4] > newest_end_ns:
+                    newest_end_ns = ev[4]
+        dead_meta: Dict[str, Any] = {
+            "count": len(dead_rings),
+            "retain_cap": self._MAX_DEAD_RINGS,
+            "newest_event_age_s": (
+                round(max(0.0, (time.monotonic_ns() - newest_end_ns))
+                      / 1e9, 3) if newest_end_ns else None),
+        }
         for ring in rings:
             tracks.append({
                 "name": "thread_name", "ph": "M", "pid": pid,
@@ -294,6 +317,7 @@ class SpanTracer:
                 "span_events": len(spans),
                 "total_span_events": total,
                 "truncated": keep < total,
+                "dead_thread_rings": dead_meta,
             },
         }
 

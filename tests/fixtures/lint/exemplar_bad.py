@@ -1,0 +1,65 @@
+"""Seeded exemplar/sentinel allocation violations (SWL504) — lint
+fixture.
+
+Not imported by anything; analyzed as text by tests/test_swarmlint.py.
+The rule: exemplar retention and the SLO sentinel tick are
+PER-OBSERVATION record paths — inside ``# swarmlint: hot`` code there
+they must be in-place slot writes into preallocated lists, never a
+dict/list/str built per observation.
+"""
+
+import time
+
+
+class BadHistogram:
+    def __init__(self, boundaries):
+        self.boundaries = boundaries
+        self.counts = [0] * (len(boundaries) + 1)
+        self._ex_rids = [None] * (len(boundaries) + 1)
+        self._ex_vals = [0.0] * (len(boundaries) + 1)
+
+    # swarmlint: hot
+    def observe_builds_dict(self, i, seconds, rid):
+        self.counts[i] += 1
+        self._ex_rids[i] = {"rid": rid, "v": seconds}  # EXPECT: SWL504
+
+    # swarmlint: hot
+    def observe_builds_fstring(self, i, seconds, rid):
+        self.counts[i] += 1
+        self._ex_rids[i] = f"{rid}@{seconds}"  # EXPECT: SWL504
+
+    # swarmlint: hot
+    def observe_slot_write_ok(self, i, seconds, rid):
+        # the sanctioned form: parallel preallocated slots, written
+        # in place
+        self.counts[i] += 1
+        self._ex_rids[i] = rid
+        self._ex_vals[i] = seconds
+
+    def snapshot_allocates_ok(self):
+        # warm reader paths may build whatever they like
+        return {"counts": list(self.counts)}
+
+
+class BadSentinel:
+    def __init__(self):
+        self._deadline = 0.0
+        self.enabled = True
+
+    # swarmlint: hot
+    def maybe_tick_appends(self, now):
+        if now < self._deadline:
+            return
+        self._windows = []  # EXPECT: SWL504
+
+    # swarmlint: hot
+    def maybe_tick_ok(self, now):
+        if not self.enabled:
+            return
+        if now < self._deadline:
+            return
+        self._close_window()
+
+    def _close_window(self):
+        # the rare close path is NOT per-observation: allocation is fine
+        self._deadline = time.monotonic() + 10.0

@@ -1,0 +1,365 @@
+"""Online SLO sentinel + exemplar tests (ISSUE 7 acceptance).
+
+The injected-regression test replays two synthetic windows — healthy,
+then admission-serialized — through the sentinel's deterministic
+``ingest`` core and asserts the full alert contract: dominant
+contributor named, shares summing to 1, the auto flight + trace dumps
+on disk tagged with the alert id, and ``/admin/slo`` reflecting the
+breach. The exemplar tests close the loop from a tail histogram bucket
+to a resolvable ``?trace_id=`` trace export.
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from swarmdb_tpu.api.app import ApiConfig, create_app
+from swarmdb_tpu.broker.local import LocalBroker
+from swarmdb_tpu.core.runtime import SwarmDB
+from swarmdb_tpu.obs import TRACER, FlightRecorder
+from swarmdb_tpu.obs.metrics import HIST_TTFT, Histogram, HistogramRegistry
+from swarmdb_tpu.obs.sentinel import SLOConfig, SLOSentinel
+from swarmdb_tpu.utils.metrics import MetricsRegistry
+
+CFG = ApiConfig(jwt_secret_key="test-secret", rate_limit_per_minute=10_000)
+
+HEALTHY = {
+    "completed": 20,
+    "per_completion_ms": {"queue_wait": 5.0, "prefill": 10.0,
+                          "decode": 20.0, "host_sync": 0.5},
+    "mean_ms": {"queue_wait": 5.0, "prefill": 10.0,
+                "decode": 2.0, "host_sync": 0.1},
+    "admission_waves": 10,
+    "mean_wave_size": 2.0,
+    "p95_ttft_s": 0.25,
+    "p95_queue_wait_s": 0.05,
+}
+
+# admission-serialized: queue wait exploded, prefill grew, decode flat —
+# the dp8 signature PR 5 diagnosed offline, replayed as a live window
+SERIALIZED = {
+    "completed": 18,
+    "per_completion_ms": {"queue_wait": 900.0, "prefill": 80.0,
+                          "decode": 25.0, "host_sync": 0.6},
+    "mean_ms": {"queue_wait": 900.0, "prefill": 40.0,
+                "decode": 2.1, "host_sync": 0.1},
+    "admission_waves": 9,
+    "mean_wave_size": 2.0,
+    "p95_ttft_s": 5.0,
+    "p95_queue_wait_s": 2.5,
+}
+
+
+def make_sentinel(tmp_path, **cfg_overrides):
+    cfg = SLOConfig(window_s=10.0, warmup_windows=1, min_completions=8,
+                    ttft_p95_s=2.5, queue_p95_s=1.0, cost_growth_x=2.0,
+                    max_alerts=64, enabled=True)
+    for k, v in cfg_overrides.items():
+        setattr(cfg, k, v)
+    flight = FlightRecorder(n_steps=16, n_requests=16, n_events=16)
+    flight.record_step({"ts": time.time(), "active": 2, "queued": 7})
+    flight.record_request({"rid": "r-seed", "submitted_at": 1.0,
+                           "admitted_at": 1.9, "first_token_at": 2.0,
+                           "retired_at": 2.5})
+    sent = SLOSentinel(metrics=MetricsRegistry(), config=cfg,
+                       flight=flight, tracer=TRACER,
+                       flight_dir=str(tmp_path))
+    return sent
+
+
+def test_injected_regression_fires_attributed_alert(tmp_path, monkeypatch):
+    # pin the dump directory to THIS tmp even when CI exports a global
+    # SWARMDB_FLIGHT_DIR
+    monkeypatch.setenv("SWARMDB_FLIGHT_DIR", str(tmp_path))
+    sent = make_sentinel(tmp_path)
+
+    assert sent.ingest(HEALTHY) is None          # warmup -> baseline
+    assert sent.baseline is not None
+    assert sent.baseline["per_completion_ms"]["queue_wait"] == 5.0
+
+    alert = sent.ingest(SERIALIZED)
+    assert alert is not None
+    assert len(sent.alerts()) == 1
+    assert sent.breached is True
+
+    # attribution: dominant named, shares sum to 1 over the analyzer's
+    # contributor set
+    assert alert["dominant"] == "admission_serialization"
+    shares = alert["diagnosis"]["shares"]
+    assert abs(sum(shares.values()) - 1.0) < 1e-3
+    assert shares["admission_serialization"] > 0.8
+    assert alert["diagnosis"]["regressed"] is True
+    # all three SLOs breached by the injected window
+    breached_slos = {b["slo"] for b in alert["breaches"]}
+    assert breached_slos == {"ttft_p95_s", "queue_wait_p95_s",
+                             "cost_growth_x"}
+
+    # auto flight dump tagged with the alert id (filename + payload)
+    assert alert["flight_dump"] is not None
+    assert os.path.exists(alert["flight_dump"])
+    assert alert["id"] in os.path.basename(alert["flight_dump"])
+    with open(alert["flight_dump"]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == alert["id"]
+    assert dump["steps"] and dump["requests"]
+
+    # auto trace dump tagged with the alert id
+    assert alert["trace_dump"] is not None
+    assert os.path.exists(alert["trace_dump"])
+    with open(alert["trace_dump"]) as f:
+        trace = json.load(f)
+    assert trace["metadata"]["alert_id"] == alert["id"]
+
+    # alert ring rewritten for the CI artifact
+    rings = list(Path(tmp_path).glob("slo_alerts_*.json"))
+    assert rings, list(Path(tmp_path).iterdir())
+    ring = json.loads(rings[0].read_text())
+    assert ring["alerts_total"] == 1
+    assert ring["alerts"][0]["id"] == alert["id"]
+
+    # recovery: a healthy window clears the breach flag
+    assert sent.ingest(HEALTHY) is None
+    assert sent.breached is False
+
+
+def test_idle_windows_neither_train_nor_alert(tmp_path):
+    sent = make_sentinel(tmp_path, min_completions=8)
+    idle = dict(HEALTHY, completed=2)
+    assert sent.ingest(idle) is None
+    assert sent.baseline is None                 # did not train
+    sent.ingest(HEALTHY)                         # baseline
+    assert sent.ingest(dict(SERIALIZED, completed=3)) is None
+    assert sent.breached is False                # did not alert
+
+
+def test_window_close_diffs_shared_counters(tmp_path):
+    """The online path: phase_us_* counter deltas become a window's
+    per-completion decomposition (deterministic — deadlines forced)."""
+    sent = make_sentinel(tmp_path, min_completions=1, warmup_windows=1)
+    m = sent.metrics
+    sent._deadline = 0.0
+    sent.maybe_tick(now=1.0)                     # anchor close
+    assert sent.windows_total == 0               # anchor records nothing
+    m.counters["engine_completed"].inc(10)
+    m.counters["engine_admitted"].inc(10)
+    m.counters["engine_admission_waves"].inc(5)
+    m.counters["engine_host_syncs"].inc(20)
+    m.counters["phase_us_queue_wait"].inc(50_000)    # 50 ms total
+    m.counters["phase_us_prefill"].inc(100_000)
+    m.counters["phase_us_decode"].inc(200_000)
+    m.counters["phase_us_host_sync"].inc(5_000)
+    sent._deadline = 0.0
+    sent.maybe_tick(now=2.0)
+    assert sent.windows_total == 1
+    w = sent.last_window
+    assert w["completed"] == 10
+    assert w["admission_waves"] == 5
+    assert w["per_completion_ms"]["queue_wait"] == pytest.approx(5.0)
+    assert w["per_completion_ms"]["prefill"] == pytest.approx(10.0)
+    assert w["per_completion_ms"]["decode"] == pytest.approx(20.0)
+    assert w["mean_wave_size"] == pytest.approx(2.0)
+    # a window became the baseline (warmup_windows=1)
+    assert sent.baseline is not None
+
+
+def api_drive(coro_fn, tmp_path, serving=None):
+    async def runner():
+        db = SwarmDB(broker=LocalBroker(), save_dir=str(tmp_path / "hist"))
+        app = create_app(db, CFG, serving=serving)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client, db)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+async def admin_headers(client):
+    r = await client.post("/auth/token", json={"username": "admin",
+                                               "password": "pw"})
+    assert r.status == 200
+    return {"Authorization":
+            f"Bearer {(await r.json())['access_token']}"}
+
+
+def test_admin_slo_reflects_breach_and_metrics_gauges(tmp_path):
+    async def drive(client, db):
+        hdrs = await admin_headers(client)
+        # non-admin rejected
+        r = await client.post("/auth/token", json={"username": "u",
+                                                   "password": "p"})
+        user = {"Authorization":
+                f"Bearer {(await r.json())['access_token']}"}
+        r = await client.get("/admin/slo", headers=user)
+        assert r.status == 403
+
+        db.sentinel.config.warmup_windows = 1
+        db.sentinel.enabled = True
+        db.sentinel.ingest(HEALTHY)
+        alert = db.sentinel.ingest(SERIALIZED)
+        assert alert is not None
+
+        r = await client.get("/admin/slo", headers=hdrs)
+        assert r.status == 200
+        slo = await r.json()
+        assert slo["breached"] is True
+        assert slo["alerts_total"] == 1
+        assert slo["alerts"][0]["dominant"] == "admission_serialization"
+        assert abs(sum(slo["alerts"][0]["diagnosis"]["shares"]
+                       .values()) - 1.0) < 1e-3
+        assert slo["baseline"] is not None
+        assert slo["config"]["window_s"] == db.sentinel.config.window_s
+
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert "swarmdb_slo_breached 1" in text
+        assert "swarmdb_slo_alerts_total 1" in text
+        assert 'swarmdb_slo_per_completion_ms{category="queue_wait"}' \
+            in text
+
+    api_drive(drive, tmp_path)
+
+
+def test_exemplar_resolves_via_trace_export(tmp_path):
+    """A tail TTFT bucket's exemplar trace id must open a real request
+    timeline through /admin/trace/export?trace_id=."""
+    rid = "req-exemplar-1"
+    t0 = time.time() - 45.0
+    TRACER.span_at("engine.admit", t0, t0 + 44.0, cat="engine", rid=rid)
+    HIST_TTFT.observe(45.0, rid)                  # tail: le=60 bucket
+
+    async def drive(client, db):
+        hdrs = await admin_headers(client)
+        r = await client.get("/admin/slo", headers=hdrs)
+        slo = await r.json()
+        ttft_ex = slo["exemplars"].get("ttft_seconds", [])
+        entry = next(e for e in ttft_ex if e["trace_id"] == rid)
+        assert entry["le"] == "60"
+        assert entry["value_s"] == pytest.approx(45.0)
+        assert entry["export"] == f"/admin/trace/export?trace_id={rid}"
+
+        # the link resolves to the recorded span
+        r = await client.get(entry["export"], headers=hdrs)
+        assert r.status == 200
+        trace = await r.json()
+        rids = {(e.get("args") or {}).get("rid")
+                for e in trace["traceEvents"] if e.get("ph") == "X"}
+        assert rid in rids
+
+        # OpenMetrics exemplar syntax on /metrics
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert f'# {{trace_id="{rid}"}}' in text
+
+    api_drive(drive, tmp_path)
+
+
+def test_trace_export_lists_dead_thread_rings(tmp_path):
+    """ISSUE 7 satellite: export metadata declares how many dead-thread
+    rings are retained and how old their newest event is, so a consumer
+    can tell 'still present' from 'already evicted'."""
+    def record():
+        TRACER.instant("short.lived", cat="test", rid="dead-ring-probe")
+
+    t = threading.Thread(target=record, name="short-lived")
+    t.start()
+    t.join()
+    trace = TRACER.to_chrome_trace()
+    meta = trace["metadata"]["dead_thread_rings"]
+    assert meta["count"] >= 1
+    # the cap is enforced at the NEXT ring registration, so count may
+    # transiently exceed it between registrations — only its presence
+    # and sanity are contractual
+    assert meta["retain_cap"] >= 1
+    assert meta["newest_event_age_s"] is not None
+    assert meta["newest_event_age_s"] >= 0.0
+    # the dead thread's event is still in the export
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "short.lived" in names
+
+
+def test_env_knobs_disable_histograms_sentinel_exemplars(monkeypatch):
+    # SWARMDB_HISTOGRAMS=0: registry-born histograms never record
+    monkeypatch.setenv("SWARMDB_HISTOGRAMS", "0")
+    reg = HistogramRegistry()
+    h = reg.register("off_seconds", (0.1, 1.0))
+    h.observe(0.5, "rid-1")
+    assert sum(h.counts) == 0
+    assert h.exemplars() == []
+
+    # SWARMDB_EXEMPLARS=0: counts recorded, exemplars not retained
+    monkeypatch.delenv("SWARMDB_HISTOGRAMS", raising=False)
+    monkeypatch.setenv("SWARMDB_EXEMPLARS", "0")
+    h2 = Histogram("noex_seconds", (0.1, 1.0))
+    h2.observe(0.5, "rid-2")
+    assert sum(h2.counts) == 1
+    assert h2.exemplars() == []
+
+    # SWARMDB_SENTINEL=0: disabled sentinel never closes windows
+    monkeypatch.setenv("SWARMDB_SENTINEL", "0")
+    sent = SLOSentinel(metrics=MetricsRegistry())
+    assert sent.enabled is False
+    sent._deadline = 0.0
+    sent.maybe_tick(now=time.monotonic() + 100.0)
+    assert sent.windows_total == 0
+    assert sent.status()["enabled"] is False
+
+
+def _load_bench_trend():
+    path = (Path(__file__).resolve().parent.parent / "scripts"
+            / "bench_trend.py")
+    spec = importlib.util.spec_from_file_location("bench_trend", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trend_attributes_regression(tmp_path):
+    bt = _load_bench_trend()
+    base = {"metric": "m", "value": 100.0, "mode": "all", "modes": {
+        "serve": {"v": 100.0,
+                  "ph": {"q": 0.10, "p": 0.20, "d": 0.60, "h": 0.10}},
+        "echo": {"v": 4000.0},
+    }}
+    test = {"metric": "m", "value": 40.0, "mode": "all", "modes": {
+        "serve": {"v": 40.0,
+                  "ph": {"q": 0.70, "p": 0.10, "d": 0.15, "h": 0.05}},
+        "echo": {"v": 4100.0},
+    }}
+    b, t = tmp_path / "BENCH_r08.json", tmp_path / "BENCH_r09.json"
+    b.write_text(json.dumps({"n": 8, "parsed": base}))
+    t.write_text(json.dumps({"n": 9, "parsed": test}))
+    report = bt.build_report(str(b), str(t), threshold=0.15)
+    assert report["regressed_modes"] == ["serve"]
+    serve = next(v for v in report["modes"] if v["mode"] == "serve")
+    assert serve["dominant"] == "admission_serialization"
+    shares = serve["attribution"]["shares"]
+    assert abs(sum(shares.values()) - 1.0) < 1e-3
+    # report-only by default, enforce flips the exit code
+    assert bt.main([str(b), str(t)]) == 0
+    assert bt.main([str(b), str(t), "--enforce"]) == 1
+    # the repo's own checked-in trajectory stays loadable end-to-end
+    assert bt.main([]) == 0
+
+
+def test_bench_trend_pairs_without_phase_shares(tmp_path):
+    bt = _load_bench_trend()
+    base = {"parsed": {"modes": {"serve": {"v": 50.0, "p50": 1.0}}}}
+    test = {"parsed": {"modes": {"serve": {"v": 10.0, "p50": 6.0}}}}
+    b, t = tmp_path / "a.json", tmp_path / "b.json"
+    b.write_text(json.dumps(base))
+    t.write_text(json.dumps(test))
+    report = bt.build_report(str(b), str(t), threshold=0.15)
+    serve = report["modes"][0]
+    assert serve["regressed"] is True
+    assert serve["attribution"] is None
+    assert "p50_send_to_first_token_s" in serve["signals"]

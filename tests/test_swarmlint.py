@@ -41,6 +41,7 @@ def expected_findings(path: Path):
     "tracer_leak_bad.py",       # tracer-leak family (SWL401)
     "span_bad.py",              # span-discipline family (SWL501/502)
     "metrics_bad.py",           # histogram discipline (SWL503)
+    "exemplar_bad.py",          # exemplar/sentinel allocation (SWL504)
     "heartbeat_bad.py",         # heartbeat-safety family (SWL601/602)
 ])
 def test_each_family_detects_seeded_violations(name):
@@ -127,5 +128,5 @@ def test_cli_module_smoke():
         cwd=str(REPO), capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
     for rule in ("SWL101", "SWL203", "SWL301", "SWL401", "SWL501",
-                 "SWL502", "SWL503", "SWL601", "SWL602"):
+                 "SWL502", "SWL503", "SWL504", "SWL601", "SWL602"):
         assert rule in proc.stdout
