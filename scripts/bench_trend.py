@@ -11,10 +11,10 @@ contributor from the per-mode phase shares that ``bench.py`` now embeds
 in the compact summary (``ph``: q=queue_wait p=prefill d=decode
 h=host_sync r=reply_emit).
 
-Report-only by default (CI runs it that way first — the checked-in
-records predate the ``ph`` field and several known regressions, dpserve
-dpx=0.22 among them, are already on the books); ``--enforce`` makes
-regressions fail the job once the trend is clean.
+Report-only by default; CI runs ``--enforce`` (armed by ISSUE 8 once
+dpserve's dpx=0.22 regression was fixed), which makes any regression —
+including a drop in dpserve's ``dp_scaling_x``, guarded as a
+first-class number wherever both records carry ``dpx`` — fail the job.
 
 Usage::
 
@@ -164,6 +164,19 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
             "ratio": round(ratio, 3),
             "regressed": ratio < (1.0 - threshold),
         }
+        # dp_scaling_x is a first-class guarded number (ISSUE 8): dpserve
+        # throughput can hold steady while the dp8/dp1 ratio collapses
+        # (a dp1 speedup the sharded path missed), so the gate watches
+        # the ratio itself wherever both records carry it
+        bdx, tdx = b.get("dpx"), t.get("dpx")
+        if isinstance(bdx, (int, float)) and isinstance(tdx, (int, float)) \
+                and bdx > 0:
+            entry["base_dpx"] = bdx
+            entry["test_dpx"] = tdx
+            entry["dpx_ratio"] = round(tdx / bdx, 3)
+            if tdx / bdx < (1.0 - threshold):
+                entry["regressed"] = True
+                entry["dpx_regressed"] = True
         if entry["regressed"]:
             bs, ts = _phase_summary(b), _phase_summary(t)
             if bs is not None and ts is not None:
@@ -200,6 +213,8 @@ def build_report(base_path: str, test_path: str,
                 f"{v['mode']} {v['base_msgs_per_sec']} -> "
                 f"{v['test_msgs_per_sec']} msgs/sec "
                 f"({v['ratio']}x)"
+                + (f", dp_scaling_x {v['base_dpx']} -> {v['test_dpx']}"
+                   if v.get("dpx_regressed") else "")
                 + (f", dominant {v['dominant']} "
                    f"({v['attribution']['shares'][v['dominant']]:.0%})"
                    if v.get("dominant") else ", unattributed")

@@ -43,6 +43,11 @@ Directive grammar (comments beginning ``# swarmlint:``):
 ``# swarmlint: device-state: <name>[, <name>]``
     Class-level taint declaration (hostsync.py): ``self.<name>`` holds
     device arrays, so host-materializing it in a hot function is a finding.
+``# swarmlint: sanctioned-drain [-- reason]``
+    On (or directly above) a host-sync call in hot code: this is a
+    declared per-REQUEST drain (the engine's one session/chunk sync), so
+    SWL101 stays quiet. Never applies inside a loop — a sync you loop
+    over is a per-iteration sync and stays an SWL105 finding (hostsync.py).
 """
 
 from __future__ import annotations
@@ -76,6 +81,11 @@ RULES: Dict[str, Rule] = {
         Rule("SWL102", "host-sync",
              "host materialization of a device value (.item() / np.asarray "
              "/ device_put) in a hot-path function"),
+        Rule("SWL105", "host-sync",
+             "host sync (device_get / block_until_ready) inside a LOOP in "
+             "hot-path code — a per-iteration sync serializes the device "
+             "pipeline; the `# swarmlint: sanctioned-drain` marker only "
+             "sanctions straight-line per-request drains, never loops"),
         Rule("SWL201", "recompile-hazard",
              "jax.jit called inside a loop or hot function — a fresh "
              "wrapper (and compile-cache miss) per call"),
@@ -189,12 +199,20 @@ class Directives:
     holds: Dict[int, str] = field(default_factory=dict)  # line -> guard
     device_state: List[Tuple[int, Tuple[str, ...]]] = field(
         default_factory=list)
+    # lines carrying `# swarmlint: sanctioned-drain` (hostsync SWL101/105)
+    sanctioned_drains: Set[int] = field(default_factory=set)
 
 
 def _parse_directive(body: str, line: int, out: Directives) -> None:
     body = body.strip()
     if body == "hot" or body.startswith("hot "):
         out.hot_lines.add(line)
+        return
+    if body == "sanctioned-drain" or body.startswith("sanctioned-drain"):
+        # declared per-request drain (hostsync SWL101/SWL105): consumed
+        # by the hostsync checker via its own line scan; registered here
+        # so the directive is part of the grammar, not an unknown
+        out.sanctioned_drains.add(line)
         return
     if body == "heartbeat" or body.startswith("heartbeat "):
         out.heartbeat_lines.add(line)

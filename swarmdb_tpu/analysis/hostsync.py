@@ -1,4 +1,4 @@
-"""host-sync checks (SWL101/SWL102).
+"""host-sync checks (SWL101/SWL102/SWL105).
 
 The engine's throughput contract is "one host sync per decode chunk"
 (backend/engine.py module docstring): on this image's tunneled TPU every
@@ -20,6 +20,14 @@ decorator).
   declared ``# swarmlint: device-state``. Plain numpy-on-host work (the
   admission path builds its dispatch arguments with numpy on purpose —
   the transfer rides the jit call) is NOT flagged.
+- SWL105: a host sync lexically inside a ``for``/``while`` loop in hot
+  code — a per-ITERATION sync, the exact shape the device-resident
+  decode loop (engine emission ring, ISSUE 8) exists to remove. The
+  ``# swarmlint: sanctioned-drain`` marker (same line, or a comment
+  line directly above) declares a legitimate straight-line per-request
+  drain and quiets SWL101 there; it NEVER applies inside a loop — a
+  drain you loop over is a per-chunk sync wearing a costume, and stays
+  an SWL105 finding.
 """
 
 from __future__ import annotations
@@ -134,10 +142,57 @@ class _Taint:
         return False
 
 
+SANCTIONED_DRAIN_RE = None  # compiled lazily (keep import surface tiny)
+
+
+def _sanctioned_lines(src: SourceFile) -> Set[int]:
+    """Code lines covered by a ``# swarmlint: sanctioned-drain`` marker:
+    the marker's own line (inline form), or — when the marker opens a
+    standalone comment block — the first code line after the block."""
+    import re
+
+    global SANCTIONED_DRAIN_RE
+    if SANCTIONED_DRAIN_RE is None:
+        SANCTIONED_DRAIN_RE = re.compile(
+            r"#\s*swarmlint:\s*sanctioned-drain\b")
+    out: Set[int] = set()
+    for idx, line in enumerate(src.lines):
+        if not SANCTIONED_DRAIN_RE.search(line):
+            continue
+        lineno = idx + 1
+        out.add(lineno)
+        if line.lstrip().startswith("#"):
+            # standalone comment: sanction the first code line below
+            j = idx + 1
+            while j < len(src.lines):
+                stripped = src.lines[j].strip()
+                if stripped and not stripped.startswith("#"):
+                    out.add(j + 1)
+                    break
+                j += 1
+    return out
+
+
+def _loop_spans(fn: ast.AST) -> List[Tuple[int, int]]:
+    """(first, last) line spans of every loop BODY inside ``fn`` (the
+    header line is excluded so `for x in jax.device_get(...)` — a
+    one-time pre-loop sync — stays SWL101 territory)."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            body = list(node.body) + list(node.orelse)
+            if body:
+                last = max(getattr(b, "end_lineno", b.lineno)
+                           for b in body)
+                spans.append((body[0].lineno, last))
+    return spans
+
+
 def check(src: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     jitted = _collect_jitted_names(src.tree)
     device_state = _device_state_of(src)
+    sanctioned = _sanctioned_lines(src)
 
     # (hot function, enclosing class) pairs, hotness propagated into
     # nested defs
@@ -161,6 +216,27 @@ def check(src: SourceFile) -> List[Finding]:
     for fn, cls in hot_fns:
         attrs = device_state.get(cls, set()) if cls is not None else set()
         taint = _Taint(fn, jitted, attrs)
+        loops = _loop_spans(fn)
+
+        def _in_loop(lineno: int) -> bool:
+            return any(lo <= lineno <= hi for lo, hi in loops)
+
+        def _sync_finding(node: ast.AST, what: str) -> Optional[Finding]:
+            if _in_loop(node.lineno):
+                return make_finding(
+                    src, "SWL105", node,
+                    f"{what} inside a LOOP in hot function `{fn.name}` — "
+                    f"a per-iteration host sync; fold the loop on-device "
+                    f"(lax.while_loop + emission ring) or drain once "
+                    f"outside it")
+            if node.lineno in sanctioned:
+                return None  # declared per-request drain, straight-line
+            return make_finding(
+                src, "SWL101", node,
+                f"{what} inside hot function `{fn.name}` — every sync "
+                f"here serializes the decode pipeline (mark a legitimate "
+                f"per-request drain with `# swarmlint: sanctioned-drain`)")
+
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -170,19 +246,16 @@ def check(src: SourceFile) -> List[Finding]:
             name = dotted_name(node.func)
             if name in SYNC_CALLS:
                 seen_lines.add(key)
-                findings.append(make_finding(
-                    src, "SWL101", node,
-                    f"`{name}` blocks on the device inside hot function "
-                    f"`{fn.name}` — every sync here serializes the decode "
-                    f"pipeline"))
+                f = _sync_finding(node, f"`{name}`")
+                if f is not None:
+                    findings.append(f)
                 continue
             if (isinstance(node.func, ast.Attribute)
                     and node.func.attr == "block_until_ready"):
                 seen_lines.add(key)
-                findings.append(make_finding(
-                    src, "SWL101", node,
-                    f"`.block_until_ready()` inside hot function "
-                    f"`{fn.name}` blocks the decode pipeline"))
+                f = _sync_finding(node, "`.block_until_ready()`")
+                if f is not None:
+                    findings.append(f)
                 continue
             if (isinstance(node.func, ast.Attribute)
                     and node.func.attr in MATERIALIZE_METHODS

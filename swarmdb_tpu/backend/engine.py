@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import io_callback
 
 from ..obs import TRACER, FlightRecorder
 from ..obs.metrics import (HIST_DECODE_CHUNK, HIST_QUEUE_WAIT, HIST_TTFT)
@@ -114,6 +115,10 @@ class _Slot:
     cancelled: bool = False      # retire at the next processed block
     first_token_at: Optional[float] = None
     admitted_at: Optional[float] = None  # prefill start (flight timeline)
+    # engine-local host-sync count stamped at admission: retirement
+    # records how many sanctioned syncs this request's lifetime spanned
+    # (flight request timelines -> the host_syncs-per-request contract)
+    admit_syncs: int = 0
     # device-side next write position: advances by K at each DISPATCH
     # (pipelined chunks are issued before the previous block is read);
     # ``position`` stays the host-confirmed value, advanced at processing
@@ -208,6 +213,9 @@ class Engine:
         self.flight = FlightRecorder()
         self._flight_dir = flight_dir
         self._flight_last_had_work = False
+        # ShardLaneGroup sets this to the lane index: lanes share ONE
+        # flight recorder, and step records carry which lane wrote them
+        self.flight_shard: Optional[int] = None
         # online SLO sentinel (obs/sentinel.py): the runtime owns it (one
         # per process, shared metrics registry); ServingService points it
         # here so the engine loop drives window closes and breach dumps
@@ -416,6 +424,89 @@ class Engine:
         # ordered by parallel.multihost VARIANT_* codes
         self._decode_variants = (self._decode, self._decode_fast,
                                  self._decode_greedy)
+
+        # ---- device-resident decode sessions (emission ring) -------------
+        # One jitted ``lax.while_loop`` runs MANY decode chunks per host
+        # visit: each chunk's [K+1, B] token block is pushed host-ward
+        # through an ORDERED ``io_callback`` (the emission ring — the
+        # device runs one chunk ahead of host-side emission, so the
+        # stream is double-buffered by construction), and the host only
+        # touches the device ONCE per session: the drain read of the
+        # chunk counter after the loop exits. The callback's boolean
+        # return is the host's continue vote (new admissible work, a
+        # cancel, stop), consumed at the next chunk boundary — stop and
+        # stream emission are serviced from the ring without ever
+        # blocking the device loop. Single-shard PAGED engines only: the
+        # shard_map'd multi-device program and the pod control plane
+        # keep the per-chunk scan+pipeline path (SWARMDB_EMIT_RING=0
+        # forces that path everywhere).
+        self._resident_variants: Optional[Tuple[Any, ...]] = None
+        self._resident_snap: Optional[List[Tuple[int, GenRequest, int]]] \
+            = None
+        self._resident_prev_ns = 0
+        self._lane_busy = False
+        self._host_sync_n = 0  # engine-LOCAL sync count (registry
+        # counters are shared across lanes, so per-request deltas must
+        # not absorb sibling engines' syncs)
+        if (paged is not None
+                and getattr(paged.allocator, "n_shards", 1) <= 1
+                and os.environ.get("SWARMDB_EMIT_RING", "1") != "0"):
+
+            def _decode_resident(params, last_tokens, last_lps, positions,
+                                 cache, base_keys, temp, topk, topp,
+                                 stop_pos, live, max_chunks, *,
+                                 use_filters, assume_greedy=False):
+                # stop_pos [B]: first position at/after which the slot
+                # needs no more tokens (max_new_tokens bound; the host
+                # remains the authority on exact retirement — the device
+                # estimate only decides when the LOOP may stop). live
+                # [B]: slots participating in this session; dead lanes
+                # start done and compute discarded garbage, exactly like
+                # the scan path.
+                def cond(carry):
+                    n, done, cont = carry[0], carry[1], carry[2]
+                    return (n < max_chunks) & cont & ~jnp.all(done)
+
+                def body(carry):
+                    n, done, cont, lt, llp, pos, cache = carry
+                    all_toks, all_lps, lt, llp, cache = _decode(
+                        params, lt, llp, pos, cache, base_keys, temp,
+                        topk, topp, use_filters=use_filters,
+                        assume_greedy=assume_greedy)
+                    pos = pos + K
+                    # eos anywhere in the block (row 0 = fed token covers
+                    # an eos prefill sample) marks the lane done; done
+                    # lanes keep computing garbage the host discards
+                    done = (done | (pos >= stop_pos)
+                            | jnp.any(all_toks == self.eos_id, axis=0))
+                    cont = io_callback(
+                        self._resident_emit,
+                        jax.ShapeDtypeStruct((), jnp.bool_),
+                        all_toks, all_lps, n, ordered=True)
+                    return (n + 1, done, cont, lt, llp, pos, cache)
+
+                init = (jnp.int32(0), ~live, jnp.bool_(True), last_tokens,
+                        last_lps, positions, cache)
+                n, _done, _cont, lt, llp, _pos, cache = jax.lax.while_loop(
+                    cond, body, init)
+                return n, lt, llp, cache
+
+            self._resident_variants = tuple(
+                jax.jit(functools.partial(_decode_resident,
+                                          use_filters=uf,
+                                          assume_greedy=ag),
+                        donate_argnums=donate)
+                for uf, ag in ((True, False), (False, False),
+                               (False, True)))
+        # set by ShardLaneGroup: returns True when a SIBLING lane has a
+        # decode session in flight while this lane admits — the overlap
+        # the per-shard lanes exist to create (flight/SLO counter
+        # ``engine_admission_overlap_steps``)
+        self.overlap_probe: Optional[Callable[[], bool]] = None
+        # single-device lane placement (ShardLaneGroup): state rebuilds
+        # (restart / in-loop error recovery) must land on THIS device,
+        # not the process default
+        self._home_device = None
         # multi-host control plane (parallel/multihost.py): set by
         # enable_multihost(); when active, every device call is published
         # so worker hosts replay it in lockstep
@@ -429,6 +520,28 @@ class Engine:
         if prefill_batch is None:
             prefill_batch = 8
         self.prefill_batch = max(1, min(prefill_batch, max_batch))
+        # ---- row-bucketed waves (per-shard admission lanes) ---------------
+        # A lane's waves are small (<= slots-per-lane) and often partial;
+        # padding the ROW dimension to prefill_batch unconditionally made
+        # the lane path pay ~4x its real prefill compute (measured 78%
+        # grid padding on the dp8 bench vs 11% at dp1). Small-batch PAGED
+        # engines therefore pad rows to the smallest power-of-two bucket
+        # covering the admission count instead. Each row bucket is a
+        # compiled variant (warmup covers rows x buckets x widths), so
+        # the ladder is gated to prefill_batch <= 4 — exactly the lane
+        # geometry — unless SWARMDB_PREFILL_ROWS forces it (1) or off (0).
+        rows_env = os.environ.get("SWARMDB_PREFILL_ROWS", "auto")
+        row_bucketed = (paged is not None
+                        and getattr(paged.allocator, "n_shards", 1) <= 1
+                        and (self.prefill_batch <= 4
+                             if rows_env == "auto" else rows_env != "0"))
+        if row_bucketed:
+            ladder = [1]
+            while ladder[-1] < self.prefill_batch:
+                ladder.append(min(self.prefill_batch, ladder[-1] * 2))
+            self._row_buckets = ladder
+        else:
+            self._row_buckets = [self.prefill_batch]
 
         # ---- fused dense prefill: forward + sample + cache insert + fed-
         # token scatter in ONE compiled dispatch per admission group.
@@ -971,8 +1084,9 @@ class Engine:
         B = self.max_batch
         sh = getattr(self, "_state_sharding", None)
         if sh is None:
-            return (jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), jnp.float32))
+            with self._device_ctx():
+                return (jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), jnp.float32))
         return (
             jax.jit(lambda: jnp.zeros((B,), jnp.int32), out_shardings=sh)(),
             jax.jit(lambda: jnp.zeros((B,), jnp.float32),
@@ -1152,11 +1266,23 @@ class Engine:
             return self.paged.allocator.free_count()
         return self._prefix.free_count()
 
+    def _device_ctx(self):
+        """Placement scope for device-state rebuilds: lane engines
+        (ShardLaneGroup) live on ONE specific device, and a recovery
+        path that rebuilds the pool under the process default device
+        would silently mix devices into the next dispatch."""
+        if self._home_device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return jax.default_device(self._home_device)
+
     def _fresh_cache(self):
-        if self.paged:
-            self.paged.allocator.reset()
-            return self.paged.init_pool()
-        return self._prefill_cache_fn(self.max_batch, self.max_seq)
+        with self._device_ctx():
+            if self.paged:
+                self.paged.allocator.reset()
+                return self.paged.init_pool()
+            return self._prefill_cache_fn(self.max_batch, self.max_seq)
 
     def warmup(self) -> float:
         """Pre-compile every jitted variant the serving loop can hit — the
@@ -1215,6 +1341,20 @@ class Engine:
             )
             jax.block_until_ready(all_toks)
 
+        if self._use_resident():
+            # resident-session variants: with live all-False the
+            # while_loop body never executes (no emission fires) but the
+            # program still compiles; state passes through the donation
+            no_live = np.zeros((self.max_batch,), bool)
+            for fn in self._resident_variants:
+                (_n, self._last_tokens, self._last_lps, self.cache) = fn(
+                    self.params, self._last_tokens, self._last_lps,
+                    positions, self.cache, self._base_keys_np, self._temp,
+                    self._topk, self._topp, positions, no_live,
+                    np.int32(0),
+                )
+            jax.block_until_ready(self._last_tokens)
+
         Bp = self.prefill_batch
         lengths = np.ones(Bp, np.int32)
         zero_i = np.zeros(Bp, np.int32)
@@ -1242,12 +1382,20 @@ class Engine:
                         np.ones(R, np.float32),
                     )
                 else:
-                    drop = np.full(Bp, self.max_batch, np.int32)
-                    self._mirrored(
-                        self.CALL_PAGED_PREFILL, tokens, lengths,
-                        np.zeros((Bp, chunks), np.int32), drop, keys,
-                        zero_f, zero_i, ones_f,
-                    )
+                    # one variant per ROW bucket too (lane engines pad
+                    # waves to the admission count's bucket, not Bp)
+                    for rb in self._row_buckets:
+                        self._mirrored(
+                            self.CALL_PAGED_PREFILL,
+                            np.full((rb, bucket), self.pad_id, np.int32),
+                            np.ones(rb, np.int32),
+                            np.zeros((rb, chunks), np.int32),
+                            np.full(rb, self.max_batch, np.int32),
+                            self._base_keys_np[np.zeros(rb, np.int64)],
+                            np.zeros(rb, np.float32),
+                            np.zeros(rb, np.int32),
+                            np.ones(rb, np.float32),
+                        )
             else:
                 drop = np.full(Bp, self.max_batch, np.int32)
                 if self._mh is not None:
@@ -1269,13 +1417,21 @@ class Engine:
                     tokens = np.full((Bp, bucket), self.pad_id, np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        self._mirrored(
-                            self.CALL_PAGED_PREFIX_PREFILL, tokens,
-                            lengths, np.zeros(Bp, np.int32),
-                            np.zeros((Bp, ppb), np.int32),
-                            np.zeros((Bp, chunks), np.int32), drop, keys,
-                            zero_f, zero_i, ones_f,
-                        )
+                        for rb in self._row_buckets:
+                            self._mirrored(
+                                self.CALL_PAGED_PREFIX_PREFILL,
+                                np.full((rb, bucket), self.pad_id,
+                                        np.int32),
+                                np.ones(rb, np.int32),
+                                np.zeros(rb, np.int32),
+                                np.zeros((rb, ppb), np.int32),
+                                np.zeros((rb, chunks), np.int32),
+                                np.full(rb, self.max_batch, np.int32),
+                                self._base_keys_np[np.zeros(rb, np.int64)],
+                                np.zeros(rb, np.float32),
+                                np.zeros(rb, np.int32),
+                                np.ones(rb, np.float32),
+                            )
                         if self._warm_resume():
                             # rolling-KV resume variants (gated: each is a
                             # 30-90 s compile on the tunneled service and
@@ -1382,6 +1538,16 @@ class Engine:
         for decode in self._decode_variants:
             plan.append((decode, (params_s, lt_s, llp_s, i32_B, cache_s,
                                   keys_B, f32_B, i32_B, f32_B)))
+        if self._use_resident():
+            # resident sessions carry host callbacks, which jax refuses
+            # to serialize into the persistent cache — the AOT compile
+            # still validates the specs, and warmup's jit execution adds
+            # zero persistent entries either way (drift test invariant)
+            bool_B = sds((B,), np.bool_)
+            for fn in self._resident_variants:
+                plan.append((fn, (params_s, lt_s, llp_s, i32_B, cache_s,
+                                  keys_B, f32_B, i32_B, f32_B, i32_B,
+                                  bool_B, sds((), np.int32))))
 
         keys_Bp = sds((Bp,) + self._base_keys_np.shape[1:], key_dt)
         i32_Bp, f32_Bp = sds((Bp,), np.int32), sds((Bp,), np.float32)
@@ -1400,10 +1566,16 @@ class Engine:
                         lt_s, llp_s, keys_R, sds((R,), np.float32),
                         sds((R,), np.int32), sds((R,), np.float32))))
                     continue
-                plan.append((self._prefill_paged_fused, (
-                    params_s, tok, i32_Bp, sds((Bp, chunks), np.int32),
-                    i32_Bp, cache_s["k"], cache_s["v"], lt_s, llp_s,
-                    keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+                for rb in self._row_buckets:
+                    keys_rb = sds((rb,) + self._base_keys_np.shape[1:],
+                                  key_dt)
+                    i32_rb, f32_rb = (sds((rb,), np.int32),
+                                      sds((rb,), np.float32))
+                    plan.append((self._prefill_paged_fused, (
+                        params_s, sds((rb, bucket), np.int32), i32_rb,
+                        sds((rb, chunks), np.int32), i32_rb,
+                        cache_s["k"], cache_s["v"], lt_s, llp_s,
+                        keys_rb, f32_rb, i32_rb, f32_rb)))
             else:
                 plan.append((self._prefill_fused, (
                     params_s, tok, i32_Bp, i32_Bp, cache_s, lt_s, llp_s,
@@ -1415,11 +1587,18 @@ class Engine:
                     table = sds((Bp, ppb), np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        plan.append((self._prefill_paged_prefix_fused, (
-                            params_s, tok, i32_Bp, i32_Bp, table,
-                            sds((Bp, chunks), np.int32), i32_Bp,
-                            cache_s["k"], cache_s["v"], lt_s, llp_s,
-                            keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
+                        for rb in self._row_buckets:
+                            keys_rb = sds(
+                                (rb,) + self._base_keys_np.shape[1:],
+                                key_dt)
+                            i32_rb, f32_rb = (sds((rb,), np.int32),
+                                              sds((rb,), np.float32))
+                            plan.append((self._prefill_paged_prefix_fused, (
+                                params_s, sds((rb, bucket), np.int32),
+                                i32_rb, i32_rb, sds((rb, ppb), np.int32),
+                                sds((rb, chunks), np.int32), i32_rb,
+                                cache_s["k"], cache_s["v"], lt_s, llp_s,
+                                keys_rb, f32_rb, i32_rb, f32_rb)))
                         if self._warm_resume():
                             maxp = self.paged.allocator.maxp
                             plan.append((self._prefill_paged_resume_fused, (
@@ -1626,6 +1805,21 @@ class Engine:
                 break
             try:
                 self._admit()
+                if self._use_resident():
+                    # device-resident session: the while_loop runs chunks
+                    # until all lanes finish or the host votes to stop
+                    # (admissible work / cancel) through the emission
+                    # ring's callback return; ONE host sync per session.
+                    # The flight step is recorded POST-admission, PRE-
+                    # session: a session boundary is the one moment
+                    # occupancy is transiently low (retired slots not yet
+                    # refilled), and sampling only there would read a
+                    # fully-loaded engine as stalled-with-free-slots
+                    # (admission_stall_frac would be garbage).
+                    self._flight_step(0)
+                    if self._any_active():
+                        self._run_resident()
+                    continue
                 if self._any_active():
                     in_flight.append(self._dispatch_decode())
                 while in_flight and (len(in_flight) >= self.pipeline_depth
@@ -1661,8 +1855,11 @@ class Engine:
                 # mid-step they may reference deleted buffers — rebuild both
                 # so the engine survives the error
                 try:
-                    self._last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
-                    self._last_lps = jnp.zeros((self.max_batch,), jnp.float32)
+                    with self._device_ctx():
+                        self._last_tokens = jnp.zeros((self.max_batch,),
+                                                      jnp.int32)
+                        self._last_lps = jnp.zeros((self.max_batch,),
+                                                   jnp.float32)
                     self.cache = self._fresh_cache()
                     if self._prefix is not None:
                         # the rebuilt pool is zeroed and (paged) its pages
@@ -1688,6 +1885,8 @@ class Engine:
         in the flight record is a RECOMPILE landing mid-traffic — the
         exact stall class warmup exists to prevent."""
         fns: List[Any] = list(self._decode_variants)
+        if self._resident_variants is not None:
+            fns.extend(self._resident_variants)
         for name in ("_prefill_fused", "_prefill_paged_fused",
                      "_prefill_paged_packed", "_prefill_paged_prefix_fused",
                      "_prefill_paged_resume_fused", "_prefill_prefix_fused",
@@ -1743,6 +1942,16 @@ class Engine:
             "restarts": c["engine_restarts"].value,
             "compiled_variants": self._compiled_count(),
         }
+        if self._use_resident():
+            # evidence-quality marker for the analyzer's stall split:
+            # resident-path steps sample occupancy right AFTER admission
+            # (the loop records pre-session), so active-vs-queued is a
+            # trustworthy admission-stall signal; scan-path steps sample
+            # mid-pipeline and stay unmarked (analyze._queue_split only
+            # trusts marked dumps)
+            rec["occ_at_admit"] = True
+        if self.flight_shard is not None:
+            rec["shard"] = self.flight_shard
         if self._prefix is not None:
             ps = self._prefix.stats()
             rec["prefix_hit_tokens"] = ps["hit_tokens"]
@@ -2178,6 +2387,15 @@ class Engine:
                 return b
         return self.prefill_buckets[-1]
 
+    def _rows_for(self, n: int) -> int:
+        """Smallest row bucket covering an ``n``-admission wave (the wave
+        arrays' leading dimension; [prefill_batch] unless the engine is
+        row-bucketed — see __init__)."""
+        for rb in self._row_buckets:
+            if n <= rb:
+                return rb
+        return self._row_buckets[-1]
+
     def _set_slot_key(self, slot_id: int, seed) -> None:
         """Per-request PRNG seed: rewrite the slot's key row (host array;
         the keys ride every dispatch as a numpy argument, so this costs
@@ -2250,7 +2468,7 @@ class Engine:
         per admission wave (see ``_prefill_paged_prefix_insert``)."""
         t0 = time.time()
         ps = self._prefix_ps
-        Bp = self.prefill_batch
+        Bp = self._rows_for(len(batch))  # row-bucketed wave (lanes)
         chunks = -(-bucket // ps)
         padded = np.full((Bp, bucket), self.pad_id, np.int32)
         lengths = np.ones(Bp, np.int32)
@@ -2462,8 +2680,10 @@ class Engine:
         vector and surface as row 0 of the next chunk's block.
         """
         t0 = time.time()
-        Bp = self.prefill_batch
         n = len(batch)
+        # row-bucketed wave (lane engines): pay for the admissions the
+        # wave actually has, not prefill_batch unconditionally
+        Bp = self._rows_for(n)
         longest = max(len(req.prompt) for _, req in batch)
         bucket = self._bucket_for(longest)
         padded = np.full((Bp, bucket), self.pad_id, np.int32)
@@ -2581,6 +2801,7 @@ class Engine:
             slot.generated = []
             slot.logprobs = []
             slot.pending_first = True
+            slot.admit_syncs = self._host_sync_n
             with self._cv:
                 self._admitting.discard(req.request_id)
                 # cancelled while the prefill was in flight: retire at the
@@ -2614,6 +2835,16 @@ class Engine:
         prefill_dt = time.time() - t0
         self._lat_prefill.observe(prefill_dt)
         self.metrics.counters["engine_admission_waves"].inc()
+        if self.overlap_probe is not None:
+            # per-shard lanes: count waves whose prefill dispatch ran
+            # while a SIBLING lane's decode session was in flight — the
+            # overlap that a single global admission wave can never have
+            try:
+                if self.overlap_probe():
+                    self.metrics.counters[
+                        "engine_admission_overlap_steps"].inc()
+            except Exception:  # probe is advisory telemetry only
+                pass
         self.metrics.counters["phase_us_prefill"].inc(
             max(0, int(prefill_dt * 1e6)))
         for slot_id, req in batch:
@@ -2624,6 +2855,142 @@ class Engine:
                       "mid": req.metadata.get("message_id")})
 
     # --------------------------------------------------------------- decode
+
+    def _use_resident(self) -> bool:
+        """Whether the loop runs device-resident decode sessions instead
+        of per-chunk scan dispatches. ONE gate shared by the loop,
+        warmup() and warmup_call_plan() (same drift contract as
+        _packed_active): built only for single-shard paged engines, and
+        pod mode falls back — worker hosts replay per-call, and a
+        host-steered while_loop cannot be mirrored."""
+        return self._resident_variants is not None and self._mh is None
+
+    # swarmlint: hot
+    def _resident_emit(self, block, lps, n) -> np.bool_:
+        """Ordered io_callback target: one call per device chunk, on the
+        runtime's callback thread. The engine thread is parked in the
+        session drain for the whole session, and ordered callbacks are
+        serialized, so this thread IS the engine thread's stand-in:
+        token emission, retirement, and stream callbacks all run here,
+        without the device loop ever waiting on a host round-trip (the
+        return value is consumed one chunk later — double-buffered).
+        Never raises: an exception here would poison the device program
+        mid-flight, so failures vote to stop the loop instead."""
+        try:
+            snap = self._resident_snap
+            if snap is None:
+                return np.bool_(False)
+            n = int(n)
+            K = self.decode_chunk
+            snapshot = [(i, req, pos0 + n * K) for i, req, pos0 in snap]
+            now_ns = time.monotonic_ns()
+            self._process_host_block(np.asarray(block), np.asarray(lps),
+                                     snapshot, self._resident_prev_ns)
+            self._resident_prev_ns = now_ns
+            return np.bool_(self._resident_should_continue())
+        except Exception:
+            logger.exception("emission-ring block processing failed; "
+                             "stopping the resident session")
+            return np.bool_(False)
+
+    # swarmlint: hot
+    def _resident_should_continue(self) -> bool:
+        """The host's continue vote, evaluated once per emitted chunk.
+        Stop when: the engine is stopping, nothing is active anymore
+        (every lane retired host-side — the device's own done mask can
+        lag a cancel), or queued work could be admitted into a freed
+        slot (exit -> admit -> new session). Reads of _stop/slots are
+        deliberately lock-light: a stale verdict is corrected at the
+        next chunk boundary."""
+        # racy-by-design: a stale verdict costs ONE extra chunk, while
+        # taking _cv here would put lock acquisition on every emitted
+        # chunk of every lane
+        if self._stop:  # swarmlint: disable=SWL301 -- chunk-granular race is benign
+            return False
+        active = any(s.active for s in self.slots)
+        if not active:
+            return False
+        with self._cv:
+            queued = len(self._queue)
+        if queued and any(not s.active for s in self.slots):
+            return False
+        return True
+
+    # swarmlint: hot
+    def _run_resident(self) -> None:
+        """Dispatch one device-resident decode session and drain it.
+
+        The session covers every currently-active slot; admission happens
+        only between sessions (the continue vote exits the loop when
+        queued work meets a free slot). Host<->device traffic for the
+        whole session: the dispatch (no sync) and ONE drain read of the
+        chunk counter — a request admitted and retired within a session
+        therefore spans a single sanctioned sync, vs one per chunk on
+        the scan path."""
+        B = self.max_batch
+        K = self.decode_chunk
+        positions = np.zeros((B,), np.int32)
+        stop_pos = np.zeros((B,), np.int32)
+        live = np.zeros((B,), bool)
+        snap: List[Tuple[int, GenRequest, int]] = []
+        needs_filters = False
+        needs_sampling = False
+        max_rem = 0
+        for i, s in enumerate(self.slots):
+            if not s.active:
+                continue
+            pos0 = s.dispatched_position
+            positions[i] = pos0
+            live[i] = True
+            # +1 covers the pending first token (row 0 of the first
+            # block); the device stops the LOOP here, the host still
+            # owns exact retirement semantics
+            rem = (s.request.sampling.max_new_tokens
+                   - len(s.generated) + 1)
+            stop_pos[i] = min(self.max_seq, pos0 + max(1, rem))
+            snap.append((i, s.request, pos0))
+            max_rem = max(max_rem, int(stop_pos[i]) - pos0)
+            if self._topk[i] > 0 or self._topp[i] < 1.0:
+                needs_filters = True
+            if self._temp[i] > 0:
+                needs_sampling = True
+        if not snap:
+            return
+        max_chunks = np.int32(-(-max(1, max_rem) // K) + 1)
+        variant = (0 if needs_filters else 1 if needs_sampling else 2)
+        fn = self._resident_variants[variant]
+        self._resident_snap = snap
+        self._resident_prev_ns = time.monotonic_ns()
+        self._lane_busy = True
+        try:
+            n_dev, lt, llp, cache = fn(
+                self.params, self._last_tokens, self._last_lps, positions,
+                self.cache, self._base_keys_np, self._temp, self._topk,
+                self._topp, stop_pos, live, max_chunks,
+            )
+            self._last_tokens, self._last_lps, self.cache = lt, llp, cache
+            t_sync0 = time.monotonic_ns()
+            # swarmlint: sanctioned-drain -- THE one sync per session:
+            # its resolution also guarantees every ordered emission
+            # callback has run, so slot state below is host-confirmed
+            n_chunks = int(jax.device_get(n_dev))
+            t_sync1 = time.monotonic_ns()
+            self.tracer.span_end(t_sync0, "engine.host_sync", cat="engine")
+            self.metrics.counters["engine_host_syncs"].inc()
+            self._host_sync_n += 1
+            self.metrics.counters["phase_us_host_sync"].inc(
+                (t_sync1 - t_sync0) // 1000)
+            self.metrics.counters["engine_resident_sessions"].inc()
+            self.metrics.counters["engine_resident_chunks"].inc(n_chunks)
+        finally:
+            self._resident_snap = None
+            self._lane_busy = False
+        for i, req, _pos0 in snap:
+            s = self.slots[i]
+            if s.active and s.request is req:
+                # every emitted block advanced s.position; the device's
+                # next fed token corresponds to exactly that extent
+                s.dispatched_position = s.position
 
     def _dispatch_decode(self):  # swarmlint: hot
         """Issue one K-step decode chunk (NO host sync) and return
@@ -2679,7 +3046,9 @@ class Engine:
         """
         t_sync0 = time.monotonic_ns()
         # everything else in the hot path rides jit dispatches; this is
-        # swarmlint: disable=SWL101 -- THE one sanctioned sync per chunk
+        # the scan path's per-chunk drain (the resident emission ring
+        # replaces it with one drain per SESSION — _run_resident)
+        # swarmlint: sanctioned-drain
         block, lps = jax.device_get((all_toks, all_lps))
         t_sync1 = time.monotonic_ns()
         # the sanctioned sync is itself a span + counter: the flight
@@ -2687,21 +3056,34 @@ class Engine:
         # time went to host<->device" to be a first-class number
         self.tracer.span_end(t_sync0, "engine.host_sync", cat="engine")
         self.metrics.counters["engine_host_syncs"].inc()
+        self._host_sync_n += 1
         self.metrics.counters["phase_us_host_sync"].inc(
             (t_sync1 - t_sync0) // 1000)
+        self._process_host_block(np.asarray(block), np.asarray(lps),
+                                 snapshot, t_dispatch_ns)
+
+    # swarmlint: hot
+    def _process_host_block(self, block, lps, snapshot,
+                            t_dispatch_ns: int = 0) -> None:
+        """Pure host-side half of block processing: emit tokens, retire
+        finished slots, close the per-chunk spans. Fed numpy blocks by
+        BOTH paths — the scan path after its per-chunk drain, and the
+        resident emission ring's ordered callback (where the device is
+        never waited on)."""
+        t_done_ns = time.monotonic_ns()
         if t_dispatch_ns:
             # per-chunk latency, dispatch -> processed (pipelined chunks
-            # overlap, so sums can exceed wall clock — documented)
+            # overlap, so sums can exceed wall clock — documented); on
+            # the resident path the stamp is the previous emission, so
+            # this is the chunk's device wall time
             self.metrics.counters["phase_us_decode"].inc(
-                (t_sync1 - t_dispatch_ns) // 1000)
+                (t_done_ns - t_dispatch_ns) // 1000)
             # exemplar rid: the chunk covers every snapshot slot; tag it
             # with the first one so a tail decode-chunk bucket opens a
             # representative trace (tuple indexing, no allocation)
             HIST_DECODE_CHUNK.observe(
-                (t_sync1 - t_dispatch_ns) / 1e9,
+                (t_done_ns - t_dispatch_ns) / 1e9,
                 snapshot[0][1].request_id if snapshot else None)
-        block = np.asarray(block)
-        lps = np.asarray(lps)
         now = time.time()
         K = self.decode_chunk
         for i, req, pos0 in snapshot:
@@ -2831,6 +3213,11 @@ class Engine:
                 "admitted_at": slot.admitted_at,
                 "first_token_at": slot.first_token_at,
                 "retired_at": time.time(),
+                # sanctioned host syncs this request's lifetime spanned,
+                # +1 for the drain its retirement rides in (the resident
+                # session's drain lands AFTER this record). Scan path:
+                # ~one per chunk; resident path: admit + drain (+ final)
+                "host_syncs": self._host_sync_n - slot.admit_syncs + 1,
             })
         if req is not None:
             # raw-model logprobs of the generated tokens (parallel list);

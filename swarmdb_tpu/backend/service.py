@@ -147,6 +147,168 @@ def sampling_from_message(msg: Message) -> SamplingParams:
     )
 
 
+def build_backend_engine(
+    model_name_or_cfg,
+    *,
+    max_batch: int = 8,
+    max_seq: Optional[int] = None,
+    seed: int = 0,
+    decode_chunk: int = 8,
+    paged: Optional[bool] = None,
+    page_size: int = 16,
+    kv_pool_tokens: Optional[int] = None,
+    prefill_batch: Optional[int] = None,
+    metrics=None,
+    flight_dir: Optional[str] = None,
+    tokenizer_path: Optional[str] = None,
+) -> Tuple[Engine, Tokenizer]:
+    """One single-device Engine (dense or paged) for a registry config —
+    the construction ``ServingService.from_model_name`` has always done,
+    factored out so the per-shard admission lanes
+    (``parallel/lanes.ShardLaneGroup``) can build one engine PER DEVICE
+    with identical wiring. Weights are randomly initialized (shapes and
+    compute are identical to a checkpoint restore); everything eager
+    here (params, pools, slot state) lands on the caller's
+    ``jax.default_device`` scope, which is how a lane pins its engine to
+    one mesh device."""
+    from ..models import llama, mixtral
+    from ..models.configs import ModelConfig, get_config
+    from ..utils.xla_cache import enable_compile_cache
+
+    enable_compile_cache()  # no-op unless SWARMDB_COMPILE_CACHE is set
+
+    cfg = (model_name_or_cfg
+           if isinstance(model_name_or_cfg, ModelConfig)
+           else get_config(model_name_or_cfg))
+    seq = max_seq or min(cfg.max_seq_len, 1024)
+    key = jax.random.PRNGKey(seed)
+    if cfg.is_moe:
+        params = mixtral.init_params(cfg, key)
+        fwd = lambda p, t, pos, c: mixtral.forward(p, cfg, t, pos, c)
+        init_cache = lambda b, s: mixtral.init_kv_cache(cfg, b, s)
+        paged_fwd = lambda p, t, pos, c: mixtral.forward_paged(p, cfg, t,
+                                                               pos, c)
+        init_pool_model = mixtral.init_paged_cache
+        mod = mixtral
+    else:
+        params = llama.init_params(cfg, key)
+        fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
+        init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
+        paged_fwd = lambda p, t, pos, c: llama.forward_paged(p, cfg, t,
+                                                             pos, c)
+        init_pool_model = llama.init_paged_cache
+        mod = llama
+    # two-segment chunked decode — the cache (dense slot buffer OR
+    # paged pool) stays frozen per chunk; see Engine._decode /
+    # ops.layers. SWARMDB_CHUNKED=0 falls back to per-step cache
+    # threading (escape hatch if a backend's compiler mishandles the
+    # chunked graph).
+    if paged is None:
+        paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
+    # ONE prefix-cache enablement flag shared by paged pool sizing and
+    # prefix_fns wiring (review finding: duplicated conditions drift)
+    prefix_enabled = (
+        hasattr(mod, "forward_prefix_pages" if paged
+                else "forward_prefix_lane")
+        and os.environ.get("SWARMDB_PREFIX", "1") != "0"
+        and seq % page_size == 0
+    )
+    chunked_fns = None
+    if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
+        chunk_fwd = mod.forward_paged_chunked if paged else mod.forward_chunked
+        if paged:
+            merge = mod.merge_paged_chunk
+        elif os.environ.get("SWARMDB_MERGE", "einsum") == "scatter":
+            # scatter-form chunk merge: numerically identical
+            # (ops/layers.merge_chunk_kv_scatter); raced against the
+            # einsum form on silicon by scripts/profile_merge.py
+            merge = mod.merge_chunk_scatter
+        else:
+            merge = mod.merge_chunk
+        chunked_fns = (
+            lambda p, t, pos, c, hkv, s: chunk_fwd(p, cfg, t, pos, c,
+                                                   hkv, s),
+            lambda b, k: mod.init_chunk_kv(cfg, b, k),
+            merge,
+        )
+
+    paged_spec = None
+    if paged:
+        from ..ops.paged_kv import PageAllocator, pages_per_slot
+
+        maxp = pages_per_slot(seq, page_size)
+        if kv_pool_tokens is None and "SWARMDB_KV_POOL_TOKENS" in os.environ:
+            kv_pool_tokens = int(os.environ["SWARMDB_KV_POOL_TOKENS"])
+        pool_tokens = kv_pool_tokens or max_batch * maxp * page_size
+        if kv_pool_tokens is None and prefix_enabled:
+            # prefix caching shares this pool: cached pages compete
+            # with slot footprints, so grow the default by the prefix
+            # budget or admissions starve once the cache warms up
+            pool_tokens += int(os.environ.get(
+                "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
+        num_pages = 1 + -(-pool_tokens // page_size)  # +1 trash page
+        paged_spec = PagedKV(
+            decode_forward=paged_fwd,
+            init_pool=lambda: init_pool_model(
+                cfg, max_batch, seq, num_pages, page_size),
+            page_size=page_size,
+            num_pages=num_pages,
+            allocator=PageAllocator(num_pages, page_size, seq, max_batch),
+        )
+
+    # Automatic prefix caching: chat serving re-prefills each
+    # conversation's history every turn, so reuse of page-aligned
+    # prompt KV is the dominant serve-mode lever (round-4 profile:
+    # prefill FLOPs ~15:1 over decode). Default ON; SWARMDB_PREFIX=0
+    # disables. DENSE engines keep a side pool (SWARMDB_PREFIX_TOKENS,
+    # default max_batch*max_seq/2 — half the decode cache's footprint,
+    # so enabling the feature never doubles an existing deployment's
+    # KV HBM; benches size it up). PAGED engines reuse the main pool
+    # in place (grown above by the same budget).
+    prefix_fns = None
+    prefix_pages = 0
+    if prefix_enabled:
+        if paged:
+            # paged mode reuses the MAIN pool in place; only the
+            # suffix-forward core is needed (no side pool, no lane)
+            prefix_fns = (
+                lambda p, t, tab, pl, pk, pv, logits_at=None:
+                    mod.forward_prefix_pages(p, cfg, t, tab, pl, pk, pv,
+                                             logits_at=logits_at),
+                None,
+            )
+        else:
+            prefix_tokens = int(os.environ.get(
+                "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
+            prefix_pages = 1 + -(-prefix_tokens // page_size)  # +1 trash
+            prefix_fns = (
+                lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
+                    mod.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
+                                            lp, logits_at=logits_at),
+                lambda n, ps: mod.init_prefix_pool(cfg, n, ps),
+            )
+
+    tokenizer = default_tokenizer(cfg.vocab_size, tokenizer_path)
+    if cfg.is_moe:
+        fwd_last = lambda p, t, pos, c, at: mixtral.forward(
+            p, cfg, t, pos, c, logits_at=at)
+    else:
+        fwd_last = lambda p, t, pos, c, at: llama.forward(
+            p, cfg, t, pos, c, logits_at=at)
+    engine = Engine(
+        fwd, init_cache, params,
+        max_batch=max_batch, max_seq=seq,
+        eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
+        metrics=metrics, decode_chunk=decode_chunk, paged=paged_spec,
+        prefill_batch=prefill_batch, chunked_fns=chunked_fns,
+        pipeline_depth=int(os.environ.get("SWARMDB_PIPELINE", "2")),
+        prefix_fns=prefix_fns, prefix_pages=prefix_pages,
+        prefix_page_size=page_size, forward_last_fn=fwd_last,
+        flight_dir=flight_dir,
+    )
+    return engine, tokenizer
+
+
 class ServingService:
     """Owns one Engine + its broker consumer; routes messages → generation."""
 
@@ -257,135 +419,11 @@ class ServingService:
         coverage, i.e. no savings but no admission stalls — benches pass a
         budget to realize the savings).
         """
-        from ..models import llama, mixtral
-        from ..models.configs import get_config
-        from ..utils.xla_cache import enable_compile_cache
-
-        enable_compile_cache()  # no-op unless SWARMDB_COMPILE_CACHE is set
-
-        cfg = get_config(model_name)
-        seq = max_seq or min(cfg.max_seq_len, 1024)
-        key = jax.random.PRNGKey(seed)
-        if cfg.is_moe:
-            params = mixtral.init_params(cfg, key)
-            fwd = lambda p, t, pos, c: mixtral.forward(p, cfg, t, pos, c)
-            init_cache = lambda b, s: mixtral.init_kv_cache(cfg, b, s)
-            paged_fwd = lambda p, t, pos, c: mixtral.forward_paged(p, cfg, t, pos, c)
-            init_pool_model = mixtral.init_paged_cache
-            mod = mixtral
-        else:
-            params = llama.init_params(cfg, key)
-            fwd = lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c)
-            init_cache = lambda b, s: llama.init_kv_cache(cfg, b, s)
-            paged_fwd = lambda p, t, pos, c: llama.forward_paged(p, cfg, t, pos, c)
-            init_pool_model = llama.init_paged_cache
-            mod = llama
-        # two-segment chunked decode — the cache (dense slot buffer OR
-        # paged pool) stays frozen per chunk; see Engine._decode /
-        # ops.layers. SWARMDB_CHUNKED=0 falls back to per-step cache
-        # threading (escape hatch if a backend's compiler mishandles the
-        # chunked graph).
-        if paged is None:
-            paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
-        # ONE prefix-cache enablement flag shared by paged pool sizing and
-        # prefix_fns wiring (review finding: duplicated conditions drift)
-        prefix_enabled = (
-            hasattr(mod, "forward_prefix_pages" if paged
-                    else "forward_prefix_lane")
-            and os.environ.get("SWARMDB_PREFIX", "1") != "0"
-            and seq % page_size == 0
-        )
-        chunked_fns = None
-        if os.environ.get("SWARMDB_CHUNKED", "1") != "0":
-            chunk_fwd = mod.forward_paged_chunked if paged else mod.forward_chunked
-            if paged:
-                merge = mod.merge_paged_chunk
-            elif os.environ.get("SWARMDB_MERGE", "einsum") == "scatter":
-                # scatter-form chunk merge: numerically identical
-                # (ops/layers.merge_chunk_kv_scatter); raced against the
-                # einsum form on silicon by scripts/profile_merge.py
-                merge = mod.merge_chunk_scatter
-            else:
-                merge = mod.merge_chunk
-            chunked_fns = (
-                lambda p, t, pos, c, hkv, s: chunk_fwd(p, cfg, t, pos, c,
-                                                       hkv, s),
-                lambda b, k: mod.init_chunk_kv(cfg, b, k),
-                merge,
-            )
-
-        paged_spec = None
-        if paged:
-            from ..ops.paged_kv import PageAllocator, pages_per_slot
-
-            maxp = pages_per_slot(seq, page_size)
-            if kv_pool_tokens is None and "SWARMDB_KV_POOL_TOKENS" in os.environ:
-                kv_pool_tokens = int(os.environ["SWARMDB_KV_POOL_TOKENS"])
-            pool_tokens = kv_pool_tokens or max_batch * maxp * page_size
-            if kv_pool_tokens is None and prefix_enabled:
-                # prefix caching shares this pool: cached pages compete
-                # with slot footprints, so grow the default by the prefix
-                # budget or admissions starve once the cache warms up
-                pool_tokens += int(os.environ.get(
-                    "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
-            num_pages = 1 + -(-pool_tokens // page_size)  # +1 trash page
-            paged_spec = PagedKV(
-                decode_forward=paged_fwd,
-                init_pool=lambda: init_pool_model(
-                    cfg, max_batch, seq, num_pages, page_size),
-                page_size=page_size,
-                num_pages=num_pages,
-                allocator=PageAllocator(num_pages, page_size, seq, max_batch),
-            )
-
-        # Automatic prefix caching: chat serving re-prefills each
-        # conversation's history every turn, so reuse of page-aligned
-        # prompt KV is the dominant serve-mode lever (round-4 profile:
-        # prefill FLOPs ~15:1 over decode). Default ON; SWARMDB_PREFIX=0
-        # disables. DENSE engines keep a side pool (SWARMDB_PREFIX_TOKENS,
-        # default max_batch*max_seq/2 — half the decode cache's footprint,
-        # so enabling the feature never doubles an existing deployment's
-        # KV HBM; benches size it up). PAGED engines reuse the main pool
-        # in place (grown above by the same budget).
-        prefix_fns = None
-        prefix_pages = 0
-        if prefix_enabled:
-            if paged:
-                # paged mode reuses the MAIN pool in place; only the
-                # suffix-forward core is needed (no side pool, no lane)
-                prefix_fns = (
-                    lambda p, t, tab, pl, pk, pv, logits_at=None:
-                        mod.forward_prefix_pages(p, cfg, t, tab, pl, pk, pv,
-                                                 logits_at=logits_at),
-                    None,
-                )
-            else:
-                prefix_tokens = int(os.environ.get(
-                    "SWARMDB_PREFIX_TOKENS", max_batch * seq // 2))
-                prefix_pages = 1 + -(-prefix_tokens // page_size)  # +1 trash
-                prefix_fns = (
-                    lambda p, t, tab, pl, pk, pv, lp, logits_at=None:
-                        mod.forward_prefix_lane(p, cfg, t, tab, pl, pk, pv,
-                                                lp, logits_at=logits_at),
-                    lambda n, ps: mod.init_prefix_pool(cfg, n, ps),
-                )
-
-        tokenizer = default_tokenizer(cfg.vocab_size, tokenizer_path)
-        if cfg.is_moe:
-            fwd_last = lambda p, t, pos, c, at: mixtral.forward(
-                p, cfg, t, pos, c, logits_at=at)
-        else:
-            fwd_last = lambda p, t, pos, c, at: llama.forward(
-                p, cfg, t, pos, c, logits_at=at)
-        engine = Engine(
-            fwd, init_cache, params,
-            max_batch=max_batch, max_seq=seq,
-            eos_id=tokenizer.eos_id, pad_id=tokenizer.pad_id, seed=seed,
-            metrics=db.metrics, decode_chunk=decode_chunk, paged=paged_spec,
-            prefill_batch=prefill_batch, chunked_fns=chunked_fns,
-            pipeline_depth=int(os.environ.get("SWARMDB_PIPELINE", "2")),
-            prefix_fns=prefix_fns, prefix_pages=prefix_pages,
-            prefix_page_size=page_size, forward_last_fn=fwd_last,
+        engine, tokenizer = build_backend_engine(
+            model_name, max_batch=max_batch, max_seq=max_seq, seed=seed,
+            decode_chunk=decode_chunk, paged=paged, page_size=page_size,
+            kv_pool_tokens=kv_pool_tokens, prefill_batch=prefill_batch,
+            metrics=db.metrics, tokenizer_path=tokenizer_path,
             # watchdog restarts auto-dump the flight record here (see
             # obs/flight.py; SWARMDB_FLIGHT_DIR overrides)
             flight_dir=os.path.join(db.save_dir, "flight"),

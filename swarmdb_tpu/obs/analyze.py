@@ -24,7 +24,21 @@ What each contributor means:
 
 - ``admission_serialization`` — queue-wait (``engine.admit``) growth:
   requests sit admitted-nowhere while the engine loop serializes
-  admission waves (the flight ring's queued-depth plateau).
+  admission waves (the flight ring's queued-depth plateau). In an A/B
+  at equal offered load and equal capacity, queue-wait GROWTH is by
+  definition not capacity — it is the admission machinery.
+- ``capacity_wait`` — queue wait that is just demand exceeding the
+  achieved service rate (all slots busy while the queue is deep). A
+  closed-loop bench always shows large absolute queue waits; only the
+  fraction accrued while FREE SLOTS EXISTED is the admission path's
+  fault. Split from ``admission_serialization`` using the paired
+  flight dump's per-step (active, queued) evidence
+  (``admission_stall_frac``) — trusted only when the dump's steps were
+  sampled post-admission (``occ_at_admit`` marker, resident-path
+  engines): occupancy sampled at session boundaries reads as stall no
+  matter how healthy admission is. Unmarked dumps (and the online
+  sentinel, which has no flight pairing) keep the old behavior —
+  everything on admission_serialization.
 - ``prefill_compute`` — ``engine.prefill`` span growth: each admission
   wave's prefill program costs more (sharded program overhead, padding
   waste).
@@ -62,8 +76,9 @@ SPAN_CATEGORIES = {
 }
 
 #: diagnosis contributors, reported in this order; shares sum to ~1
-CONTRIBUTORS = ("admission_serialization", "prefill_compute",
-                "per_shard_imbalance", "host_sync", "decode")
+CONTRIBUTORS = ("admission_serialization", "capacity_wait",
+                "prefill_compute", "per_shard_imbalance", "host_sync",
+                "decode")
 
 _WAVE_GAP_US = 2000.0  # prefill starts closer than this = same wave
 
@@ -148,12 +163,30 @@ def summarize_flight(dump: Dict[str, Any]) -> Dict[str, Any]:
     steps = dump.get("steps") or []
     reqs = dump.get("requests") or []
     imbalances: List[float] = []
+    # admission-stall evidence: over steps with a non-empty queue, the
+    # queue-weighted fraction of capacity sitting FREE. ~0 = the queue
+    # waits because every slot is busy (capacity); ~1 = requests wait
+    # while slots idle (the admission machinery is the bottleneck).
+    stall_w = 0.0
+    stall_total = 0.0
+    stall_evidence = False
     for step in steps:
         shards = step.get("active_by_shard") or {}
         vals = [int(v) for v in shards.values()]
         if len(vals) >= 2 and sum(vals) > 0:
             mean = sum(vals) / len(vals)
             imbalances.append((max(vals) - min(vals)) / max(1.0, mean))
+        queued = int(step.get("queued", 0))
+        cap = int(step.get("max_batch", 0))
+        if step.get("occ_at_admit"):
+            # occupancy sampled right after admission (resident-path
+            # engines mark their steps): the one sampling point where
+            # free-while-queued really means the admission path stalled
+            stall_evidence = True
+        if queued > 0 and cap > 0:
+            free = max(0, cap - int(step.get("active", 0)))
+            stall_w += queued * (free / cap)
+            stall_total += queued
     first, last = (steps[0], steps[-1]) if steps else ({}, {})
 
     def delta(key: str) -> int:
@@ -179,6 +212,9 @@ def summarize_flight(dump: Dict[str, Any]) -> Dict[str, Any]:
         "shards": len((steps[0].get("active_by_shard") or {})) if steps
         else 0,
         "padding_ratio": round(padding / prompt, 4) if prompt > 0 else 0.0,
+        "admission_stall_frac": round(stall_w / stall_total, 4)
+        if stall_total > 0 else 0.0,
+        "stall_evidence": stall_evidence,
         "host_syncs_per_step": round(
             delta("host_syncs") / max(1, len(steps) - 1), 3),
         "p50_queue_wait_s": round(med(queue), 4),
@@ -202,14 +238,38 @@ def _attribute(base: Dict[str, Any], test: Dict[str, Any],
     # it can explain
     imb = (test_flight or {}).get("shard_imbalance", 0.0)
     imbalance_ms = min(decode_delta, decode_delta * min(1.0, float(imb)))
+    # queue-wait growth: serialization by default (equal offered load,
+    # equal slots — growth is the machinery), UNLESS the test dump
+    # carries post-admission occupancy evidence saying the slots were
+    # in fact busy whenever the queue was non-empty, in which case the
+    # wait is demand exceeding the run's achieved service rate
+    # (capacity_wait — e.g. lanes sharing a starved host core)
+    queue_growth = max(0.0, t["queue_wait"] - b["queue_wait"])
+    admit_ms, cap_ms = _queue_split(queue_growth, test_flight)
     return {
-        "admission_serialization": max(0.0, t["queue_wait"]
-                                       - b["queue_wait"]),
+        "admission_serialization": admit_ms,
+        "capacity_wait": cap_ms,
         "prefill_compute": max(0.0, t["prefill"] - b["prefill"]),
         "per_shard_imbalance": imbalance_ms,
         "host_sync": max(0.0, t["host_sync"] - b["host_sync"]),
         "decode": decode_delta - imbalance_ms,
     }
+
+
+def _queue_split(queue_ms: float,
+                 flight: Optional[Dict[str, Any]]) -> Tuple[float, float]:
+    """(admission_ms, capacity_ms) of a queue-wait quantity. The split
+    is trusted ONLY when the dump's steps were sampled post-admission
+    (``stall_evidence`` — resident-path engines mark their step
+    records): occupancy sampled anywhere else reads transient session
+    boundaries as stall. Without that evidence every ms stays on
+    admission_serialization — the pre-split behavior, which the online
+    sentinel (no flight pairing) and all pre-round-7 dumps keep."""
+    if (flight is None or not flight.get("stall_evidence")
+            or "admission_stall_frac" not in flight):
+        return queue_ms, 0.0
+    frac = min(1.0, max(0.0, float(flight["admission_stall_frac"])))
+    return queue_ms * frac, queue_ms * (1.0 - frac)
 
 
 def diagnose(base: Dict[str, Any], test: Dict[str, Any],
@@ -225,10 +285,14 @@ def diagnose(base: Dict[str, Any], test: Dict[str, Any],
         shares = {c: deltas[c] / total for c in CONTRIBUTORS}
     else:
         # no regression: shares describe the TEST run's own cost mix so
-        # the report stays schema-stable (and still sums to 1)
+        # the report stays schema-stable (and still sums to 1). The
+        # queue wait splits into admission-machinery stall vs plain
+        # capacity wait using the flight rings' occupancy evidence.
         t = test["per_completion_ms"]
+        admit_ms, cap_ms = _queue_split(t["queue_wait"], test_flight)
         mix = {
-            "admission_serialization": t["queue_wait"],
+            "admission_serialization": admit_ms,
+            "capacity_wait": cap_ms,
             "prefill_compute": t["prefill"],
             "per_shard_imbalance": 0.0,
             "host_sync": t["host_sync"],
@@ -271,8 +335,10 @@ def _solo_diagnosis(summary: Dict[str, Any],
     t = summary["per_completion_ms"]
     imb = (flight or {}).get("shard_imbalance", 0.0)
     imbalance_ms = t["decode"] * min(1.0, float(imb))
+    admit_ms, cap_ms = _queue_split(t["queue_wait"], flight)
     mix = {
-        "admission_serialization": t["queue_wait"],
+        "admission_serialization": admit_ms,
+        "capacity_wait": cap_ms,
         "prefill_compute": t["prefill"],
         "per_shard_imbalance": imbalance_ms,
         "host_sync": t["host_sync"],
@@ -371,10 +437,40 @@ def self_check() -> Dict[str, Any]:
     assert verdict["dominant"] == "admission_serialization", verdict
     assert verdict["regressed"] is True
     assert set(verdict["shares"]) == set(CONTRIBUTORS)
-    # flat A/B: schema-stable, still sums to 1
+    # flat A/B: schema-stable, still sums to 1; without flight evidence
+    # the whole queue wait stays on admission_serialization (pre-split
+    # behavior — what the online sentinel keeps seeing)
     flat = diagnose(base, base)
     assert flat["regressed"] is False
     assert abs(sum(flat["shares"].values()) - 1.0) < 1e-3
+    assert flat["shares"]["capacity_wait"] == 0.0
+    # with flight evidence of FULL occupancy while queued, the own-mix
+    # queue wait is capacity, not admission serialization
+    busy_flight = summarize_flight({
+        "steps": [{"active": 8, "max_batch": 8, "queued": 5,
+                   "occ_at_admit": True, "prompt_tokens": 0,
+                   "prefill_padding_tokens": 0, "host_syncs": 0},
+                  {"active": 8, "max_batch": 8, "queued": 7,
+                   "occ_at_admit": True, "prompt_tokens": 100,
+                   "prefill_padding_tokens": 0, "host_syncs": 1}],
+        "requests": [],
+    })
+    assert busy_flight["admission_stall_frac"] == 0.0
+    assert busy_flight["stall_evidence"] is True
+    split = diagnose(base, base, test_flight=busy_flight)
+    assert split["shares"]["admission_serialization"] == 0.0
+    assert split["shares"]["capacity_wait"] > 0.0
+    # a REGRESSED pair with busy-occupancy evidence puts the queue
+    # growth on capacity, not the admission machinery; without the
+    # post-admission marker the growth stays on admission (the r05
+    # fixture behavior)
+    grow = diagnose(base, test, None, busy_flight)
+    assert grow["regressed"] is True
+    assert grow["shares"]["admission_serialization"] < 0.05
+    assert grow["shares"]["capacity_wait"] > 0.5
+    unmarked = dict(busy_flight, stall_evidence=False)
+    legacy = diagnose(base, test, None, unmarked)
+    assert legacy["dominant"] == "admission_serialization"
     # flight summary invariants on a synthetic imbalanced dump
     fl = summarize_flight({
         "steps": [

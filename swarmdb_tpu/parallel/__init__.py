@@ -12,6 +12,7 @@ from .mesh import (
     shard_pytree,
     tree_shardings,
 )
+from .lanes import LaneGroupInfo, ShardLaneGroup, build_lane_group
 from .serving import (
     CACHE_SPEC,
     TOKEN_SPEC,
@@ -22,6 +23,9 @@ from .serving import (
 )
 
 __all__ = [
+    "LaneGroupInfo",
+    "ShardLaneGroup",
+    "build_lane_group",
     "MESH_AXES",
     "make_mesh",
     "plan_mesh_shape",

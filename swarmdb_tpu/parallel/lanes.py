@@ -1,0 +1,390 @@
+"""Per-shard admission lanes: DP serving as N independent single-device
+engines behind one Engine-shaped facade (ISSUE 8 tentpole, part a).
+
+Why lanes instead of one GSPMD engine: a DP-sharded engine runs ONE
+program per step over the whole mesh — so every admission wave's prefill
+lands on EVERY shard's stream, and all eight shards' decode chunks queue
+behind one shard's admission. The PR 5/6 analyzer put numbers on it
+(checked-in dpserve traces): dp8 paid 6.2x per-completion cost, 83% of
+the growth in queue wait — admission serialization — while the shards
+were evenly loaded. Splitting the mesh into per-device engines makes the
+serialization structurally impossible:
+
+- Each lane is a complete single-device paged engine (own params copy —
+  exactly what DP replication means — own page pool, own prefix cache,
+  own admission queue, own decode loop thread, own device stream).
+- Admission is PER LANE: lane d popping its queue and dispatching its
+  prefill touches only device d; the other lanes' device-resident decode
+  sessions (engine.py emission ring) never wait on it. The
+  ``engine_admission_overlap_steps`` counter records exactly these
+  overlapped waves.
+- Routing preserves the conversation/prefix affinity the sharded
+  allocator enforced structurally: a request's ``shard_hint`` (the
+  serving layer's conversation-stable hash) pins it to one lane, so its
+  prefix-cache pages stay hittable across turns; unhinted requests go to
+  the least-loaded lane.
+- Priorities and anti-starvation aging work per lane unchanged
+  (``Engine._age_queue``); hint routing keeps each conversation's turns
+  in ONE lane's queue, so a lane-local age bump has the same effect the
+  global queue's did.
+
+The facade exposes the Engine surface ``ServingService``/bench/dashboard
+actually consume (submit/cancel/stats/warmup/flight/paged/prefix), so
+the serving stack drops in unchanged. ``SWARMDB_ADMIT_OVERLAP=0``
+restores the single-program GSPMD engine
+(``parallel/serving.build_sharded_paged``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..backend.engine import Engine, GenRequest
+from ..obs import TRACER, FlightRecorder
+from ..utils.metrics import MetricsRegistry
+
+logger = logging.getLogger("swarmdb_tpu.lanes")
+
+__all__ = ["ShardLaneGroup", "LaneGroupInfo", "build_lane_group"]
+
+
+@dataclass
+class LaneGroupInfo:
+    """What ``build_serving_engine`` callers get in the ShardedModel slot
+    when the lane group engages: enough identity to keep the call sites
+    (api/server.py reads ``.cfg``) working."""
+
+    cfg: Any
+    mesh: Any
+    data_size: int
+
+
+class _LaneAllocatorView:
+    """Aggregate allocator facade: ``n_shards`` routes the serving
+    layer's shard hints (and disables rolling resume, which needs
+    single-pool page custody), ``stats()`` feeds the bench record."""
+
+    def __init__(self, group: "ShardLaneGroup") -> None:
+        self._group = group
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._group.lanes)
+
+    def stats(self) -> Dict[str, Any]:
+        per = [e.paged.allocator.stats() for e in self._group.lanes]
+        return {
+            "num_pages": sum(s["num_pages"] for s in per),
+            "page_size": per[0]["page_size"],
+            "free_pages": sum(s.get("free_pages", 0) for s in per),
+            "lanes": len(per),
+        }
+
+
+class _LanePagedView:
+    """Engine.paged stand-in (truthy, allocator + page_size)."""
+
+    def __init__(self, group: "ShardLaneGroup") -> None:
+        self.allocator = _LaneAllocatorView(group)
+        self.page_size = group.lanes[0].paged.page_size
+        self.num_pages = sum(e.paged.num_pages for e in group.lanes)
+
+
+class _LanePrefixView:
+    """Engine._prefix stand-in: the bench's hit-rate accounting sums the
+    per-lane caches (same-lane-only reuse, like the sharded pool's
+    same-shard-only rule)."""
+
+    def __init__(self, group: "ShardLaneGroup") -> None:
+        self._group = group
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for e in self._group.lanes:
+            if e._prefix is None:
+                continue
+            for k, v in e._prefix.stats().items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+        return out
+
+
+class ShardLaneGroup:
+    """N single-device engines behind the Engine facade."""
+
+    def __init__(self, lanes: List[Engine], info: LaneGroupInfo,
+                 flight_dir: Optional[str] = None) -> None:
+        assert lanes, "a lane group needs at least one engine"
+        self.lanes = lanes
+        self.info = info
+        ref = lanes[0]
+        self.max_batch = sum(e.max_batch for e in lanes)
+        self.max_seq = ref.max_seq
+        self.decode_chunk = ref.decode_chunk
+        self.prefill_batch = ref.prefill_batch
+        self.metrics = ref.metrics
+        self.params = ref.params          # bench MFU/device identity
+        self.tracer = TRACER
+        self._mh = None                   # lanes never run pod mode
+        self._flight_dir = flight_dir if flight_dir is not None \
+            else ref._flight_dir
+        # ONE flight recorder for the whole group: step records carry
+        # their lane in "shard", request timelines interleave. Multiple
+        # lane threads write the rings concurrently — a benign race that
+        # can at worst drop one diagnostic record (the rings are
+        # evidence, not accounting; counters stay exact).
+        self.flight = FlightRecorder()
+        self.flight.meta.update({
+            "mesh": {k: int(v) for k, v in info.mesh.shape.items()}
+            if info.mesh is not None else {},
+            "paged_shards": len(lanes),
+            "admit_overlap": True,
+            "max_batch": self.max_batch,
+            "max_seq": self.max_seq,
+        })
+        self.paged = _LanePagedView(self)
+        self._prefix = (_LanePrefixView(self)
+                        if any(e._prefix is not None for e in lanes)
+                        else None)
+        self._prefix_ps = getattr(ref, "_prefix_ps", None)
+        self._sentinel = None
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        for idx, eng in enumerate(lanes):
+            eng.flight = self.flight
+            eng.flight_shard = idx
+            eng._flight_dir = self._flight_dir
+            eng.overlap_probe = self._make_probe(idx)
+
+    def _make_probe(self, idx: int) -> Callable[[], bool]:
+        def probe() -> bool:
+            return any(e._lane_busy for j, e in enumerate(self.lanes)
+                       if j != idx)
+        return probe
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        for e in self.lanes:
+            e.start()
+
+    def stop(self) -> None:
+        for e in self.lanes:
+            e.stop()
+
+    def alive(self) -> bool:
+        return all(e.alive() for e in self.lanes)
+
+    def restart(self) -> None:
+        """Restart only the DEAD lanes: a single lane's decode-loop death
+        must not fail the seven healthy lanes' in-flight requests."""
+        for e in self.lanes:
+            if not e.alive():
+                e.restart()
+
+    def warmup(self) -> float:
+        """Warm every lane CONCURRENTLY: compilation releases the GIL
+        (XLA C++), and with the persistent cache on, the first lane to
+        compile a variant serializes it for the rest — so group warmup
+        costs ~one lane's warmup, not N."""
+        import time
+
+        t0 = time.time()
+        if len(self.lanes) == 1:
+            self.lanes[0].warmup()
+        else:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(self.lanes))) as ex:
+                list(ex.map(lambda e: e.warmup(), self.lanes))
+        return time.time() - t0
+
+    # -------------------------------------------------------- scheduling
+
+    def _lane_for(self, request: GenRequest) -> Engine:
+        if request.shard_hint is not None:
+            return self.lanes[request.shard_hint % len(self.lanes)]
+        # least-loaded lane; racy reads are fine (load balance is a
+        # heuristic, correctness never depends on it). Round-robin
+        # tiebreak so an idle group still spreads arrivals.
+        with self._rr_lock:
+            self._rr += 1
+            rot = self._rr
+        loads = []
+        for j, e in enumerate(self.lanes):
+            load = len(e._queue) + sum(1 for s in e.slots if s.active)
+            loads.append((load, (j + rot) % len(self.lanes), e))
+        return min(loads, key=lambda t: (t[0], t[1]))[2]
+
+    def submit(self, request: GenRequest) -> str:
+        return self._lane_for(request).submit(request)
+
+    def cancel(self, request_id: str) -> bool:
+        for e in self.lanes:
+            if e.cancel(request_id):
+                return True
+        return False
+
+    def generate_sync(self, prompt, sampling, timeout: float = 120.0):
+        import threading as _t
+
+        done = _t.Event()
+        result: Dict[str, Any] = {}
+
+        def on_done(rid, toks, reason):
+            result["tokens"] = toks
+            result["reason"] = reason
+            done.set()
+
+        self.submit(GenRequest(prompt=prompt, sampling=sampling,
+                               on_done=on_done))
+        if not done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        return result["tokens"], result["reason"]
+
+    # ------------------------------------------------------------- hooks
+
+    @property
+    def sentinel(self):
+        return self._sentinel
+
+    @sentinel.setter
+    def sentinel(self, value) -> None:
+        # every lane's loop drives window closes (maybe_tick is a
+        # non-blocking single-closer election — concurrent tickers are
+        # its design point)
+        self._sentinel = value
+        for e in self.lanes:
+            e.sentinel = value
+
+    @property
+    def on_pool_pressure(self):
+        return self.lanes[0].on_pool_pressure
+
+    @on_pool_pressure.setter
+    def on_pool_pressure(self, hook) -> None:
+        for e in self.lanes:
+            e.on_pool_pressure = hook
+
+    def supports_rolling(self) -> bool:
+        # page custody cannot span lanes; the serving layer already
+        # refuses rolling on any multi-shard pool
+        return False
+
+    def pool_epoch(self) -> int:
+        return sum(e.pool_epoch() for e in self.lanes)
+
+    # -------------------------------------------------------------- info
+
+    def stats(self) -> Dict[str, Any]:
+        per = [e.stats() for e in self.lanes]
+        out = {
+            "active_slots": sum(p["active_slots"] for p in per),
+            "max_batch": self.max_batch,
+            "queued": sum(p["queued"] for p in per),
+            "total_requests": sum(p["total_requests"] for p in per),
+            "total_generated": sum(p["total_generated"] for p in per),
+            "tokens_per_sec_60s": per[0]["tokens_per_sec_60s"],
+            "latencies": per[0].get("latencies", {}),
+            "lanes": len(per),
+            "queued_by_lane": [p["queued"] for p in per],
+            "active_by_lane": [p["active_slots"] for p in per],
+        }
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+        return out
+
+
+def build_lane_group(
+    model_name_or_cfg: Any,
+    mesh: Any,
+    *,
+    max_batch: int,
+    max_seq: int = 1024,
+    seed: int = 0,
+    page_size: int = 16,
+    kv_pool_tokens: Optional[int] = None,
+    metrics: Optional[MetricsRegistry] = None,
+    decode_chunk: int = 8,
+    prefill_batch: Optional[int] = None,
+    flight_dir: Optional[str] = None,
+) -> ShardLaneGroup:
+    """One paged single-device engine per mesh ``data`` device.
+
+    Each lane's eager state (params, pools, PRNG keys, fed-token
+    vectors) is built under ``jax.default_device(dev)``, so every jit
+    the lane ever dispatches runs on ITS device — the per-shard
+    admission overlap is then a property of the device streams, not of
+    scheduler luck. Params are replicated across lanes (the definition
+    of data parallelism); pools and prefix caches split N ways, same
+    aggregate budget as the sharded pool."""
+    from ..backend.service import build_backend_engine
+    from ..models.configs import ModelConfig, get_config
+
+    cfg = (model_name_or_cfg
+           if isinstance(model_name_or_cfg, ModelConfig)
+           else get_config(model_name_or_cfg))
+    for ax in ("model", "expert", "pipe"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                "per-shard admission lanes require a pure-DP mesh "
+                f"({ax} axis must be 1); TP/EP shard weights across "
+                "devices, which per-device engines cannot")
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    if max_batch % n:
+        raise ValueError(f"max_batch {max_batch} must divide the lane "
+                         f"count {n} (slot→lane affinity)")
+    slots_per = max_batch // n
+    metrics = metrics or MetricsRegistry()
+    if kv_pool_tokens is None:
+        # per-lane pool: full slot coverage + a prefix budget of one
+        # full window per slot (TWICE the single-pool default's half):
+        # lane caches are small and private — a conversation pinned to
+        # lane d can only ever hit lane d's pages — so at the default
+        # budget the per-lane LRU churns below the per-conversation
+        # footprint and the hit rate collapses (measured 35% vs 47%)
+        import os as _os
+
+        from ..ops.paged_kv import pages_per_slot
+
+        maxp = pages_per_slot(max_seq, page_size)
+        lane_pool = slots_per * maxp * page_size + int(_os.environ.get(
+            "SWARMDB_PREFIX_TOKENS", n * slots_per * max_seq)) // n
+    else:
+        lane_pool = max(1, kv_pool_tokens // n)
+    lanes: List[Engine] = []
+    for d, dev in enumerate(devices):
+        with jax.default_device(dev):
+            eng, _tok = build_backend_engine(
+                cfg, max_batch=slots_per, max_seq=max_seq, seed=seed,
+                decode_chunk=decode_chunk, paged=True,
+                page_size=page_size,
+                kv_pool_tokens=lane_pool,
+                prefill_batch=prefill_batch, metrics=metrics,
+                flight_dir=flight_dir,
+            )
+        eng._home_device = dev
+        if n > 1:
+            # distinct per-lane slot PRNG rows: lanes replicate PARAMS
+            # (same seed), but reusing the same slot keys would make
+            # temperature>0 sampling correlate across lanes at equal
+            # (slot, position). Host-side rewrite only — the keys ride
+            # every dispatch as a numpy argument.
+            import numpy as _np
+
+            from ..backend.sampling import make_slot_keys
+
+            with jax.default_device(dev):
+                eng.base_keys = make_slot_keys(seed + 7919 * (d + 1),
+                                               slots_per)
+            eng._base_keys_np = _np.array(eng.base_keys)
+            eng._default_keys_np = eng._base_keys_np.copy()
+        lanes.append(eng)
+    info = LaneGroupInfo(cfg=cfg, mesh=mesh, data_size=n)
+    return ShardLaneGroup(lanes, info, flight_dir=flight_dir)

@@ -449,19 +449,52 @@ def build_serving_engine(
     paged: Optional[bool] = None,
     page_size: int = 16,
     kv_pool_tokens: Optional[int] = None,
+    admit_overlap: Optional[bool] = None,
     **engine_kwargs: Any,
 ):
     """One-call multi-chip engine: sharded model + continuous batching.
 
     ``max_batch`` defaults to 8 slots per data shard so every decode step
     is a full data-parallel batch over ICI (SURVEY §3.4). ``paged=True``
-    (or SWARMDB_PAGED=1) builds the DP-sharded paged fast path — pool and
-    table sharded over ``data``, prefix caching on — via
-    :func:`build_sharded_paged`; requires a pure-DP mesh.
+    (or SWARMDB_PAGED=1) builds the paged fast path. On a pure-DP mesh
+    with more than one data shard, the DEFAULT paged build is now the
+    per-shard admission-lane group (``parallel/lanes.ShardLaneGroup``:
+    one single-device engine per shard, admission overlapped with the
+    other shards' decode — the ISSUE 8 fix for the dp8 admission
+    serialization); the second return value is then a
+    :class:`~swarmdb_tpu.parallel.lanes.LaneGroupInfo` instead of a
+    ShardedModel. ``admit_overlap=False`` (or SWARMDB_ADMIT_OVERLAP=0)
+    restores the single-program GSPMD engine via
+    :func:`build_sharded_paged`; requires a pure-DP mesh either way.
     """
     from ..backend.engine import Engine
 
     import os
+
+    mesh = mesh or make_mesh()
+    if paged is None:
+        paged = os.environ.get("SWARMDB_PAGED", "0") == "1"
+    if admit_overlap is None:
+        admit_overlap = os.environ.get("SWARMDB_ADMIT_OVERLAP", "1") != "0"
+    dp = mesh.shape.get("data", 1)
+    pure_dp = all(mesh.shape.get(ax, 1) == 1
+                  for ax in ("model", "expert", "pipe"))
+    if (paged and admit_overlap and pure_dp and dp > 1
+            and jax.process_count() == 1
+            and engine_kwargs.get("paged") is None):
+        from .lanes import build_lane_group
+
+        group = build_lane_group(
+            model_name_or_cfg, mesh,
+            max_batch=max_batch if max_batch is not None else 8 * dp,
+            max_seq=max_seq, seed=seed, page_size=page_size,
+            kv_pool_tokens=kv_pool_tokens,
+            metrics=engine_kwargs.get("metrics"),
+            decode_chunk=engine_kwargs.get("decode_chunk", 8),
+            prefill_batch=engine_kwargs.get("prefill_batch"),
+            flight_dir=engine_kwargs.get("flight_dir"),
+        )
+        return group, group.info
 
     sm = build_sharded_model(model_name_or_cfg, mesh, seed=seed)
     if max_batch is None:

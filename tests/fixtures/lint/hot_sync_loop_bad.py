@@ -1,0 +1,43 @@
+"""SWL105 fixture: host syncs INSIDE loops in hot code.
+
+The sanctioned-drain marker must quiet a straight-line per-request
+drain (SWL101) but never a sync that loops — that is a per-chunk sync
+wearing a costume.
+"""
+
+import jax
+
+
+# swarmlint: hot
+def per_chunk_drain_loop(blocks):
+    out = []
+    for b in blocks:
+        out.append(jax.device_get(b))  # EXPECT: SWL105
+    return out
+
+
+# swarmlint: hot
+def polling_wait(handle):
+    while not handle.ready:
+        jax.block_until_ready(handle.value)  # EXPECT: SWL105
+    return handle
+
+
+# swarmlint: hot
+def sanctioned_drain_in_loop(blocks):
+    for b in blocks:
+        # swarmlint: sanctioned-drain -- does NOT apply in a loop
+        jax.device_get(b)  # EXPECT: SWL105
+    return blocks
+
+
+# swarmlint: hot
+def legitimate_session_drain(result):
+    # swarmlint: sanctioned-drain -- one sync per request, by design
+    n = jax.device_get(result)  # OK: straight-line, marked
+    return n
+
+
+# swarmlint: hot
+def unmarked_straight_line_sync(result):
+    return jax.device_get(result)  # EXPECT: SWL101

@@ -538,3 +538,142 @@ def test_warmup_parallel_env_is_forgiving(monkeypatch):
         assert eng.warmup() >= 0.0
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+
+
+# -------------------------------------------------- device-resident decode
+
+
+def _paged_tiny_engine(**kw):
+    """Single-chip paged engine (the device-resident session path is the
+    DEFAULT for single-shard paged engines; SWARMDB_EMIT_RING=0 pins the
+    per-chunk scan+pipeline path)."""
+    from swarmdb_tpu.backend.engine import PagedKV
+    from swarmdb_tpu.ops.paged_kv import PageAllocator
+
+    cfg = TINY_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ps, num_pages = 8, 41
+    return Engine(
+        lambda p, t, pos, c: llama.forward(p, cfg, t, pos, c),
+        lambda b, s: llama.init_kv_cache(cfg, b, s),
+        params, max_batch=2, max_seq=96, eos_id=2, seed=0,
+        prefill_buckets=[16, 32],
+        paged=PagedKV(
+            decode_forward=lambda p, t, pos, c:
+                llama.forward_paged(p, cfg, t, pos, c),
+            init_pool=lambda: llama.init_paged_cache(
+                cfg, 2, 96, num_pages, ps),
+            page_size=ps, num_pages=num_pages,
+            allocator=PageAllocator(num_pages, ps, 96, 2)),
+        **kw)
+
+
+def test_resident_matches_scan_path_tokens(monkeypatch):
+    """The emission-ring while_loop must be a pure restructuring: greedy
+    tokens identical to the per-chunk scan path, chunk math unchanged."""
+    resident = _paged_tiny_engine()
+    assert resident._resident_variants is not None
+    monkeypatch.setenv("SWARMDB_EMIT_RING", "0")
+    scan = _paged_tiny_engine()
+    assert scan._resident_variants is None
+    monkeypatch.delenv("SWARMDB_EMIT_RING")
+    prompts = [[1, 5, 9], list(range(3, 30)), [7, 7]]
+    try:
+        resident.start()
+        scan.start()
+        for p in prompts:
+            a, _ = resident.generate_sync(
+                p, SamplingParams(max_new_tokens=12))
+            b, _ = scan.generate_sync(
+                p, SamplingParams(max_new_tokens=12))
+            assert a == b, (p, a, b)
+    finally:
+        resident.stop()
+        scan.stop()
+
+
+def test_resident_host_syncs_per_request(monkeypatch):
+    """The tentpole host-sync contract on ONE engine: a streamed
+    multi-chunk request spans <= 3 sanctioned syncs on the resident
+    path, while the scan path pays ~one per chunk (flight timelines)."""
+    resident = _paged_tiny_engine()
+    monkeypatch.setenv("SWARMDB_EMIT_RING", "0")
+    scan = _paged_tiny_engine()
+    monkeypatch.delenv("SWARMDB_EMIT_RING")
+
+    def stream_one(eng):
+        toks = []
+        done = threading.Event()
+        req = GenRequest(
+            prompt=[1, 2, 3],
+            sampling=SamplingParams(max_new_tokens=40),  # ~5 chunks, K=8
+            on_token=lambda rid, t: toks.append(t),
+            on_done=lambda *a: done.set())
+        rid = eng.submit(req)
+        assert done.wait(120)
+        rec = next(r for r in reversed(eng.flight.requests())
+                   if r["rid"] == rid)
+        assert len(toks) >= 24
+        return rec["host_syncs"]
+
+    try:
+        resident.start()
+        scan.start()
+        assert stream_one(resident) <= 3
+        assert stream_one(scan) >= 4  # one drain per chunk, ~5 chunks
+    finally:
+        resident.stop()
+        scan.stop()
+
+
+def test_resident_session_counters_and_flight():
+    """Sessions are counted, chunks accumulate, and the one drain per
+    session is the only engine host sync while a request runs."""
+    eng = _paged_tiny_engine()
+    c = eng.metrics.counters
+    try:
+        eng.start()
+        toks, reason = eng.generate_sync(
+            [4, 5, 6], SamplingParams(max_new_tokens=24))
+        assert reason in ("length", "eos")
+        # on_done fires from the emission callback DURING the session;
+        # the drain (and its counters) land right after — poll briefly
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and (c["engine_resident_sessions"].value < 1
+                    or c["engine_host_syncs"].value
+                    != c["engine_resident_sessions"].value)):
+            time.sleep(0.05)
+        assert c["engine_resident_sessions"].value >= 1
+        assert (c["engine_resident_chunks"].value
+                >= c["engine_resident_sessions"].value)
+        assert (c["engine_host_syncs"].value
+                == c["engine_resident_sessions"].value)
+    finally:
+        eng.stop()
+
+
+def test_row_bucketed_waves():
+    """Lane-geometry paged engines pad admission waves to the smallest
+    covering ROW bucket instead of prefill_batch (78% measured grid
+    padding at dp8 otherwise); dense engines keep the fixed shape."""
+    eng = _paged_tiny_engine()
+    assert eng._row_buckets == [1, 2]
+    assert eng._rows_for(1) == 1 and eng._rows_for(2) == 2
+    dense = Engine(
+        lambda p, t, pos, c: llama.forward(p, TINY_DEBUG, t, pos, c),
+        lambda b, s: llama.init_kv_cache(TINY_DEBUG, b, s),
+        llama.init_params(TINY_DEBUG, jax.random.PRNGKey(0)),
+        max_batch=2, max_seq=32, eos_id=2, prefill_buckets=[8])
+    assert dense._row_buckets == [dense.prefill_batch]
+    # a single admission must dispatch a 1-row wave: padding delta for
+    # the wave is bucket - prompt, not prefill_batch * bucket - prompt
+    c = eng.metrics.counters
+    try:
+        eng.start()
+        before = c["prefill_padding_tokens"].value
+        eng.generate_sync([1] * 10, SamplingParams(max_new_tokens=2))
+        added = c["prefill_padding_tokens"].value - before
+        assert added <= 16 - 10, added  # one row, bucket 16
+    finally:
+        eng.stop()

@@ -16,10 +16,19 @@ import pytest
 from swarmdb_tpu.obs import analyze
 
 REPO = Path(__file__).resolve().parent.parent
-DP1_TRACE = REPO / "bench_logs" / "dpserve_dp1_trace.json"
-DP8_TRACE = REPO / "bench_logs" / "dpserve_dp8_trace.json"
+# the PRE-ISSUE-8 regression pair (global-wave GSPMD admission, dpx=0.22)
+# stays checked in as the analyzer's regression-attribution fixture —
+# the live dpserve_dp{1,8}_trace.json names now hold the POST-fix pair
+# (per-shard lanes + resident decode), see the r07 tests below
+DP1_TRACE = REPO / "bench_logs" / "dpserve_dp1_trace_r05.json"
+DP8_TRACE = REPO / "bench_logs" / "dpserve_dp8_trace_r05.json"
 DP1_FLIGHT = REPO / "bench_logs" / "flight_1785852451827_bench_dpserve_dp1.json"
 DP8_FLIGHT = REPO / "bench_logs" / "flight_1785852414700_bench_dpserve_dp8.json"
+# the post-fix pair, deposited by `bench.py --analyze` mode=dpserve r07
+DP1_TRACE_R07 = REPO / "bench_logs" / "dpserve_dp1_trace_r07.json"
+DP8_TRACE_R07 = REPO / "bench_logs" / "dpserve_dp8_trace_r07.json"
+DP1_FLIGHT_R07 = REPO / "bench_logs" / "flight_dpserve_dp1_r07.json"
+DP8_FLIGHT_R07 = REPO / "bench_logs" / "flight_dpserve_dp8_r07.json"
 
 CONTRIBUTORS = set(analyze.CONTRIBUTORS)
 
@@ -99,3 +108,48 @@ def test_cli_acceptance_invocation():
         cwd=str(REPO), capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr
     assert "self-check: ok" in proc.stdout
+
+
+def test_r07_pair_admission_serialization_collapsed():
+    """ISSUE 8 acceptance: on the freshly deposited post-fix dp8 traces
+    (per-shard admission lanes + device-resident decode), the diagnosis
+    attributes admission_serialization < 20% share (was 83%+ dominant on
+    the r05 pair above). Queue wait that is just demand exceeding slots
+    lands on capacity_wait via the flight rings' occupancy-while-queued
+    evidence (slots are FULL whenever lane queues are non-empty), not on
+    the admission machinery. Asserted on the dp8 run's own cost mix —
+    the checked-in pair was recorded on a 1-core container where the
+    dp8-vs-dp1 wall-clock ratio measures host-core contention, so the
+    cost-mix attribution (not the throughput delta) carries the
+    structural verdict; dp8-vs-dp1 on the same evidence is additionally
+    schema-checked below."""
+    pair = analyze.analyze_files([
+        str(DP1_TRACE_R07), str(DP8_TRACE_R07),
+        str(DP1_FLIGHT_R07), str(DP8_FLIGHT_R07)])
+    diag = pair["diagnosis"]
+    assert set(diag["shares"]) == CONTRIBUTORS
+    assert abs(sum(diag["shares"].values()) - 1.0) < 5e-3
+    assert diag["shares"]["admission_serialization"] < 0.20, diag
+    json.dumps(pair)
+    # the dp8 run's OWN cost mix says the same thing
+    solo = analyze.analyze_files([str(DP8_TRACE_R07),
+                                  str(DP8_FLIGHT_R07)])
+    sdiag = solo["diagnosis"]
+    assert sdiag["shares"]["admission_serialization"] < 0.20, sdiag
+    assert sdiag["shares"]["capacity_wait"] > \
+        sdiag["shares"]["admission_serialization"], sdiag
+
+
+def test_r07_dp8_flight_shows_busy_occupancy_and_low_syncs():
+    """The flight evidence behind the r07 verdict: when the dp8 lanes'
+    queues are non-empty the slots are overwhelmingly BUSY (low
+    admission_stall_frac — waiting is capacity, not serialization), and
+    the per-request sync contract holds on the request timelines."""
+    dump = json.loads(DP8_FLIGHT_R07.read_text())
+    fl = analyze.summarize_flight(dump)
+    assert fl["admission_stall_frac"] < 0.5, fl
+    syncs = [r["host_syncs"] for r in dump.get("requests", [])
+             if "host_syncs" in r]
+    assert syncs, "request timelines carry no host_syncs field"
+    med = sorted(syncs)[len(syncs) // 2]
+    assert med <= 3, (med, syncs[:20])

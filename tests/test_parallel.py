@@ -136,7 +136,7 @@ def test_sharded_paged_engine_matches_dense_sharded():
         mesh = make_mesh(8, data=8, model=1, expert=1)
         engine, sm = build_serving_engine(
             get_config("tiny-debug"), mesh, max_batch=8, max_seq=64,
-            seed=0, paged=paged, page_size=8,
+            seed=0, paged=paged, page_size=8, admit_overlap=False,
         )
         if paged:
             alloc = engine.paged.allocator
@@ -215,7 +215,7 @@ def test_dp_paged_admission_spreads_shards():
     engine, _sm = build_serving_engine(
         "tiny-debug", make_mesh(8, data=8, model=1, expert=1),
         max_batch=16, max_seq=64, decode_chunk=4, prefill_buckets=[16],
-        paged=True, page_size=8,
+        paged=True, page_size=8, admit_overlap=False,
     )
     alloc = engine.paged.allocator
     assert alloc.n_shards == 8
@@ -257,7 +257,7 @@ def test_dp_paged_shard_hint_preserves_prefix_affinity():
     engine, _sm = build_serving_engine(
         "tiny-debug", make_mesh(8, data=8, model=1, expert=1),
         max_batch=16, max_seq=64, decode_chunk=4, prefill_buckets=[32],
-        paged=True, page_size=8,
+        paged=True, page_size=8, admit_overlap=False,
     )
     engine.start()
     try:
@@ -297,7 +297,7 @@ def test_dp_paged_hint_falls_back_when_shard_exhausted():
     engine, _sm = build_serving_engine(
         "tiny-debug", make_mesh(8, data=8, model=1, expert=1),
         max_batch=16, max_seq=64, decode_chunk=4, prefill_buckets=[32],
-        paged=True, page_size=8, kv_pool_tokens=512,
+        paged=True, page_size=8, kv_pool_tokens=512, admit_overlap=False,
     )
     alloc = engine.paged.allocator
     engine.start()
@@ -345,6 +345,7 @@ def test_sharded_warmup_plan_covers_packed_variant(tmp_path):
         make_mesh(8, data=8, model=1, expert=1),
         max_batch=16, max_seq=64, decode_chunk=4,
         prefill_buckets=[16], paged=True, page_size=8,
+        admit_overlap=False,
     )
     assert engine._packed_active()
     plan = engine.warmup_call_plan()
@@ -379,6 +380,7 @@ def test_sharded_precompile_cache_covers_warmup(tmp_path):
         make_mesh(8, data=8, model=1, expert=1),
         max_batch=16, max_seq=64, decode_chunk=4,
         prefill_buckets=[16], paged=True, page_size=8,
+        admit_overlap=False,
     )
     assert engine._packed_active()
     cache_dir = tmp_path / "xla"

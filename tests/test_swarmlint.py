@@ -36,6 +36,7 @@ def expected_findings(path: Path):
 
 @pytest.mark.parametrize("name", [
     "hot_sync_bad.py",          # host-sync family (SWL101/SWL102)
+    "hot_sync_loop_bad.py",     # host-sync-in-loop family (SWL105)
     "recompile_bad.py",         # recompile family (SWL201/202/203)
     "lock_bad.py",              # lock-discipline family (SWL301)
     "tracer_leak_bad.py",       # tracer-leak family (SWL401)
