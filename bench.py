@@ -1089,6 +1089,198 @@ def bench_ha(seconds: float) -> dict:
         client.close()
 
 
+def bench_chaos_serve(seconds: float) -> dict:
+    """Serving-path fault drill (ISSUE 9): a supervised 2-lane group on
+    virtual CPU devices under concurrent streamed clients, a scripted
+    mid-decode lane KILL, then a pool squeeze — recording the numbers
+    the acceptance contract names: ``time_to_quarantine_s``,
+    ``requests_migrated``, ``acked_loss`` (requests that lost or
+    duplicated a client-visible chunk, or failed non-retryably; MUST be
+    0), and p95 TTFT inside vs outside the fault window. CPU wall-clock
+    by design (same rationale as dpserve: the path is what a v5e-8
+    would run)."""
+    n = _env("SWARMDB_BENCH_CHAOS_LANES", 2, int)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from swarmdb_tpu.backend.chaos import ServingChaos, wait_until
+    from swarmdb_tpu.backend.engine import (GenRequest,
+                                            is_retryable_reason)
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.models.configs import get_config
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+    from swarmdb_tpu.utils.xla_cache import enable_compile_cache
+
+    enable_compile_cache(os.environ.get(
+        "SWARMDB_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache")))
+    # tight watermarks for the drill: the tiny per-lane pools must cross
+    # pause/shed territory under a 97% free-page squeeze (production
+    # defaults 0.92/0.80/0.98 are sized for real pool geometries)
+    os.environ.setdefault("SWARMDB_POOL_HIGH", "0.6")
+    os.environ.setdefault("SWARMDB_POOL_LOW", "0.4")
+    os.environ.setdefault("SWARMDB_POOL_SHED", "0.7")
+    group, _info = build_serving_engine(
+        get_config("tiny-debug"), make_mesh(n, data=n, model=1, expert=1),
+        max_batch=2 * n, max_seq=128, paged=True, page_size=8,
+        decode_chunk=4)
+    if _env("SWARMDB_BENCH_PREWARM", 1, int) == 1:
+        # BEFORE start(): warmup reuses live buffers through donation,
+        # which is only safe while every lane loop is down
+        group.warmup()
+    group.start()
+    sup = group.attach_supervisor(
+        suspect_s=0.25, quarantine_s=0.5, poll_s=0.05, probe_clean_n=2,
+        probe_timeout_s=60.0, deadline_s=120.0, retries=3)
+    chaos = ServingChaos(group)
+
+    new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16, int)
+    n_clients = _env("SWARMDB_BENCH_CHAOS_CLIENTS", 4, int)
+    stop = threading.Event()
+    fault_window = threading.Event()
+    lock = threading.Lock()
+    stats = {"completed": 0, "acked_loss": 0, "client_retries": 0,
+             "reasons": {}, "ttft_steady": [], "ttft_fault": []}
+
+    def client(worker: int) -> None:
+        i = 0
+        while not stop.is_set():
+            prompt = [1 + worker, 5, 9, 13 + (i % 7)]
+            deadline = time.time() + 60.0
+            while True:  # client-side retry of retryable surfaces
+                done = threading.Event()
+                out: dict = {}
+                streamed: list = []
+                t_submit = time.monotonic()
+                first = [0.0]
+
+                def on_tok(rid, tok):
+                    if not first[0]:
+                        first[0] = time.monotonic() - t_submit
+                    streamed.append(tok)
+
+                def on_done(rid, toks, reason):
+                    out["toks"], out["reason"] = toks, reason
+                    done.set()
+
+                group.submit(GenRequest(
+                    prompt=prompt,
+                    sampling=SamplingParams(max_new_tokens=new_tokens),
+                    # mixed classes PER LANE (priority decorrelated from
+                    # the lane hint): the squeeze phase must shed ONLY
+                    # the low class while the high class drains
+                    priority=0 if worker < n_clients // 2 else 3,
+                    shard_hint=worker % n,
+                    on_token=on_tok, on_done=on_done))
+                if not done.wait(90):
+                    with lock:
+                        stats["acked_loss"] += 1  # hung stream = loss
+                    break
+                reason = out["reason"]
+                with lock:
+                    stats["reasons"][reason] = (
+                        stats["reasons"].get(reason, 0) + 1)
+                if reason in ("length", "eos"):
+                    with lock:
+                        stats["completed"] += 1
+                        if streamed != out["toks"]:
+                            stats["acked_loss"] += 1  # dup/lost chunk
+                        (stats["ttft_fault"] if fault_window.is_set()
+                         else stats["ttft_steady"]).append(first[0])
+                    break
+                if is_retryable_reason(reason) and time.time() < deadline:
+                    with lock:
+                        stats["client_retries"] += 1
+                    continue
+                with lock:
+                    stats["acked_loss"] += 1  # non-retryable failure
+                break
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(w,), daemon=True)
+               for w in range(n_clients)]
+    window = max(6.0, min(seconds, 30.0))
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(window / 3)  # steady state
+        # ---- fault 1: mid-decode lane kill --------------------------
+        fault_window.set()
+        t_kill = time.monotonic()
+        chaos.kill_lane(0)
+        wait_until(
+            lambda: sup.status()["lanes"][0]["state"] == "quarantined",
+            30.0, what="lane 0 quarantine")
+        time_to_quarantine = time.monotonic() - t_kill
+        wait_until(
+            lambda: all(l["state"] == "alive"
+                        for l in sup.status()["lanes"]),
+            60.0, what="lane 0 readmission")
+        time_to_readmit = time.monotonic() - t_kill
+        fault_window.clear()
+        time.sleep(window / 3)  # recovered steady state
+        # ---- fault 2: pool squeeze -> shed + client retry -----------
+        shed_before = group.metrics.counters["requests_shed"].value
+        chaos.squeeze_pool(0.97)
+        time.sleep(min(3.0, window / 4))
+        chaos.heal_pool()
+        time.sleep(min(3.0, window / 4))
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    finally:
+        stop.set()
+        chaos.stop()
+        sup.stop()
+        group.stop()
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(
+            vals[min(len(vals) - 1, int(q / 100 * (len(vals) - 1)))], 4)
+
+    c = group.metrics.counters
+    result = {
+        "metric": "chaos_serve_acked_loss",
+        "value": stats["acked_loss"],
+        "unit": "requests",
+        "mode": "chaos_serve",
+        "lanes": n,
+        "clients": n_clients,
+        "completed": stats["completed"],
+        "acked_loss": stats["acked_loss"],
+        "time_to_quarantine_s": round(time_to_quarantine, 3),
+        "time_to_readmit_s": round(time_to_readmit, 3),
+        "requests_migrated": c["requests_migrated"].value,
+        "requests_retried": c["requests_retried"].value,
+        "requests_shed": c["requests_shed"].value - shed_before,
+        "admission_pauses": c["engine_admission_paused"].value,
+        "admission_resumes": c["engine_admission_resumed"].value,
+        "client_retries": stats["client_retries"],
+        "lane_quarantines": c["lane_quarantines"].value,
+        "lane_readmissions": c["lane_readmissions"].value,
+        "finish_reasons": stats["reasons"],
+        "p95_ttft_steady_s": pct(stats["ttft_steady"], 95),
+        "p95_ttft_fault_s": pct(stats["ttft_fault"], 95),
+        "detector_suspect_s": sup.suspect_s,
+        "detector_quarantine_s": sup.quarantine_s,
+    }
+    if stats["acked_loss"]:
+        result["error"] = (f"ACKED LOSS: {stats['acked_loss']} requests "
+                           f"lost/duplicated a chunk or failed "
+                           f"non-retryably during the fault drill")
+    return result
+
+
 _MODES = {
     "echo": bench_echo,
     "serve": bench_serve,
@@ -1098,6 +1290,7 @@ _MODES = {
     "dpserve": bench_dpserve,
     "longctx": bench_longctx,
     "ha": bench_ha,
+    "chaos_serve": bench_chaos_serve,
 }
 
 # dpserve is NOT here: it is a virtual-CPU-device measurement by design
@@ -1105,12 +1298,12 @@ _MODES = {
 _NEEDS_BACKEND = {"serve", "group", "tooluse", "swarm100", "longctx"}
 
 # what `mode=all` actually runs; the watchdog scales its limit by THIS
-# count, not len(_MODES). ha runs right after echo (CPU-only, seconds of
-# wall time, no backend); longctx runs LAST: it is the slowest warmup,
-# so a cold-container budget squeeze sheds the long-context line rather
-# than the headline serve/tooluse records
-_ALL_MODES = ("echo", "ha", "serve", "group", "tooluse", "swarm100",
-              "dpserve", "longctx")
+# count, not len(_MODES). ha and chaos_serve run right after echo
+# (CPU-only, seconds of wall time, no TPU backend); longctx runs LAST:
+# it is the slowest warmup, so a cold-container budget squeeze sheds the
+# long-context line rather than the headline serve/tooluse records
+_ALL_MODES = ("echo", "ha", "chaos_serve", "serve", "group", "tooluse",
+              "swarm100", "dpserve", "longctx")
 
 
 def _force_cpu() -> None:

@@ -24,6 +24,11 @@ Directive grammar (comments beginning ``# swarmlint:``):
     detector's evaluation path — blocking I/O and lock acquisition inside
     it are findings (heartbeat.py, SWL601/SWL602): a detector that can
     stall turns a healthy leader into a "dead" one.
+``# swarmlint: retry``
+    On (or directly above) a ``def``: the function retries fallible work —
+    every loop inside it must carry a bound, a backoff, and a deadline
+    check (retry.py, SWL701): an undisciplined retry loop turns one
+    failure into a retry storm.
 ``# swarmlint: disable=<rule>[,<rule>] [-- reason]``
     Suppress the named rules (ids like ``SWL101`` or family names like
     ``host-sync``) on this line, or — when the comment is a standalone
@@ -127,6 +132,11 @@ RULES: Dict[str, Rule] = {
              "lock acquisition inside `# swarmlint: heartbeat` code — "
              "detector evaluation must stay lock-free (a writer holding "
              "the lock stalls the verdict)"),
+        Rule("SWL701", "retry-discipline",
+             "retry loop in `# swarmlint: retry` code with no bound, no "
+             "backoff, or no deadline check — an undisciplined retry "
+             "loop turns one failure into a retry storm (and a hung "
+             "dependency into a hung caller)"),
     )
 }
 
@@ -192,6 +202,7 @@ class GuardDecl:
 class Directives:
     hot_lines: Set[int] = field(default_factory=set)
     heartbeat_lines: Set[int] = field(default_factory=set)
+    retry_lines: Set[int] = field(default_factory=set)
     # line -> None (suppress all) or set of rule ids
     disables: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
     comment_only_lines: Set[int] = field(default_factory=set)
@@ -216,6 +227,9 @@ def _parse_directive(body: str, line: int, out: Directives) -> None:
         return
     if body == "heartbeat" or body.startswith("heartbeat "):
         out.heartbeat_lines.add(line)
+        return
+    if body == "retry" or body.startswith("retry "):
+        out.retry_lines.add(line)
         return
     if body.startswith("disable"):
         rest = body[len("disable"):]
@@ -374,6 +388,20 @@ class SourceFile:
                 return True
         return False
 
+    def is_retry(self, fn: ast.AST) -> bool:
+        """Retry-path function: ``# swarmlint: retry`` on the
+        decorator/def lines or directly above (same marker style as
+        ``hot``/``heartbeat``). Loops inside must carry a bound, a
+        backoff, and a deadline check (retry.py, SWL701)."""
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        first = min([fn.lineno]
+                    + [d.lineno for d in fn.decorator_list]) - 1
+        for line in range(first, fn.body[0].lineno):
+            if line in self.directives.retry_lines:
+                return True
+        return False
+
     def held_guards(self, fn: ast.AST) -> Set[str]:
         """Guards a ``# swarmlint: holds[...]`` directive on/above the
         def declares as already held by this function's callers."""
@@ -470,7 +498,8 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def analyze_file(path: str, select: Optional[Set[str]] = None,
                  text: Optional[str] = None) -> List[Finding]:
-    from . import heartbeat, hostsync, locks, recompile, spans, tracers
+    from . import heartbeat, hostsync, locks, recompile, retry, spans, \
+        tracers
 
     try:
         src = SourceFile(path, text=text)
@@ -480,7 +509,8 @@ def analyze_file(path: str, select: Optional[Set[str]] = None,
         raise SyntaxError(f"{path}: {exc}") from None
     findings: List[Finding] = []
     for checker in (hostsync.check, recompile.check, locks.check,
-                    tracers.check, spans.check, heartbeat.check):
+                    tracers.check, spans.check, heartbeat.check,
+                    retry.check):
         findings.extend(checker(src))
     out = []
     seen = set()

@@ -660,6 +660,13 @@ def create_app(
         sentinel = getattr(db, "sentinel", None)
         if sentinel is not None:
             lines.extend(await _run_sync(sentinel.prometheus_lines))
+        # lane supervision (ISSUE 9): per-lane health state + beat age
+        # (0=alive, 1=suspect, 2=quarantined). The migration/shed/retry
+        # counters (swarmdb_requests_migrated / _shed / _retried) are
+        # plain registry counters and already rendered above.
+        supervisor = getattr(serving, "supervisor", None)
+        if supervisor is not None:
+            lines.extend(await _run_sync(supervisor.prometheus_lines))
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -840,6 +847,18 @@ def create_app(
             return web.json_response(flight.last_dump)
         return web.json_response(await _run_sync(flight.dump))
 
+    async def admin_lanes(request: web.Request) -> web.Response:
+        """GET /admin/lanes — the lane supervisor's full status: per-lane
+        state machine (alive/suspect/quarantined), beat ages, quarantine
+        and restart counts, and the migration/retry/shed/deadline
+        counters ("a lane is quarantined — what to check", runbook
+        step 7)."""
+        require_admin(current_agent(request))
+        supervisor = getattr(serving, "supervisor", None)
+        if supervisor is None:
+            raise _error(503, "no lane supervisor attached")
+        return web.json_response(await _run_sync(supervisor.status))
+
     async def dashboard(request: web.Request) -> web.Response:
         """GET /dashboard: self-contained observability page (the
         kafka-ui counterpart — reference dockerfile-compose.yaml:51-62).
@@ -1009,6 +1028,7 @@ def create_app(
         web.get("/admin/flight", flight_record),
         web.get("/admin/slo", admin_slo),
         web.get("/admin/ha", admin_ha),
+        web.get("/admin/lanes", admin_lanes),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

@@ -59,6 +59,25 @@ from .sampling import (SamplingParams, make_slot_keys,
 
 logger = logging.getLogger("swarmdb_tpu.engine")
 
+#: Finish reasons a client (or the lane supervisor) may transparently
+#: retry: the request itself was fine — the ENGINE lost it (loop death,
+#: lane quarantine, transient dispatch failure) or deliberately returned
+#: it (pool-pressure shedding, a stale rolling-resume epoch). Mirrors the
+#: ``BrokerError.retryable`` contract from the HA control plane: the
+#: failure names itself retryable instead of every caller keeping a
+#: private list. Non-retryable reasons ("eos", "length", "cancelled",
+#: "deadline") are final.
+RETRYABLE_REASONS = frozenset({
+    "engine_error", "engine_restart", "lane_quarantined", "shed",
+    "stale_resume",
+})
+
+
+def is_retryable_reason(reason: str) -> bool:
+    """True when a finish reason is safe to requeue (see
+    :data:`RETRYABLE_REASONS`)."""
+    return reason in RETRYABLE_REASONS
+
 
 @dataclass
 class GenRequest:
@@ -102,6 +121,17 @@ class GenRequest:
     # load-spreading rotation would scatter turns (and their
     # registrations) across shards. Advisory: any free slot still admits.
     shard_hint: Optional[int] = None
+    # ---- fault-tolerant serving (ISSUE 9) -----------------------------
+    # deadline: absolute wall-clock time past which this request must not
+    # be served. The engine fails expired QUEUED requests with reason
+    # "deadline" during admission (never a half-served stream); the lane
+    # supervisor enforces it end to end and refuses retries that cannot
+    # fit before it. None = no deadline.
+    deadline: Optional[float] = None
+    # retries_left: how many times a RETRYABLE failure (see
+    # RETRYABLE_REASONS) may transparently requeue this request before
+    # the failure surfaces. Consumed by the supervisor, not the engine.
+    retries_left: int = 0
 
 
 @dataclass
@@ -308,6 +338,55 @@ class Engine:
         self._temp = np.zeros(max_batch, np.float32)
         self._topk = np.zeros(max_batch, np.int32)
         self._topp = np.ones(max_batch, np.float32)
+
+        # ---- lane supervision signal (backend/supervisor.py) -------------
+        # Per-step liveness beat: a plain monotonic float slot written by
+        # the engine/emission threads and read by the supervisor — the
+        # same single-writer-stamp discipline as the HA failure detector
+        # (ha/detector.py). A wedged device dispatch stops the loop from
+        # iterating, so the beat goes stale while the thread stays alive:
+        # exactly the two-signal split the supervisor's state machine
+        # (ALIVE -> SUSPECT -> QUARANTINED) distinguishes.
+        self._beat_mono = time.monotonic()
+        # True while the loop is inside an engine step (admission /
+        # dispatch / block processing). A first-traffic XLA compile can
+        # legitimately stall a step for tens of seconds with no beats —
+        # the supervisor grants in-step stalls a compile grace window
+        # (SWARMDB_LANE_DISPATCH_GRACE_S) before quarantining, while a
+        # stall OUTSIDE a step (the chaos wedge seam, a stuck lock) gets
+        # none. Single-writer bool slot, loop thread only.
+        self._in_step = False
+        # Fault-injection seam (backend/chaos.py): called once per engine
+        # loop iteration, on the engine thread, BEFORE admission. A kill
+        # fault raises LaneKilled (a BaseException, so the loop's error
+        # recovery cannot swallow it and the thread dies for real); wedge
+        # and slow faults block/sleep here, starving the beat. None in
+        # production.
+        self.chaos_step: Optional[Callable[["Engine"], None]] = None
+
+        # ---- pool-watermark backpressure (paged engines) ------------------
+        # Page-pool exhaustion used to block admission indefinitely with
+        # no signal. Watermarks over NON-RECLAIMABLE pool utilization
+        # (free + evictable prefix-cache pages count as headroom):
+        # admission pauses at the high watermark and resumes at the low
+        # one (hysteresis — no admit/fail thrash at the boundary), and
+        # past the hard SHED watermark the lowest-priority queued work is
+        # returned with retryable reason "shed" so higher-priority work
+        # drains first. SWARMDB_POOL_HIGH >= 1 disables.
+        def _env_frac(name: str, default: float) -> float:
+            try:
+                return float(os.environ.get(name, default))
+            except ValueError:
+                logger.warning("%s=%r is not a float; using %g", name,
+                               os.environ.get(name), default)
+                return default
+
+        self._bp_high = _env_frac("SWARMDB_POOL_HIGH", 0.92)
+        self._bp_low = min(_env_frac("SWARMDB_POOL_LOW", 0.80),
+                           self._bp_high)
+        self._bp_shed = max(_env_frac("SWARMDB_POOL_SHED", 0.98),
+                            self._bp_high)
+        self._bp_paused = False
 
         self._queue: List[Tuple[int, float, int, GenRequest]] = []  # heap
         # rotates the DP-shard interleave in _free_slot_ids (engine
@@ -919,6 +998,27 @@ class Engine:
     def alive(self) -> bool:
         """True while the decode loop thread is running."""
         return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------- supervision signals
+
+    # swarmlint: heartbeat
+    def _beat(self) -> None:
+        """Per-step liveness proof (engine loop / emission callback):
+        one monotonic read into a single-writer float slot — the
+        supervisor's verdict path reads it lock-free."""
+        self._beat_mono = time.monotonic()
+
+    def _chaos_pending(self) -> bool:
+        cs = self.chaos_step
+        return cs is not None and getattr(cs, "pending",
+                                          lambda: False)()
+
+    # swarmlint: heartbeat
+    def beat_age_s(self, now: float = 0.0) -> float:
+        """Seconds since the decode loop last proved progress. Idle
+        engines still beat (the admission wait loop stamps every wait
+        tick); only a dead or wedged loop lets this grow."""
+        return (now or time.monotonic()) - self._beat_mono
 
     # ---------------------------------------------------------- multi-host
 
@@ -1785,10 +1885,20 @@ class Engine:
         in_flight: List[Tuple[Any, Any, List[Tuple[int, GenRequest, int]],
                               int]] = []
         while True:
+            self._in_step = False
+            self._beat()
             with self._cv:
                 while (not self._stop and not self._queue
-                       and not self._any_active() and not in_flight):
-                    self._cv.wait(timeout=0.5)
+                       and not self._any_active() and not in_flight
+                       and not self._chaos_pending()):
+                    # idle engines must still beat or the supervisor
+                    # would read "no work" as "wedged"; the tick bounds
+                    # idle beat staleness well under any sane suspect
+                    # threshold. An armed chaos fault exits the wait so
+                    # it lands at the seam below (outside the lock) even
+                    # on an idle lane.
+                    self._beat()
+                    self._cv.wait(timeout=0.25)
                 stopping = self._stop
             if stopping:
                 # drain dispatched chunks so their requests complete
@@ -1803,6 +1913,14 @@ class Engine:
                         logger.exception("drain on stop failed")
                 in_flight.clear()
                 break
+            cs = self.chaos_step
+            if cs is not None:
+                # fault-injection seam (backend/chaos.py): kill raises
+                # LaneKilled (BaseException — deliberately NOT caught by
+                # the recovery handler below, the thread dies); wedge
+                # blocks here, starving the liveness beat
+                cs(self)
+            self._in_step = True
             try:
                 self._admit()
                 if self._use_resident():
@@ -2027,6 +2145,119 @@ class Engine:
 
     # ------------------------------------------------------------- admission
 
+    def _expire_deadlines(self) -> None:  # swarmlint: hot
+        """Fail QUEUED requests whose deadline already passed with reason
+        "deadline" (final, not retryable): serving them would stream into
+        a client that stopped waiting, and admitting them burns pool
+        pages higher-priority live requests need. In-flight requests are
+        never cut mid-stream — the supervisor's deadline watch cancels
+        those at chunk granularity."""
+        now = time.time()
+        expired: List[GenRequest] = []
+        with self._cv:
+            if not self._queue:
+                return
+            keep = []
+            for item in self._queue:
+                req = item[3]
+                if req.deadline is not None and now > req.deadline:
+                    expired.append(req)
+                else:
+                    keep.append(item)
+            if expired:
+                self._queue[:] = keep
+                heapq.heapify(self._queue)
+        for req in expired:
+            self.metrics.counters["requests_deadline_expired"].inc()
+            if req.on_done is not None:
+                try:
+                    req.on_done(req.request_id, [], "deadline")
+                except Exception:
+                    logger.exception("on_done callback failed")
+
+    def _pool_headroom(self) -> float:
+        """Fraction of the page pool still claimable by admission: free
+        pages plus UNPINNED prefix-cache pages (the cache fills the pool
+        by design — counting cached-but-evictable pages as used would
+        read a healthy warm cache as pressure)."""
+        free = self.paged.allocator.free_count()
+        if self._prefix is not None:
+            free += self._prefix.evictable_count()
+        cap = max(1, self.paged.num_pages - 1)  # page 0 is trash
+        return min(1.0, free / cap)
+
+    def _backpressure_gate(self) -> bool:  # swarmlint: hot
+        """Watermark hysteresis over pool utilization; returns True when
+        admission may proceed. Paused admission still reclaims retired
+        pages (the caller runs the pending-free flush first) and still
+        fires the pool-pressure hook, so parked rolling conversations
+        get evicted instead of deadlocking the pause."""
+        if self.paged is None or self._bp_high >= 1.0:
+            return True
+        util = 1.0 - self._pool_headroom()
+        if self._bp_paused:
+            if util <= self._bp_low:
+                self._bp_paused = False
+                self.metrics.counters["engine_admission_resumed"].inc()
+                self.flight.record_event(
+                    {"kind": "pool.backpressure_resumed",
+                     "util": round(util, 3), "shard": self.flight_shard})
+                return True
+        elif util >= self._bp_high:
+            self._bp_paused = True
+            self.metrics.counters["engine_admission_paused"].inc()
+            self.flight.record_event(
+                {"kind": "pool.backpressure_paused",
+                 "util": round(util, 3), "shard": self.flight_shard})
+            self.tracer.instant("pool.backpressure", cat="engine",
+                                args={"util": round(util, 3)})
+        if not self._bp_paused:
+            return True
+        # paused: free what can be freed, shed what must be shed
+        if self.on_pool_pressure is not None:
+            cap = max(1, self.paged.num_pages - 1)
+            need = max(1, int((util - self._bp_low) * cap))
+            try:
+                self.on_pool_pressure(need)
+            except Exception:
+                logger.exception("pool-pressure callback failed")
+        if util >= self._bp_shed:
+            self._shed_lowest()
+        return False
+
+    def _shed_lowest(self) -> None:  # swarmlint: hot
+        """Past the hard watermark: return the lowest-priority queued
+        class with retryable reason "shed" so the higher classes drain
+        the remaining pool first. Priority-aware by construction — a
+        homogeneous queue sheds nothing (there is no lower-priority work
+        to sacrifice; deadlines bound those waits instead)."""
+        shed: List[GenRequest] = []
+        with self._cv:
+            if len(self._queue) < 2:
+                return
+            prios = {-negp for negp, _, _, _ in self._queue}
+            if len(prios) < 2:
+                return
+            lowest = min(prios)
+            keep = []
+            for item in self._queue:
+                if -item[0] == lowest:
+                    shed.append(item[3])
+                else:
+                    keep.append(item)
+            self._queue[:] = keep
+            heapq.heapify(self._queue)
+        for req in shed:
+            self.metrics.counters["requests_shed"].inc()
+            self.flight.record_event(
+                {"kind": "pool.request_shed", "rid": req.request_id,
+                 "priority": req.priority, "shard": self.flight_shard})
+            if req.on_done is not None:
+                try:
+                    req.on_done(req.request_id, [], "shed")
+                except Exception:
+                    logger.exception("on_done callback failed")
+
     def _admit(self) -> None:  # swarmlint: hot
         """Move queued requests into free slots (highest priority first) and
         run their prefill in groups of up to ``prefill_batch``.
@@ -2036,6 +2267,7 @@ class Engine:
         finding); every popped request is still admitted this round.
         """
         self._age_queue()
+        self._expire_deadlines()
         if self.paged:
             # reclaim retired slots' pages first: zero their table rows on
             # device (mirrored to pod workers), THEN return pages to the
@@ -2049,6 +2281,8 @@ class Engine:
                              np.int32),
                 )
                 self.paged.allocator.release_taken(pending)
+            if not self._backpressure_gate():
+                return
         pressure_called = False
         while True:
             stale_resumes: List[GenRequest] = []
@@ -2907,6 +3141,12 @@ class Engine:
         # chunk of every lane
         if self._stop:  # swarmlint: disable=SWL301 -- chunk-granular race is benign
             return False
+        cs = self.chaos_step
+        if cs is not None and getattr(cs, "pending", lambda: False)():
+            # an armed chaos fault must land at the loop-top seam: exit
+            # the session so the next iteration runs chaos_step (a kill
+            # raised inside this ordered callback would be swallowed)
+            return False
         active = any(s.active for s in self.slots)
         if not active:
             return False
@@ -3070,6 +3310,10 @@ class Engine:
         BOTH paths — the scan path after its per-chunk drain, and the
         resident emission ring's ordered callback (where the device is
         never waited on)."""
+        # the engine thread parks in the session drain for a whole
+        # resident session, so the emission callback is where a live lane
+        # proves progress — beat HERE, not just in the loop
+        self._beat()
         t_done_ns = time.monotonic_ns()
         if t_dispatch_ns:
             # per-chunk latency, dispatch -> processed (pipelined chunks
@@ -3355,4 +3599,7 @@ class Engine:
         }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
+        if self.paged is not None:
+            out["pool_headroom"] = round(self._pool_headroom(), 4)
+            out["admission_paused"] = self._bp_paused
         return out

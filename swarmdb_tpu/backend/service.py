@@ -331,6 +331,23 @@ class ServingService:
         db.sentinel.bind(flight=engine.flight, tracer=engine.tracer,
                          flight_dir=engine._flight_dir)
         engine.sentinel = db.sentinel
+        # lane supervision + retry/deadline budgets (ISSUE 9,
+        # backend/supervisor.py): every served request is adopted —
+        # deadline (SWARMDB_REQ_DEADLINE_S) + retry budget
+        # (SWARMDB_REQ_RETRIES) stamped, retryable engine losses
+        # (RETRYABLE_REASONS) requeued with jittered backoff, and lane
+        # groups get quarantine/migration/re-admission. SWARMDB_SUPERVISE=0
+        # restores the bare watchdog-restart behavior.
+        self.supervisor = None
+        if os.environ.get("SWARMDB_SUPERVISE", "1") != "0":
+            from .supervisor import LaneSupervisor
+
+            if getattr(engine, "lanes", None) is not None:
+                self.supervisor = engine.attach_supervisor(
+                    metrics=db.metrics)
+            else:
+                self.supervisor = LaneSupervisor(
+                    engine, metrics=db.metrics).start()
         self._consumer_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # Reply emission (tokenizer decode + send_message + persistence
@@ -463,6 +480,10 @@ class ServingService:
         if self._consumer_thread is not None:
             self._consumer_thread.join(timeout=10)
             self._consumer_thread = None
+        if self.supervisor is not None:
+            # stop supervision BEFORE the engine: a lane going dead
+            # during shutdown must not trigger a restart/migration race
+            self.supervisor.stop()
         self.engine.stop()
         if self._reply_thread is not None:
             self._reply_queue.put(None)  # sentinel AFTER engine drained
@@ -482,8 +503,11 @@ class ServingService:
         while not self._stop.is_set():
             # watchdog (SURVEY §5.3): a dead decode loop strands every
             # in-flight and queued request — restart it, failing them fast
-            # so lineage/resend applies instead of silent timeouts
-            if not self.engine.alive():
+            # so lineage/resend applies instead of silent timeouts. With a
+            # supervisor attached, recovery (and per-lane quarantine) is
+            # ITS job — engine.alive() then only reads dead when every
+            # lane is gone AND the supervisor's own restarts failed.
+            if self.supervisor is None and not self.engine.alive():
                 logger.error("engine loop dead; restarting backend %s",
                              self.backend_id)
                 try:
@@ -1036,7 +1060,7 @@ class ServingService:
                 rid = self._serve_n(msg, req, prompt, sampling, priority, n,
                                     want_logprobs, on_done)
             else:
-                rid = self.engine.submit(req)
+                rid = self._submit(req)
             # the span covers prompt build + trim + submit; args link the
             # message id to the ENGINE request id so one export joins the
             # runtime/broker spans (rid = msg.id) to the engine spans
@@ -1116,7 +1140,7 @@ class ServingService:
         submitted = []
         try:
             for r in reqs:
-                self.engine.submit(r)
+                self._submit(r)
                 submitted.append(r)
         except Exception:
             # a later member failed to submit: without the full group the
@@ -1150,10 +1174,22 @@ class ServingService:
 
         return _watch
 
+    def _submit(self, req: GenRequest) -> str:
+        """One submission seam: through the supervisor when attached
+        (adoption + health-aware routing), straight to the engine
+        otherwise."""
+        if self.supervisor is not None:
+            return self.supervisor.submit(req)
+        return self.engine.submit(req)
+
     def cancel_request(self, rid: str) -> None:
         """Cancel a serve_message request INCLUDING any n>1 fan-out
-        members (engine.cancel alone only reaches completion 0)."""
+        members (engine.cancel alone only reaches completion 0). The
+        supervisor is consulted first: a request parked on a retry
+        timer lives in no engine's queue."""
         for r in self._fanout.pop(rid, [rid]):
+            if self.supervisor is not None and self.supervisor.cancel(r):
+                continue
             self.engine.cancel(r)
 
     def _reply_loop(self) -> None:

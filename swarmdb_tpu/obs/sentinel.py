@@ -80,7 +80,7 @@ class SLOConfig:
 
     __slots__ = ("window_s", "warmup_windows", "min_completions",
                  "ttft_p95_s", "queue_p95_s", "cost_growth_x",
-                 "max_alerts", "enabled")
+                 "retry_rate", "max_alerts", "enabled")
 
     def __init__(self,
                  window_s: Optional[float] = None,
@@ -89,6 +89,7 @@ class SLOConfig:
                  ttft_p95_s: Optional[float] = None,
                  queue_p95_s: Optional[float] = None,
                  cost_growth_x: Optional[float] = None,
+                 retry_rate: Optional[float] = None,
                  max_alerts: Optional[int] = None,
                  enabled: Optional[bool] = None) -> None:
         self.window_s = window_s if window_s is not None else \
@@ -106,6 +107,13 @@ class SLOConfig:
             _env_float("SWARMDB_SLO_QUEUE_P95_S", 1.0)
         self.cost_growth_x = cost_growth_x if cost_growth_x is not None \
             else _env_float("SWARMDB_SLO_COST_GROWTH_X", 2.0)
+        # retry-rate SLO (ISSUE 9): supervised retries (lane migration
+        # requeues, shed-and-retry, engine-loss requeues) per completion
+        # in the window. A flapping lane shows up HERE — each flap
+        # re-fails its migrated requests — before throughput degrades
+        # enough to trip the cost SLO.
+        self.retry_rate = retry_rate if retry_rate is not None else \
+            _env_float("SWARMDB_SLO_RETRY_RATE", 0.5)
         self.max_alerts = max_alerts if max_alerts is not None else \
             _env_int("SWARMDB_SLO_ALERTS", 64)
         self.enabled = enabled if enabled is not None else \
@@ -206,7 +214,8 @@ class SLOSentinel:
 
     def _snapshot_counters(self) -> Dict[str, int]:
         names = ["engine_completed", "engine_admitted",
-                 "engine_admission_waves", "engine_host_syncs"]
+                 "engine_admission_waves", "engine_host_syncs",
+                 "requests_retried", "requests_migrated", "requests_shed"]
         names += [f"phase_us_{c}" for c in CATEGORIES]
         return {n: self._counter_value(n) for n in names}
 
@@ -256,7 +265,13 @@ class SLOSentinel:
                 HIST_TTFT.boundaries, cur_ttft, self._prev_ttft),
             "p95_queue_wait_s": self._p95_from_delta(
                 HIST_QUEUE_WAIT.boundaries, cur_queue, self._prev_queue),
+            "retried": cur["requests_retried"] - prev["requests_retried"],
+            "migrated": (cur["requests_migrated"]
+                         - prev["requests_migrated"]),
+            "shed": cur["requests_shed"] - prev["requests_shed"],
         }
+        window["retry_rate"] = round(
+            window["retried"] / max(1, completed), 3)
         self._prev_ttft, self._prev_queue = cur_ttft, cur_queue
         denom = max(1, completed)
         per_completion = {}
@@ -303,6 +318,9 @@ class SLOSentinel:
         w.setdefault("completed", 0)
         w.setdefault("admission_waves", 0)
         w.setdefault("mean_wave_size", 0.0)
+        w.setdefault("retried", 0)
+        w.setdefault("retry_rate",
+                     round(w["retried"] / max(1, w["completed"]), 3))
         return w
 
     def _baseline_from_warmup(self) -> Dict[str, Any]:
@@ -364,6 +382,10 @@ class SLOSentinel:
         if queue is not None and queue > cfg.queue_p95_s:
             breaches.append({"slo": "queue_wait_p95_s",
                              "limit": cfg.queue_p95_s, "value": queue})
+        rr = window.get("retry_rate")
+        if rr is not None and rr > cfg.retry_rate:
+            breaches.append({"slo": "retry_rate", "limit": cfg.retry_rate,
+                             "value": rr})
         base_cost = sum(self.baseline["per_completion_ms"].values())
         cost = sum(window["per_completion_ms"].values())
         growth = (cost / base_cost) if base_cost > 0 else 1.0
@@ -506,6 +528,9 @@ class SLOSentinel:
         if w.get("cost_growth_x") is not None:
             lines.append("# TYPE swarmdb_slo_cost_growth_x gauge")
             lines.append(f"swarmdb_slo_cost_growth_x {w['cost_growth_x']}")
+        if w.get("retry_rate") is not None:
+            lines.append("# TYPE swarmdb_slo_retry_rate gauge")
+            lines.append(f"swarmdb_slo_retry_rate {w['retry_rate']}")
         if w.get("per_completion_ms"):
             lines.append("# TYPE swarmdb_slo_per_completion_ms gauge")
             for cat in CATEGORIES:

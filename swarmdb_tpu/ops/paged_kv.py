@@ -326,6 +326,17 @@ class PageAllocator:
         with self._lock:
             self._give(page_ids)
 
+    def reserve(self, n: int) -> List[int]:
+        """Withdraw up to ``n`` free pages from circulation (serving
+        chaos: pool-squeeze fault). Reserved pages are never referenced
+        by any table row — the fault only starves admission, exactly
+        like a burst of long-lived occupants. Return them with
+        :meth:`add_free` (the heal path); a reset() reclaims them
+        implicitly (the ids die with the generation)."""
+        with self._lock:
+            take = min(n, len(self._free))
+            return [self._free.pop() for _ in range(take)]
+
     def free_count(self, slot_id: Optional[int] = None) -> int:
         """Free pages available — to ``slot_id`` if given (the sharded
         allocator restricts each slot to its shard's sub-pool)."""

@@ -196,6 +196,15 @@ class PrefixLRU:
             self._entries.clear()
             self._pins.clear()
 
+    def evictable_count(self) -> int:
+        """How many cached pages could be evicted right now (cached and
+        not pinned) — the page-pool backpressure gate counts these as
+        headroom, since admission can always reclaim them via
+        evict_lru."""
+        with self._lock:
+            return sum(1 for _, (p, _t) in self._entries.items()
+                       if not self._pins.get(p))
+
     def free_count(self) -> int:
         """Managed-free mode: pages immediately takeable without eviction
         (the dense rolling registry's headroom probe)."""

@@ -41,7 +41,7 @@ import concurrent.futures
 import logging
 import threading
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -154,6 +154,11 @@ class ShardLaneGroup:
                         else None)
         self._prefix_ps = getattr(ref, "_prefix_ps", None)
         self._sentinel = None
+        # lane supervisor (backend/supervisor.py, ISSUE 9): attached by
+        # the serving layer (or tests). When present, submissions are
+        # adopted (deadline/retry budgets, migration tracking) and
+        # routing excludes quarantined lanes.
+        self.supervisor = None
         self._rr = 0
         self._rr_lock = threading.Lock()
         for idx, eng in enumerate(lanes):
@@ -179,6 +184,13 @@ class ShardLaneGroup:
             e.stop()
 
     def alive(self) -> bool:
+        """Without a supervisor, any dead lane makes the group "dead"
+        (the serving watchdog then restarts the dead ones via
+        restart()). WITH a supervisor, single-lane death is the
+        supervisor's job — quarantine, migrate, restart, probe, re-admit
+        — so the group only reads dead when EVERY lane is gone."""
+        if self.supervisor is not None:
+            return any(e.alive() for e in self.lanes)
         return all(e.alive() for e in self.lanes)
 
     def restart(self) -> None:
@@ -206,25 +218,56 @@ class ShardLaneGroup:
 
     # -------------------------------------------------------- scheduling
 
-    def _lane_for(self, request: GenRequest) -> Engine:
+    def _admissible(self) -> List[int]:
+        """Lane indices currently taking admissions. A quarantined lane
+        (supervisor verdict) is excluded; if EVERY lane is quarantined
+        the full set is returned — queueing on a recovering lane beats
+        refusing outright (deadlines bound the wait)."""
+        sup = self.supervisor
+        if sup is None:
+            return list(range(len(self.lanes)))
+        ok = [j for j in range(len(self.lanes)) if sup.lane_admissible(j)]
+        return ok or list(range(len(self.lanes)))
+
+    def _route(self, request: GenRequest) -> "Tuple[int, Engine]":
+        ok = self._admissible()
         if request.shard_hint is not None:
-            return self.lanes[request.shard_hint % len(self.lanes)]
-        # least-loaded lane; racy reads are fine (load balance is a
-        # heuristic, correctness never depends on it). Round-robin
+            j = request.shard_hint % len(self.lanes)
+            if j in ok:
+                return j, self.lanes[j]
+            # hinted lane quarantined: deterministic remap so a
+            # conversation's turns keep landing together (prefix reuse
+            # on the fallback lane) until the home lane is re-admitted
+            j = ok[request.shard_hint % len(ok)]
+            return j, self.lanes[j]
+        # least-loaded admissible lane; racy reads are fine (load balance
+        # is a heuristic, correctness never depends on it). Round-robin
         # tiebreak so an idle group still spreads arrivals.
         with self._rr_lock:
             self._rr += 1
             rot = self._rr
         loads = []
-        for j, e in enumerate(self.lanes):
+        for j in ok:
+            e = self.lanes[j]
             load = len(e._queue) + sum(1 for s in e.slots if s.active)
-            loads.append((load, (j + rot) % len(self.lanes), e))
-        return min(loads, key=lambda t: (t[0], t[1]))[2]
+            loads.append((load, (j + rot) % len(self.lanes), j, e))
+        _, _, j, e = min(loads, key=lambda t: (t[0], t[1]))
+        return j, e
+
+    def _lane_for(self, request: GenRequest) -> Engine:
+        return self._route(request)[1]
 
     def submit(self, request: GenRequest) -> str:
+        if self.supervisor is not None:
+            # adoption (deadline/retry budgets, migration tracking) +
+            # health-aware routing; the supervisor calls _route directly
+            return self.supervisor.submit(request)
         return self._lane_for(request).submit(request)
 
     def cancel(self, request_id: str) -> bool:
+        if self.supervisor is not None and self.supervisor.cancel(
+                request_id):
+            return True
         for e in self.lanes:
             if e.cancel(request_id):
                 return True
@@ -297,7 +340,20 @@ class ShardLaneGroup:
         }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
+        if self.supervisor is not None:
+            out["lane_states"] = [
+                l["state"] for l in self.supervisor.status()["lanes"]]
         return out
+
+    def attach_supervisor(self, **kwargs) -> Any:
+        """Build, attach, and start a LaneSupervisor over this group
+        (idempotent). The serving layer calls this unless
+        SWARMDB_SUPERVISE=0."""
+        if self.supervisor is None:
+            from ..backend.supervisor import LaneSupervisor
+
+            self.supervisor = LaneSupervisor(self, **kwargs).start()
+        return self.supervisor
 
 
 def build_lane_group(

@@ -1,0 +1,75 @@
+"""Seeded retry-discipline violations (SWL701) — lint fixture.
+
+Not imported by anything; analyzed as text by tests/test_swarmlint.py.
+The shapes mirror the bugs ``backend/supervisor.py``'s recovery paths
+must never grow: a retry loop with no bound turns one failure into a
+storm, no backoff hammers the recovering dependency, no deadline turns
+a hung dependency into a hung caller.
+"""
+
+import itertools
+import time
+
+
+class FlakyClient:
+    def __init__(self, conn):
+        self._conn = conn
+
+    # swarmlint: retry
+    def retry_forever(self):
+        while True:  # EXPECT: SWL701
+            if self._conn.send(b"?"):
+                return True
+
+    # swarmlint: retry
+    def retry_no_backoff_no_deadline(self, attempts):
+        n = 0
+        while True:  # EXPECT: SWL701
+            if self._conn.send(b"?"):
+                return True
+            n += 1
+            if n >= attempts:
+                break
+        return False
+
+    # swarmlint: retry
+    def retry_no_deadline(self, attempts):
+        n = 0
+        while True:  # EXPECT: SWL701
+            if self._conn.send(b"?"):
+                return True
+            n += 1
+            if n >= attempts:
+                break
+            time.sleep(0.1 * n)
+        return False
+
+    # swarmlint: retry
+    def retry_unbounded_for(self, deadline):
+        for i in itertools.count():  # EXPECT: SWL701
+            if time.monotonic() >= deadline:
+                return False
+            if self._conn.send(b"?"):
+                return True
+            time.sleep(0.05 * (i + 1))
+
+    # swarmlint: retry
+    def retry_via_helper(self):
+        def spin():
+            while True:  # EXPECT: SWL701
+                if self._conn.send(b"?"):
+                    return True
+
+        return spin()
+
+    # swarmlint: retry
+    def retry_disciplined(self, max_attempts, deadline):
+        # clean: bounded + backoff + deadline — the supervisor's
+        # _probe_lane shape; must produce NO finding
+        for attempt in range(max_attempts):
+            if time.monotonic() >= deadline:
+                return False
+            if self._conn.send(b"?"):
+                return True
+            time.sleep(0.05 * (attempt + 1))
+        return False

@@ -44,6 +44,7 @@ def expected_findings(path: Path):
     "metrics_bad.py",           # histogram discipline (SWL503)
     "exemplar_bad.py",          # exemplar/sentinel allocation (SWL504)
     "heartbeat_bad.py",         # heartbeat-safety family (SWL601/602)
+    "retry_bad.py",             # retry-discipline family (SWL701)
 ])
 def test_each_family_detects_seeded_violations(name):
     path = FIXTURES / name
