@@ -992,12 +992,27 @@ def bench_longctx(seconds: float) -> dict:
 
 
 def bench_ha(seconds: float) -> dict:
-    """HA failover drill (ISSUE 4): a 3-node in-process cluster under
-    concurrent producers, scripted leader kill mid-window, and the two
-    numbers the acceptance contract names — ``time_to_promote_s`` (kill
-    -> a new leader wins the epoch CAS) and ``acked_loss`` (acked-durable
-    records missing after failover; MUST be 0). CPU-only, no LLM
-    backend: what's under test is the control plane, not decode."""
+    """HA failover drill. Since ISSUE 10 the default is the
+    PARTITION-LEADERSHIP drill: a 3-node cluster with a multi-partition
+    topic spread across all nodes, one producer per partition, a
+    scripted kill of the most-loaded non-controller node — measuring
+    ``acked_loss`` (MUST be 0), ``blast_radius`` (fraction of partitions
+    that observed a write stall; bounded by 1/cluster_size + one
+    partition), per-partition ``time_to_promote`` p50/p95, and the
+    aggregate-acked-write-throughput A/B against the single-leader
+    baseline (``SWARMDB_HA_PARTITION_LEADERSHIP=0`` pins the old
+    node-level drill as the control). CPU-only, no LLM backend: what's
+    under test is the control plane, not decode."""
+    if os.environ.get("SWARMDB_HA_PARTITION_LEADERSHIP",
+                      "1").strip() in ("0", "false", "no"):
+        return _bench_ha_single_leader(seconds)
+    return _bench_ha_partition(seconds)
+
+
+def _bench_ha_single_leader(seconds: float) -> dict:
+    """The PR 4 drill (node-level leadership): one leader, scripted
+    kill, time_to_promote + acked_loss. Kept verbatim as the A/B
+    control for the partition-leadership drill."""
     os.environ.setdefault("SWARMDB_HA_HEARTBEAT_S", "0.05")
     from swarmdb_tpu.broker.base import LeaderChangedError
     from swarmdb_tpu.ha import build_local_cluster, wait_until
@@ -1067,6 +1082,7 @@ def bench_ha(seconds: float) -> dict:
             "value": round(time_to_promote, 3),
             "unit": "seconds",
             "mode": "ha",
+            "variant": "single_leader",
             "acked_loss": len(lost),
             "acked_total": len(acked),
             "acked_pre_kill": acked_pre_kill,
@@ -1087,6 +1103,221 @@ def bench_ha(seconds: float) -> dict:
         stop.set()
         harness.stop()
         client.close()
+
+
+def _ha_producer_pool(client, topic: str, parts: int, n_producers: int,
+                      acked: dict, acked_lock, stop, retryable_raises):
+    """One closed-loop acked producer per partition (round-robin when
+    n_producers > parts): append -> wait_durable(=quorum) -> log
+    (monotonic stamp, payload). Retryable failures re-send the SAME
+    payload — the zero-loss contract's client half."""
+    from swarmdb_tpu.broker.base import LeaderChangedError
+
+    def produce(worker: int) -> None:
+        part = worker % parts
+        i = 0
+        while not stop.is_set():
+            payload = f"w{worker}-m{i}"
+            try:
+                off = client.append(topic, part, payload.encode())
+                if client.wait_durable(topic, part, off, 2.0):
+                    with acked_lock:
+                        acked[part].append((time.monotonic(), payload))
+                    i += 1
+            except LeaderChangedError:
+                retryable_raises[0] += 1
+                stop.wait(0.02)
+
+    threads = [threading.Thread(target=produce, args=(w,), daemon=True)
+               for w in range(n_producers)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _bench_ha_partition(seconds: float) -> dict:
+    """The ISSUE 10 drill: partition-scoped leader kill + blast radius
+    + per-partition time-to-promote + write-throughput A/B (see
+    bench_ha docstring)."""
+    os.environ.setdefault("SWARMDB_HA_HEARTBEAT_S", "0.05")
+    from swarmdb_tpu.ha import build_local_cluster, tp_key, wait_until
+
+    suspect_s = _env("SWARMDB_HA_SUSPECT_S", 0.3, float)
+    dead_s = _env("SWARMDB_HA_DEAD_S", 2 * suspect_s, float)
+    parts = _env("SWARMDB_BENCH_HA_PARTITIONS", 6, int)
+    n_producers = max(4, _env("SWARMDB_BENCH_HA_PRODUCERS", parts, int))
+    node_ids = ["ha-0", "ha-1", "ha-2"]
+    window = max(4.0, min(seconds, 30.0))
+
+    harness, cluster, client = build_local_cluster(
+        node_ids, suspect_s=suspect_s, dead_s=dead_s,
+        partition_leadership=True)
+    acked: dict = {p: [] for p in range(parts)}
+    acked_lock = threading.Lock()
+    retryable_raises = [0]
+    stop = threading.Event()
+    try:
+        wait_until(lambda: cluster.read()["leader"] == "ha-0", 5.0,
+                   what="bootstrap leader")
+        client.create_topic("bench_ha", parts)
+        wait_until(
+            lambda: len(cluster.read()["assignments"]) == parts, 5.0,
+            what="partition assignment")
+        threads = _ha_producer_pool(client, "bench_ha", parts,
+                                    n_producers, acked, acked_lock, stop,
+                                    retryable_raises)
+        time.sleep(window / 3)  # steady state before the fault
+        with acked_lock:
+            pre_kill_counts = {p: len(v) for p, v in acked.items()}
+        pre_kill_total = sum(pre_kill_counts.values())
+        throughput = pre_kill_total / (window / 3)
+
+        counts: dict = {}
+        for a in cluster.read()["assignments"].values():
+            counts[a["leader"]] = counts.get(a["leader"], 0) + 1
+        # victim: the most-loaded NON-controller node — the kill must
+        # orphan partitions without also exercising controller failover
+        victim = max((n for n in node_ids if n != "ha-0"),
+                     key=lambda n: counts.get(n, 0))
+        victim_parts = [
+            int(k.rpartition(":")[2])
+            for k, a in cluster.read()["assignments"].items()
+            if a["leader"] == victim]
+        t_kill = time.monotonic()
+        t_kill_wall = time.time()
+        harness.kill(victim)
+        wait_until(
+            lambda: all(
+                cluster.read()["assignments"][tp_key("bench_ha", p)]
+                ["leader"] != victim for p in victim_parts),
+            30.0, what="every orphaned partition re-seated")
+        t_reseated = time.monotonic()
+        # post-failover steady state: at least 3s so the stall window
+        # below can SEE the victim partitions' first post-failover ack
+        # (an in-flight wait_durable rides out its 2s timeout first)
+        time.sleep(max(window / 3, 3.0))
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+        # zero-loss audit, per partition, through the client (routes to
+        # each partition's CURRENT leader)
+        lost_total = 0
+        for p in range(parts):
+            survived = {r.value.decode()
+                        for r in client.fetch("bench_ha", p, 0, 1_000_000)}
+            with acked_lock:
+                lost_total += sum(1 for _, pay in acked[p]
+                                  if pay not in survived)
+
+        # per-partition time-to-promote from the flight ring (wall time
+        # of the CAS win minus wall time of the kill)
+        ttps = sorted(
+            max(0.0, ev["t"] - t_kill_wall)
+            for ev in harness.flight.events()
+            if ev.get("kind") == "ha.partition_promoted"
+            and ev.get("t", 0) >= t_kill_wall)
+        ttp_p50 = ttps[len(ttps) // 2] if ttps else None
+        ttp_p95 = ttps[min(len(ttps) - 1, int(len(ttps) * 0.95))] \
+            if ttps else None
+
+        # blast radius: fraction of partitions whose ack stream stalled
+        # longer than the detector's dead threshold inside the fault
+        # window (the acceptance bound: <= 1/cluster_size + 1 partition)
+        stalled = []
+        for p in range(parts):
+            with acked_lock:
+                # window reaches past the client's 2s durability-wait
+                # timeout so a victim partition's recovery gap is seen
+                times = [t for t, _ in acked[p]
+                         if t_kill - 0.5 <= t <= t_reseated + 2.5]
+            gaps = [b - a for a, b in zip(times, times[1:])]
+            if not times or (gaps and max(gaps) > dead_s):
+                stalled.append(p)
+        blast_radius = round(len(stalled) / parts, 4)
+
+        final_counts: dict = {}
+        for a in cluster.read()["assignments"].values():
+            final_counts[a["leader"]] = final_counts.get(a["leader"], 0) + 1
+        result = {
+            "metric": "ha_time_to_promote_s",
+            "value": round(ttp_p95 if ttp_p95 is not None
+                           else (t_reseated - t_kill), 3),
+            "unit": "seconds",
+            "mode": "ha",
+            "variant": "partition_leadership",
+            "acked_loss": lost_total,
+            "acked_total": sum(len(v) for v in acked.values()),
+            "acked_pre_kill": pre_kill_total,
+            "retryable_raises": retryable_raises[0],
+            "detector_suspect_s": suspect_s,
+            "detector_dead_s": dead_s,
+            "detector_budget_s": round(dead_s + 2 * suspect_s, 3),
+            "producers": n_producers,
+            "blast_radius": blast_radius,
+            "partition_leadership": {
+                "partitions": parts,
+                "cluster_size": len(node_ids),
+                "leaderships_per_node": final_counts,
+                "victim": victim,
+                "victim_partitions": victim_parts,
+                "stalled_partitions": stalled,
+                "blast_radius": blast_radius,
+                "blast_radius_bound": round(
+                    1 / len(node_ids) + 1 / parts, 4),
+                "time_to_promote_p50_s": (round(ttp_p50, 3)
+                                          if ttp_p50 is not None else None),
+                "time_to_promote_p95_s": (round(ttp_p95, 3)
+                                          if ttp_p95 is not None else None),
+                "reseat_all_s": round(t_reseated - t_kill, 3),
+                "throughput_msgs_per_sec": round(throughput, 1),
+            },
+        }
+        if lost_total:
+            result["error"] = (
+                f"ACKED LOSS: {lost_total} acked-durable records missing "
+                "after partition failover")
+    finally:
+        stop.set()
+        harness.stop()
+        client.close()
+
+    # A/B control: the same producer pool against the single-leader
+    # (node-level) cluster — every write funnels through one node, the
+    # aggregate acked throughput is the scaling baseline
+    ctrl_harness, ctrl_cluster, ctrl_client = build_local_cluster(
+        ["ctl-0", "ctl-1", "ctl-2"], suspect_s=suspect_s, dead_s=dead_s,
+        partition_leadership=False)
+    ctrl_acked: dict = {p: [] for p in range(parts)}
+    ctrl_lock = threading.Lock()
+    ctrl_stop = threading.Event()
+    try:
+        wait_until(lambda: ctrl_cluster.read()["leader"] == "ctl-0", 5.0,
+                   what="control bootstrap")
+        ctrl_client.create_topic("bench_ha", parts)
+        wait_until(
+            lambda: len(ctrl_harness.nodes["ctl-0"]
+                        .broker_facade.replicators) == 2,
+            5.0, what="control followers adopted")
+        ctrl_threads = _ha_producer_pool(
+            ctrl_client, "bench_ha", parts, n_producers, ctrl_acked,
+            ctrl_lock, ctrl_stop, [0])
+        time.sleep(window / 3)
+        ctrl_stop.set()
+        for t in ctrl_threads:
+            t.join(timeout=5.0)
+        single = sum(len(v) for v in ctrl_acked.values()) / (window / 3)
+        pl = result["partition_leadership"]
+        pl["single_leader_msgs_per_sec"] = round(single, 1)
+        pl["write_scaling_x"] = (
+            round(pl["throughput_msgs_per_sec"] / single, 2)
+            if single > 0 else None)
+        result["write_scaling_x"] = pl["write_scaling_x"]
+    finally:
+        ctrl_stop.set()
+        ctrl_harness.stop()
+        ctrl_client.close()
+    return result
 
 
 def bench_chaos_serve(seconds: float) -> dict:
@@ -1375,6 +1606,8 @@ _SUMMARY_KEYS = (
     ("dpx", "dp_scaling_x"),
     ("ovh", "tracer_overhead_pct"),
     ("loss", "acked_loss"),
+    ("blast", "blast_radius"),
+    ("wsx", "write_scaling_x"),
 )
 
 

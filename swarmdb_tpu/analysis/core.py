@@ -29,6 +29,11 @@ Directive grammar (comments beginning ``# swarmlint:``):
     every loop inside it must carry a bound, a backoff, and a deadline
     check (retry.py, SWL701): an undisciplined retry loop turns one
     failure into a retry storm.
+``# swarmlint: ha``
+    On (or directly above) a ``def``: the function writes to a replicated
+    partition log under HA leadership — every broker append inside it
+    must be preceded by an epoch-fence check (heartbeat.py, SWL603): an
+    unfenced append is how a deposed leader forks the log.
 ``# swarmlint: disable=<rule>[,<rule>] [-- reason]``
     Suppress the named rules (ids like ``SWL101`` or family names like
     ``host-sync``) on this line, or — when the comment is a standalone
@@ -132,6 +137,10 @@ RULES: Dict[str, Rule] = {
              "lock acquisition inside `# swarmlint: heartbeat` code — "
              "detector evaluation must stay lock-free (a writer holding "
              "the lock stalls the verdict)"),
+        Rule("SWL603", "heartbeat-safety",
+             "partition-log append inside `# swarmlint: ha` code with no "
+             "epoch-fence check before the write — a deposed leader's "
+             "unfenced append forks the replicated log"),
         Rule("SWL701", "retry-discipline",
              "retry loop in `# swarmlint: retry` code with no bound, no "
              "backoff, or no deadline check — an undisciplined retry "
@@ -203,6 +212,7 @@ class Directives:
     hot_lines: Set[int] = field(default_factory=set)
     heartbeat_lines: Set[int] = field(default_factory=set)
     retry_lines: Set[int] = field(default_factory=set)
+    ha_lines: Set[int] = field(default_factory=set)
     # line -> None (suppress all) or set of rule ids
     disables: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
     comment_only_lines: Set[int] = field(default_factory=set)
@@ -230,6 +240,9 @@ def _parse_directive(body: str, line: int, out: Directives) -> None:
         return
     if body == "retry" or body.startswith("retry "):
         out.retry_lines.add(line)
+        return
+    if body == "ha" or body.startswith("ha "):
+        out.ha_lines.add(line)
         return
     if body.startswith("disable"):
         rest = body[len("disable"):]
@@ -399,6 +412,19 @@ class SourceFile:
                     + [d.lineno for d in fn.decorator_list]) - 1
         for line in range(first, fn.body[0].lineno):
             if line in self.directives.retry_lines:
+                return True
+        return False
+
+    def is_ha(self, fn: ast.AST) -> bool:
+        """HA write-path function: ``# swarmlint: ha`` on the
+        decorator/def lines or directly above. Broker appends inside
+        must be epoch-fence-checked first (heartbeat.py, SWL603)."""
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        first = min([fn.lineno]
+                    + [d.lineno for d in fn.decorator_list]) - 1
+        for line in range(first, fn.body[0].lineno):
+            if line in self.directives.ha_lines:
                 return True
         return False
 

@@ -1,4 +1,4 @@
-"""heartbeat-safety checks (SWL601/SWL602) for the HA failure detector.
+"""heartbeat-safety checks (SWL601/SWL602/SWL603) for the HA layer.
 
 The failure detector's verdict path (``ha/detector.py``:
 ``FailureDetector._evaluate``) must be pure arithmetic over monotonic
@@ -22,6 +22,17 @@ declared with ``# swarmlint: heartbeat`` on (or directly above) a
 
 The marker propagates into nested defs (a helper defined inside a
 heartbeat function runs on the same thread).
+
+SWL603 (ISSUE 10) polices the OTHER half of the fencing contract — the
+write path: a function marked ``# swarmlint: ha`` writes to a
+replicated partition log under HA leadership, and every broker append
+inside it (an ``.append(...)`` call with the topic/partition/value
+shape — list-style single-argument appends are ignored) must be
+preceded by an epoch-fence check (a call whose name contains
+``fence``, e.g. ``_check_fenced`` / ``_check_partition_fence``). An
+append that can run before the fence check is how a deposed leader
+forks the replicated log — the exact bug class partition-level
+fencing exists to make impossible.
 """
 
 from __future__ import annotations
@@ -76,10 +87,47 @@ def _blocking_reason(node: ast.Call) -> Optional[str]:
     return None
 
 
+def _is_partition_append(node: ast.Call) -> bool:
+    """Broker-append shape: ``<obj>.append(topic, partition, value,
+    ...)`` — at least three positional args (or two plus keywords), so
+    ``some_list.append(x)`` never matches."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "append"):
+        return False
+    return (len(node.args) >= 3
+            or (len(node.args) >= 2 and bool(node.keywords)))
+
+
+def _is_fence_check(node: ast.Call) -> bool:
+    name = dotted_name(node.func) or ""
+    return "fence" in name.split(".")[-1].lower()
+
+
+def _check_ha_fencing(src: SourceFile, fn: ast.AST,
+                      findings: List[Finding]) -> None:
+    """SWL603: inside a `# swarmlint: ha` function, every partition-log
+    append must run strictly AFTER a fence check."""
+    fence_lines = [n.lineno for n in ast.walk(fn)
+                   if isinstance(n, ast.Call) and _is_fence_check(n)]
+    first_fence = min(fence_lines) if fence_lines else None
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call) and _is_partition_append(node)):
+            continue
+        if first_fence is not None and node.lineno > first_fence:
+            continue
+        findings.append(make_finding(
+            src, "SWL603", node,
+            f"partition-log append in HA function `{fn.name}` with no "
+            f"epoch-fence check before it — call the fence check (e.g. "
+            f"`_check_partition_fence(topic, partition)`) first, or a "
+            f"deposed leader forks the log"))
+
+
 def check(src: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
 
     hb_fns: List[ast.AST] = []
+    ha_fns: List[ast.AST] = []
 
     def visit(node: ast.AST, hb: bool) -> None:
         for child in ast.iter_child_nodes(node):
@@ -87,11 +135,16 @@ def check(src: SourceFile) -> List[Finding]:
                 child_hb = hb or src.is_heartbeat(child)
                 if child_hb:
                     hb_fns.append(child)
+                if src.is_ha(child):
+                    ha_fns.append(child)
                 visit(child, child_hb)
             else:
                 visit(child, hb)
 
     visit(src.tree, False)
+
+    for fn in ha_fns:
+        _check_ha_fencing(src, fn, findings)
 
     seen = set()
     for fn in hb_fns:

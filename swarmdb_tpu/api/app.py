@@ -549,7 +549,10 @@ def create_app(
         """GET /admin/ha — full control-plane status: role, fencing
         epoch, cluster map view, detector state, replication lag, plus
         the recent HA events (promotions/deposals/detector transitions)
-        from the flight recorder's event ring."""
+        from the flight recorder's event ring. Under partition-level
+        leadership the ``partition_leadership`` block carries the
+        per-partition table (leader, epoch, replica lag for locally-led
+        partitions), leaderships per node, and the leaderless count."""
         require_admin(current_agent(request))
         if ha_node is None:
             raise _error(503, "this process runs without an HA node")
@@ -730,6 +733,24 @@ def create_app(
                     lines.append(
                         f"swarmdb_ha_detector_signal_age_seconds "
                         f"{det['signal_age_s']}")
+                # partition-level leadership (ISSUE 10): leaderships per
+                # node + the leaderless count — the pager line for "a
+                # partition has no leader" is the leaderless gauge > 0
+                # outlasting the failover budget
+                pl = st.get("partition_leadership")
+                if pl:
+                    lines.append(
+                        "# TYPE swarmdb_partition_leaderships gauge")
+                    for nid, n in sorted(
+                            (pl.get("leaderships") or {}).items()):
+                        lines.append(
+                            f'swarmdb_partition_leaderships'
+                            f'{{node="{nid}"}} {n}')
+                    lines.append(
+                        "# TYPE swarmdb_partition_leaderless gauge")
+                    lines.append(
+                        f"swarmdb_partition_leaderless "
+                        f"{pl.get('leaderless', 0)}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

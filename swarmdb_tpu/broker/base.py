@@ -76,9 +76,23 @@ class FencedError(BrokerError):
     leader's: its appends and mirror connections are refused so a
     partitioned old leader coming back can never fork the replicated log.
     NOT retryable — the process must rejoin as a follower (see the HA
-    runbook in the README)."""
+    runbook in the README).
+
+    Partition-scoped since ISSUE 10: under partition-level leadership a
+    node is fenced per ``(topic, partition)`` lease, not per process —
+    ``topic``/``partition``/``epoch`` carry which lease was lost and at
+    what fencing epoch, while the node's OTHER leaderships keep writing.
+    Node-level fencing leaves them ``None``."""
 
     retryable = False
+
+    def __init__(self, *args, topic: "Optional[str]" = None,
+                 partition: "Optional[int]" = None,
+                 epoch: "Optional[int]" = None) -> None:
+        super().__init__(*args)
+        self.topic = topic
+        self.partition = partition
+        self.epoch = epoch
 
 
 class LeaderChangedError(BrokerError):

@@ -82,6 +82,36 @@ pair): 1-byte frame type, fixed struct header, then payload bytes.
                      its OWN span ring, so a cluster-merged trace shows
                      the replication hop. Best-effort like C/X frames;
                      consecutive duplicates are elided.
+
+Partition-level leadership (ISSUE 10) extends the fencing protocol from
+connection scope to ``(topic, partition)`` scope, because under
+partition leadership a follower mirrors from SEVERAL leaders at once
+(each node streams the partitions it leases) and deposing one lease
+must not touch the same node's other leaderships:
+
+  I  peer identity:  u32 json_len + JSON {node: node_id} — sent once
+                     after the hello so the follower can feed a
+                     PER-PEER failure detector from this stream's
+                     frames (partition mode runs one detector per peer,
+                     not one for "the" leader).
+  Q  partition lease: <HHq> topic_len, partition, lease_epoch; + topic.
+                     Leader->follower, sent before the first record of
+                     a partition on this connection and again whenever
+                     the lease epoch changes. Highest epoch wins
+                     ownership of that partition's mirror; records from
+                     a non-owner connection are dropped, never applied.
+  N  partition fence: same layout, follower->leader (shares the ack
+                     channel): the announced epoch is stale — the
+                     follower has seen a higher lease epoch for that
+                     partition. The leader revokes ONLY that lease
+                     (appends to it raise a partition-scoped
+                     :class:`FencedError`); its other partitions keep
+                     streaming on the same connection.
+
+In partition mode (``ReplicaServer(partition_mode=True)``) the
+connection-level E/F refusal and single-active-stream supersede are
+disabled — many concurrent leader streams are the point — and fencing
+is entirely per-partition via Q/N.
 """
 
 from __future__ import annotations
@@ -108,6 +138,7 @@ _LEN = struct.Struct("<I")
 _EPOCH = struct.Struct("<q")
 _CMT_HDR = struct.Struct("<HHHq")   # group_len, topic_len, partition, offset
 _TRIM_HDR = struct.Struct("<Hd")    # topic_len, cutoff_ts
+_PART_HDR = struct.Struct("<HHq")   # topic_len, partition, lease_epoch (Q/N)
 
 _POLL_S = 0.002          # follower ack / leader tail idle poll
 _RECONNECT_S = 0.5       # leader reconnect backoff
@@ -207,6 +238,14 @@ def _send_trace(sock: socket.socket, tc: Dict) -> None:
     sock.sendall(b"G" + _LEN.pack(len(payload)) + payload)
 
 
+def _send_partition_frame(sock: socket.socket, ftype: bytes, topic: str,
+                          part: int, epoch: int) -> None:
+    """Q (lease announce, leader->follower) and N (partition fence,
+    follower->leader) share one layout."""
+    t = topic.encode()
+    sock.sendall(ftype + _PART_HDR.pack(len(t), part, epoch) + t)
+
+
 class ReplicaServer:
     """Follower side: mirror a leader's log into a local broker.
 
@@ -220,12 +259,20 @@ class ReplicaServer:
     def __init__(self, broker: Broker, host: str = "127.0.0.1",
                  port: int = 0, *,
                  on_activity: Optional[Callable[[], None]] = None,
+                 on_peer_activity: Optional[Callable[[str], None]] = None,
+                 partition_mode: bool = False,
                  gate: Optional[Callable[[], bool]] = None) -> None:
         self.broker = broker
         # HA hooks: ``on_activity`` fires on every frame from the active
         # leader (feeds the failure detector's beat); ``gate`` returning
         # False refuses/drops connections (chaos partition injection).
+        # ``partition_mode`` (ISSUE 10) admits many concurrent leader
+        # streams and fences per (topic, partition) via Q/N frames;
+        # ``on_peer_activity(node_id)`` then feeds the per-peer detector
+        # for whichever peer identified itself (I frame) on the stream.
         self.on_activity = on_activity
+        self.on_peer_activity = on_peer_activity
+        self.partition_mode = partition_mode
         self.gate = gate
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -255,10 +302,14 @@ class ReplicaServer:
         # mirror); a connection with a LOWER epoch than the highest ever
         # seen is refused outright with an F frame (fencing).
         self._conn_lock = threading.Lock()
-        # swarmlint: guarded-by[self._conn_lock]: _active_conn, _conn_epochs, _highest_epoch
+        # swarmlint: guarded-by[self._conn_lock]: _active_conn, _conn_epochs, _highest_epoch, _tp_epochs, _tp_owner
         self._active_conn: Optional[socket.socket] = None
         self._conn_epochs: Dict[int, int] = {}  # id(conn) -> epoch
         self._highest_epoch: int = read_log_epoch(broker)
+        # partition mode: per-(topic, partition) lease fencing floors and
+        # the connection currently owning each partition's mirror
+        self._tp_epochs: Dict[Tuple[str, int], int] = {}
+        self._tp_owner: Dict[Tuple[str, int], int] = {}  # tp -> id(conn)
 
     def start(self) -> "ReplicaServer":
         t = threading.Thread(target=self._accept_loop, daemon=True,
@@ -279,11 +330,21 @@ class ReplicaServer:
             if epoch > self._highest_epoch:
                 self._highest_epoch = epoch
 
+    def note_partition_epoch(self, topic: str, part: int,
+                             epoch: int) -> None:
+        """Raise one partition's lease-fencing floor (the HA watch loop
+        pushes the cluster map's assignment epochs here, so a deposed
+        lease is fenced even before the new leader's first Q frame)."""
+        with self._conn_lock:
+            if epoch > self._tp_epochs.get((topic, part), 0):
+                self._tp_epochs[(topic, part)] = epoch
+
     def drop_connections(self) -> None:
         """Hard-close every leader stream (chaos partition / promotion)."""
         with self._conn_lock:
             conns = list(self._conns)
             self._active_conn = None
+            self._tp_owner.clear()
         for sock in conns:
             for op in (lambda s=sock: s.shutdown(socket.SHUT_RDWR),
                        sock.close):
@@ -341,16 +402,21 @@ class ReplicaServer:
             t.start()
             self._threads.append(t)
 
-    def _note_activity(self) -> None:
+    def _note_activity(self, peer: Optional[str] = None) -> None:
         """Feed the failure detector (every frame from the active leader
-        is a liveness proof). Never lets a callback error kill the
-        mirror stream."""
-        if self.on_activity is None:
-            return
-        try:
-            self.on_activity()
-        except Exception:
-            logger.exception("replica on_activity hook failed")
+        is a liveness proof; in partition mode, from whichever peer the
+        stream's I frame identified). Never lets a callback error kill
+        the mirror stream."""
+        if self.on_activity is not None:
+            try:
+                self.on_activity()
+            except Exception:
+                logger.exception("replica on_activity hook failed")
+        if peer is not None and self.on_peer_activity is not None:
+            try:
+                self.on_peer_activity(peer)
+            except Exception:
+                logger.exception("replica on_peer_activity hook failed")
 
     def _local_ends(self) -> Dict[str, Dict[str, int]]:
         ends: Dict[str, Dict[str, int]] = {}
@@ -368,6 +434,12 @@ class ReplicaServer:
         acked: Dict[Tuple[str, int], int] = {}
         lock = threading.Lock()
         done = threading.Event()
+        # the follower->leader channel is written by TWO threads in
+        # partition mode (ack_loop's A frames, this thread's N fences):
+        # serialize sends so frames never interleave mid-payload
+        send_lock = threading.Lock()
+        peer_id: List[Optional[str]] = [None]  # from the I frame
+        refused_tps: set = set()  # tps already N-fenced on this conn
 
         def ack_loop() -> None:
             # acks carry the follower's fsync watermark, advanced by its
@@ -393,6 +465,18 @@ class ReplicaServer:
                     try:
                         durable = min(self.broker.durable_offset(topic, part),
                                       end)
+                        if durable < end:
+                            # nudge the durability point: snapshot-mode
+                            # brokers group-commit inside wait_durable
+                            # (rate-limited there), and acks must track
+                            # records that arrived over THIS stream, not
+                            # only local-writer traffic. Zero timeout:
+                            # never parks the ack loop.
+                            self.broker.wait_durable(topic, part,
+                                                     durable, 0.0)
+                            durable = min(
+                                self.broker.durable_offset(topic, part),
+                                end)
                     except BrokerError:
                         continue
                     if durable > acked.get((topic, part), -1):
@@ -400,8 +484,9 @@ class ReplicaServer:
                         acked[(topic, part)] = durable
                         t = topic.encode()
                         try:
-                            conn.sendall(b"A" + _ACK_HDR.pack(
-                                len(t), part, durable) + t)
+                            with send_lock:
+                                conn.sendall(b"A" + _ACK_HDR.pack(
+                                    len(t), part, durable) + t)
                         except OSError:
                             return
                 # idle backoff (review r5 #4): a quiet deployment must not
@@ -422,18 +507,27 @@ class ReplicaServer:
             stale = None
             refused: Optional[int] = None
             with self._conn_lock:
-                active = self._active_conn
-                active_epoch = (self._conn_epochs.get(id(active), -1)
-                                if active is not None else -1)
-                if (leader_epoch < self._highest_epoch
-                        or leader_epoch < active_epoch):
-                    refused = max(self._highest_epoch, active_epoch)
-                else:
+                if self.partition_mode:
+                    # many concurrent leader streams are the point:
+                    # fencing is per-partition (Q/N), never per-connection
+                    self._conn_epochs[id(conn)] = leader_epoch
                     self._highest_epoch = max(self._highest_epoch,
                                               leader_epoch)
-                    self._conn_epochs[id(conn)] = leader_epoch
-                    self._active_conn = conn
-                    stale = active
+                    active = None
+                    active_epoch = -1
+                else:
+                    active = self._active_conn
+                    active_epoch = (self._conn_epochs.get(id(active), -1)
+                                    if active is not None else -1)
+                    if (leader_epoch < self._highest_epoch
+                            or leader_epoch < active_epoch):
+                        refused = max(self._highest_epoch, active_epoch)
+                    else:
+                        self._highest_epoch = max(self._highest_epoch,
+                                                  leader_epoch)
+                        self._conn_epochs[id(conn)] = leader_epoch
+                        self._active_conn = conn
+                        stale = active
             if refused is not None:
                 logger.warning(
                     "replica: fencing leader at stale epoch %d (highest "
@@ -467,11 +561,45 @@ class ReplicaServer:
                 ftype = _recv_exact(conn, 1)
                 # a superseded stream needs no is-active re-check here: the
                 # supersede path closes this socket, so the next recv fails
-                self._note_activity()
+                self._note_activity(peer_id[0])
                 if ftype == b"P":
                     # heartbeat: liveness only, the activity note above is
                     # the whole point
                     _EPOCH.unpack(_recv_exact(conn, _EPOCH.size))
+                elif ftype == b"I":
+                    # peer identity (partition mode): subsequent frames on
+                    # this stream beat THAT peer's failure detector
+                    (jlen,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                    ident = json.loads(_recv_exact(conn, jlen))
+                    peer_id[0] = ident.get("node")
+                    self._note_activity(peer_id[0])
+                elif ftype == b"Q":
+                    # partition lease announce: highest epoch wins the
+                    # partition's mirror; an equal epoch is the SAME
+                    # leader reconnecting (the map CAS seats exactly one
+                    # winner per partition-epoch), so it re-takes
+                    # ownership rather than being refused
+                    (tlen, part, lease_epoch) = _PART_HDR.unpack(
+                        _recv_exact(conn, _PART_HDR.size))
+                    topic = _recv_exact(conn, tlen).decode()
+                    tp = (topic, part)
+                    with self._conn_lock:
+                        cur = self._tp_epochs.get(tp, 0)
+                        if lease_epoch >= cur:
+                            self._tp_epochs[tp] = lease_epoch
+                            self._tp_owner[tp] = id(conn)
+                            refused_tps.discard(tp)
+                            accepted = True
+                        else:
+                            accepted = False
+                    if not accepted:
+                        logger.warning(
+                            "replica: fencing partition lease %s[%d] at "
+                            "stale epoch %d (highest seen %d)",
+                            topic, part, lease_epoch, cur)
+                        with send_lock:
+                            _send_partition_frame(conn, b"N", topic, part,
+                                                  cur)
                 elif ftype == b"C":
                     (glen, tlen, part, offset) = _CMT_HDR.unpack(
                         _recv_exact(conn, _CMT_HDR.size))
@@ -521,6 +649,23 @@ class ReplicaServer:
                     key = _recv_exact(conn, klen) if klen > 0 else (
                         b"" if klen == 0 else None)
                     value = _recv_exact(conn, vlen)
+                    if self.partition_mode:
+                        # only the connection owning this partition's
+                        # lease may mirror into it: a record from anyone
+                        # else (a stale leader racing its fence, or a
+                        # peer that never announced) is dropped, and the
+                        # sender is told ONCE per partition why
+                        tp = (topic, part)
+                        with self._conn_lock:
+                            owner_ok = self._tp_owner.get(tp) == id(conn)
+                            cur = self._tp_epochs.get(tp, 0)
+                        if not owner_ok:
+                            if tp not in refused_tps:
+                                refused_tps.add(tp)
+                                with send_lock:
+                                    _send_partition_frame(
+                                        conn, b"N", topic, part, cur)
+                            continue
                     # mirror-position check from the tracked map; ONE
                     # locked end_offset query per partition per
                     # connection, not per record (review r5 #4: the
@@ -584,6 +729,9 @@ class ReplicaServer:
                 if self._active_conn is conn:
                     self._active_conn = None
                 self._conn_epochs.pop(id(conn), None)
+                for tp in [tp for tp, owner in self._tp_owner.items()
+                           if owner == id(conn)]:
+                    del self._tp_owner[tp]  # epoch floor stays sticky
                 try:
                     self._conns.remove(conn)
                 except ValueError:
@@ -601,7 +749,12 @@ class Replicator:
                  ctrl_snapshot: Optional[Callable[[], Tuple[Dict, Dict]]] = None,
                  gate: Optional[Callable[[], bool]] = None,
                  heartbeat_s: Optional[float] = None,
-                 on_fenced: Optional[Callable[[int], None]] = None) -> None:
+                 on_fenced: Optional[Callable[[int], None]] = None,
+                 lease_fn: Optional[
+                     Callable[[str, int], Optional[int]]] = None,
+                 node_id: Optional[str] = None,
+                 on_partition_fenced: Optional[
+                     Callable[[str, int, int], None]] = None) -> None:
         self.broker = broker
         host, _, port = target.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port))
@@ -611,12 +764,24 @@ class Replicator:
         # (re)connect so control metadata lost to a disconnect converges;
         # gate — False = chaos partition (refuse to connect / cut stream);
         # on_fenced — fired once when a follower refuses our epoch.
+        # Partition mode (ISSUE 10): lease_fn(topic, part) returns the
+        # lease epoch when THIS node currently leads that partition (only
+        # those stream; the epoch rides a Q frame), node_id identifies us
+        # to the follower's per-peer detector (I frame), and
+        # on_partition_fenced fires when the follower N-fences one lease.
         self._get_epoch = get_epoch or (lambda: 0)
         self._ctrl_snapshot = ctrl_snapshot
         self.gate = gate
         self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
                             else _heartbeat_s())
         self._on_fenced = on_fenced
+        self._lease_fn = lease_fn
+        self._node_id = node_id
+        self._on_partition_fenced = on_partition_fenced
+        # tp -> fencing epoch from an N frame; written by the ack thread,
+        # read by the stream loop — benign GIL-atomic dict ops (a stale
+        # read costs one extra refused batch, never a mis-apply)
+        self._tp_refused: Dict[Tuple[str, int], int] = {}
         # a follower reporting a higher epoch means THIS leader is deposed:
         # stop reconnecting (the stream would be refused forever) and let
         # ReplicatedBroker surface FencedError on writes
@@ -843,7 +1008,29 @@ class Replicator:
             def recv_acks() -> None:
                 try:
                     while not self._stop.is_set():
-                        if _recv_exact(sock, 1) != b"A":
+                        ftype = _recv_exact(sock, 1)
+                        if ftype == b"N":
+                            # partition fence: the follower saw a higher
+                            # lease epoch for ONE partition — revoke that
+                            # lease only; the stream (and our other
+                            # partitions) keep going
+                            tlen, part, fence_epoch = _PART_HDR.unpack(
+                                _recv_exact(sock, _PART_HDR.size))
+                            topic = _recv_exact(sock, tlen).decode()
+                            self._tp_refused[(topic, part)] = fence_epoch
+                            logger.warning(
+                                "replicator %s: partition lease %s[%d] "
+                                "FENCED at epoch %d", self.addr, topic,
+                                part, fence_epoch)
+                            if self._on_partition_fenced is not None:
+                                try:
+                                    self._on_partition_fenced(
+                                        topic, part, fence_epoch)
+                                except Exception:
+                                    logger.exception(
+                                        "on_partition_fenced hook failed")
+                            continue
+                        if ftype != b"A":
                             raise BrokerError("bad ack frame")
                         tlen, part, end = _ACK_HDR.unpack(
                             _recv_exact(sock, _ACK_HDR.size))
@@ -871,6 +1058,12 @@ class Replicator:
                                      name="swarmdb-replicator-ack")
             acker.start()
 
+            if self._node_id is not None and self._lease_fn is not None:
+                # identify ourselves so the follower's per-peer failure
+                # detector credits this stream's frames to US
+                ident = json.dumps({"node": self._node_id}).encode()
+                sock.sendall(b"I" + _LEN.pack(len(ident)) + ident)
+
             # reconnect snapshot: control metadata (consumer-group commits,
             # retention trims) queued while disconnected was dropped — the
             # full latest-wins maps converge the follower in one burst
@@ -883,6 +1076,8 @@ class Replicator:
 
             known: Dict[str, TopicMeta] = {}
             cursors: Dict[Tuple[str, int], int] = {}
+            # tp -> lease epoch last Q-announced on THIS connection
+            announced: Dict[Tuple[str, int], int] = {}
             idle_wait = _POLL_S
             last_tx = time.monotonic()
             while not self._stop.is_set():
@@ -901,6 +1096,24 @@ class Replicator:
                         known[name] = meta
                     for part in range(meta.num_partitions):
                         tp = (name, part)
+                        if self._lease_fn is not None:
+                            # partition mode: stream ONLY the partitions
+                            # we currently lease; announce the lease
+                            # epoch (Q) before its first record and on
+                            # every epoch change
+                            lease = self._lease_fn(name, part)
+                            if lease is None:
+                                announced.pop(tp, None)
+                                continue
+                            fenced_at = self._tp_refused.get(tp)
+                            if fenced_at is not None and fenced_at >= lease:
+                                continue  # deposed until a fresh lease
+                            if announced.get(tp) != lease:
+                                _send_partition_frame(sock, b"Q", name,
+                                                      part, lease)
+                                announced[tp] = lease
+                                self._tp_refused.pop(tp, None)
+                                shipped += 1
                         if tp in self.gapped:
                             continue
                         if tp not in cursors:
@@ -1107,11 +1320,12 @@ class ReplicatedBroker(Broker):
     def create_partitions(self, name, new_total):
         return self.inner.create_partitions(name, new_total)
 
+    # swarmlint: ha
     def append(self, topic, partition, value, key=None, timestamp=None):
         # the fencing check makes a deposed leader's writes fail FAST and
         # LOUD (with the epoch in the error) instead of appending to a log
         # no follower will ever ack — the local-only fork is what manual
-        # failover could never rule out
+        # failover could never rule out (SWL603 polices the ordering)
         self._check_fenced()
         off = self.inner.append(topic, partition, value, key=key,
                                 timestamp=timestamp)

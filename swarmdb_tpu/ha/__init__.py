@@ -16,25 +16,34 @@ automatically recovering cluster (ISSUE 4). Pieces:
   clients write through the leader node's acks=all + fencing facade
   (never a second engine handle over its log dir).
 - ``chaos``    — deterministic fault injection (kill / partition /
-  delay on a scripted schedule) for the tests and ``bench.py``'s HA
-  mode.
+  delay on a scripted schedule, plus dueling-promotion injection) for
+  the tests and ``bench.py``'s HA mode.
+- ``partition`` — partition-level leadership (ISSUE 10): leases,
+  partition-scoped fencing + replication, quorum durability, spread
+  policy. Enabled per node via ``partition_leadership=True`` /
+  ``SWARMDB_HA_PARTITION_LEADERSHIP=1``.
 """
 
 from .chaos import ChaosHarness, build_local_cluster, wait_until
 from .client import ClusterBroker, data_plane_opener
 from .cluster import (ClusterMap, FileClusterMap, InMemoryClusterMap,
-                      NodeInfo, persist_epoch, read_log_epoch)
+                      NodeInfo, parse_tp_key, persist_epoch,
+                      read_log_epoch, tp_key)
 from .dataplane import DataPlaneServer, RemoteBroker
 from .detector import (DetectorState, FailureDetector, LivenessServer,
-                       probe_liveness)
+                       probe_ends, probe_liveness)
 from .node import ClusterUnreachableError, HANode, NodeBroker
+from .partition import (PartitionLeases, PartitionReplicatedBroker,
+                        spread_score)
 
 __all__ = [
     "ChaosHarness", "build_local_cluster", "wait_until",
     "ClusterBroker", "data_plane_opener",
     "DataPlaneServer", "RemoteBroker",
     "ClusterMap", "FileClusterMap", "InMemoryClusterMap", "NodeInfo",
-    "persist_epoch", "read_log_epoch",
+    "persist_epoch", "read_log_epoch", "tp_key", "parse_tp_key",
     "DetectorState", "FailureDetector", "LivenessServer", "probe_liveness",
+    "probe_ends",
     "ClusterUnreachableError", "HANode", "NodeBroker",
+    "PartitionLeases", "PartitionReplicatedBroker", "spread_score",
 ]

@@ -64,6 +64,7 @@ def build_local_cluster(node_ids: Sequence[str], *,
                         dead_s: float = 0.6,
                         heartbeat_s: float = 0.05,
                         refresh_s: float = 0.05,
+                        partition_leadership: Optional[bool] = None,
                         flight: Optional[FlightRecorder] = None):
     """One-call in-process cluster for tests and the bench HA mode.
 
@@ -90,13 +91,20 @@ def build_local_cluster(node_ids: Sequence[str], *,
         node = HANode(
             node_id, broker_factory(node_id), cluster,
             suspect_s=suspect_s, dead_s=dead_s, heartbeat_s=heartbeat_s,
+            partition_leadership=partition_leadership,
             flight=harness.flight,
         )
         harness.add_node(node_id, node)
         node.start(role="leader" if i == 0 else "follower")
+    from .node import NodeBroker
+
+    # NodeBroker (per-call facade re-read), NOT the facade object itself:
+    # a chaos-killed node must surface as ConnectionError on the very
+    # next op — a cached facade object would keep taking writes into a
+    # dead node's log (exactly what a dead process's sockets cannot do)
     client = ClusterBroker(
         cluster,
-        lambda node_id, info: harness.nodes[node_id].broker_facade,
+        lambda node_id, info: NodeBroker(harness.nodes[node_id]),
         refresh_s=refresh_s, owns_inner=False)
     return harness, cluster, client
 
@@ -141,6 +149,44 @@ class ChaosHarness:
     def delay(self, node_id: str, seconds: float) -> None:
         self._log("delay", node_id, seconds=seconds)
         self.nodes[node_id].set_delay(seconds)
+
+    def duel_promotion(self, topic: str, partition: int) -> Dict[str, Any]:
+        """Dueling-promotion injection (ISSUE 10): every LIVE node races
+        a per-partition CAS for the same partition at the same ranked-at
+        epoch, all released simultaneously — the per-assignment
+        ``expect_epoch`` CAS must seat exactly ONE winner per
+        partition-epoch. Returns ``{"winners": [...], "epoch": int}``."""
+        live = [(nid, n) for nid, n in self.nodes.items()
+                if n.role != "dead"]
+        if not live:
+            return {"winners": [], "epoch": None}
+        cluster = live[0][1].cluster
+        from .cluster import tp_key
+
+        a = cluster.read().get("assignments", {}).get(
+            tp_key(topic, partition), {"epoch": 0})
+        ranked_at = int(a.get("epoch", 0))
+        start = threading.Barrier(len(live))
+        winners: List[str] = []
+        winners_lock = threading.Lock()
+
+        def race(nid: str) -> None:
+            start.wait()
+            if cluster.try_promote_partition(
+                    topic, partition, nid, ranked_at + 1,
+                    expect_epoch=ranked_at):
+                with winners_lock:
+                    winners.append(nid)
+
+        threads = [threading.Thread(target=race, args=(nid,),
+                                    daemon=True) for nid, _ in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        self._log("duel", f"{topic}:{partition}", winners=list(winners),
+                  epoch=ranked_at + 1)
+        return {"winners": winners, "epoch": ranked_at + 1}
 
     # ------------------------------------------------------------ scheduling
 

@@ -32,10 +32,23 @@ Broker. Two stock openers:
   engine over the leader's log dir does NOT work: engine handles
   snapshot at open, and such writes would bypass replication — exactly
   the loss the HA layer exists to prevent.)
+
+Partition-level routing (ISSUE 10): when the cluster map carries an
+``assignments`` table, every partition-scoped operation (append, fetch,
+offsets, waits, consumer-group commits) routes to THAT partition's
+leader — one open broker handle per node, cached — while admin ops
+(topic create/list, partition scaling, retention trims) keep going to
+the node-level leader (the controller). A partition whose assignment
+points at a deregistered node is LEADERLESS mid-failover: writes to it
+raise the same retryable :class:`LeaderChangedError` (the orphan sweep
+re-seats it within the detector budget), while every other partition's
+writes keep flowing to their own leaders — that is the bounded blast
+radius, client-side.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -83,11 +96,17 @@ class ClusterBroker(Broker):
         # belongs to an HANode (closing it would kill the node)
         self._owns_inner = owns_inner
         self._lock = threading.RLock()
-        # swarmlint: guarded-by[self._lock]: _inner, _leader_id, _leader_epoch, _next_check
+        # swarmlint: guarded-by[self._lock]: _inner, _leader_id, _leader_epoch, _next_check, _assignments, _nodes, _opened
         self._inner: Optional[Broker] = None
         self._leader_id: Optional[str] = None
         self._leader_epoch = -1
         self._next_check = 0.0
+        # partition-level routing state (refreshed with the map snapshot)
+        self._assignments: Dict[str, Dict[str, Any]] = {}
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        # node_id -> (info-fingerprint, open broker); re-opened when a
+        # node re-registers with fresh addresses
+        self._opened: Dict[str, Tuple[str, Broker]] = {}
 
     # ------------------------------------------------------------ resolution
 
@@ -109,6 +128,9 @@ class ClusterBroker(Broker):
                 return self._inner
             self._next_check = now + self.refresh_s
             state = self.cluster.read()
+            # partition-routing view rides the same snapshot cadence
+            self._assignments = state.get("assignments", {}) or {}
+            self._nodes = state.get("nodes", {}) or {}
             leader = state.get("leader")
             epoch = state.get("epoch", 0)
             if leader is None:
@@ -143,6 +165,99 @@ class ClusterBroker(Broker):
                 except Exception:
                     pass
             return self._inner
+
+    # ------------------------------------------------- partition resolution
+
+    def _for_partition(self, topic: str, partition: int) -> Broker:
+        """The broker to run a partition-scoped op against: the
+        partition's assigned leader when the map has one, else the
+        node-level leader (controller) — which is exactly the pre-ISSUE-10
+        behavior for maps without assignments."""
+        with self._lock:
+            controller = self._current()  # refreshes the snapshot too
+            a = self._assignments.get(f"{topic}:{int(partition)}")
+            if a is None:
+                return controller
+            nid = a.get("leader")
+            if nid == self._leader_id:
+                return controller
+            info = self._nodes.get(nid)
+            if info is None:
+                raise LeaderChangedError(
+                    f"partition {topic}[{partition}] is leaderless "
+                    f"(assigned to deregistered node {nid}); failover in "
+                    "progress — retry resolves the new leader")
+            fp = json.dumps(info, sort_keys=True)
+            cached = self._opened.get(nid)
+            if cached is not None and cached[0] == fp:
+                return cached[1]
+            if cached is not None and self._owns_inner:
+                try:
+                    cached[1].close()
+                except Exception:
+                    pass
+            broker = self._open(nid, info)
+            self._opened[nid] = (fp, broker)
+            return broker
+
+    def _drop_partition_handle(self, topic: str, partition: int) -> None:
+        """A partition op failed transiently: forget the (possibly dead)
+        node handle so the next attempt re-opens, and force a snapshot
+        refresh."""
+        with self._lock:
+            a = self._assignments.get(f"{topic}:{int(partition)}")
+            nid = a.get("leader") if a else None
+            cached = self._opened.pop(nid, None) if nid else None
+            self._next_check = 0.0
+        if cached is not None and self._owns_inner:
+            try:
+                cached[1].close()
+            except Exception:
+                pass
+
+    def _read_tp(self, topic: str, partition: int,
+                 op: Callable[[Broker], Any]) -> Any:
+        """Partition-scoped side-effect-free op: one transparent retry
+        after re-resolving, like :meth:`_read`."""
+        try:
+            return op(self._for_partition(topic, partition))
+        except UnknownTopicError:
+            raise
+        except LeaderChangedError:
+            self._drop_partition_handle(topic, partition)
+        except (_TRANSIENT + (BrokerError,)):
+            self._drop_partition_handle(topic, partition)
+        try:
+            return op(self._for_partition(topic, partition))
+        except UnknownTopicError:
+            raise
+        except (_TRANSIENT + (BrokerError,)) as exc:
+            raise LeaderChangedError(
+                f"read on {topic}[{partition}] failed across a leader "
+                f"re-resolve ({exc}); failover may still be in progress"
+            ) from exc
+
+    def _write_tp(self, topic: str, partition: int,
+                  op: Callable[[Broker], Any], what: str) -> Any:
+        """Partition-scoped mutating op: NEVER auto-retried — a stale-
+        leader failure becomes the retryable error, scoped to THIS
+        partition (every other partition keeps writing through its own
+        leader: the client half of the bounded blast radius)."""
+        try:
+            return op(self._for_partition(topic, partition))
+        except UnknownTopicError:
+            raise
+        except (_TRANSIENT + (BrokerError,)) as exc:
+            self._drop_partition_handle(topic, partition)
+            ctx = propagate.current()
+            TRACER.instant(
+                "cluster.failover", cat="ha",
+                rid=ctx.trace_id if ctx else None,
+                args={"op": what, "partition": f"{topic}:{partition}",
+                      "error": type(exc).__name__})
+            raise LeaderChangedError(
+                f"{what} failed: partition leader unreachable or deposed "
+                f"({exc}); retry resolves the new leader") from exc
 
     # ------------------------------------------------------------ delegation
 
@@ -208,26 +323,31 @@ class ClusterBroker(Broker):
     def append(self, topic: str, partition: int, value: bytes,
                key: Optional[bytes] = None,
                timestamp: Optional[float] = None) -> int:
-        return self._write(
+        return self._write_tp(
+            topic, partition,
             lambda b: b.append(topic, partition, value, key=key,
                                timestamp=timestamp),
             f"append({topic}[{partition}])")
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_records: int = 256) -> List[Record]:
-        return self._read(
+        return self._read_tp(
+            topic, partition,
             lambda b: b.fetch(topic, partition, offset, max_records))
 
     def end_offset(self, topic: str, partition: int) -> int:
-        return self._read(lambda b: b.end_offset(topic, partition))
+        return self._read_tp(topic, partition,
+                             lambda b: b.end_offset(topic, partition))
 
     def begin_offset(self, topic: str, partition: int) -> int:
-        return self._read(lambda b: b.begin_offset(topic, partition))
+        return self._read_tp(topic, partition,
+                             lambda b: b.begin_offset(topic, partition))
 
     def wait_for_data(self, topic: str, partition: int, offset: int,
                       timeout_s: float) -> bool:
         try:
-            return self._read(
+            return self._read_tp(
+                topic, partition,
                 lambda b: b.wait_for_data(topic, partition, offset,
                                           timeout_s))
         except LeaderChangedError:
@@ -237,29 +357,34 @@ class ClusterBroker(Broker):
 
     def commit_offset(self, group: str, topic: str, partition: int,
                       offset: int) -> None:
-        return self._write(
+        return self._write_tp(
+            topic, partition,
             lambda b: b.commit_offset(group, topic, partition, offset),
             f"commit_offset({group})")
 
     def committed_offset(self, group: str, topic: str,
                          partition: int) -> Optional[int]:
-        return self._read(
+        return self._read_tp(
+            topic, partition,
             lambda b: b.committed_offset(group, topic, partition))
 
     # -- retention / durability ----------------------------------------------
 
     def trim_older_than(self, topic: str, cutoff_ts: float) -> int:
+        # topic-wide: the controller applies it and X-frames every peer
         return self._write(
             lambda b: b.trim_older_than(topic, cutoff_ts),
             f"trim_older_than({topic})")
 
     def durable_offset(self, topic: str, partition: int) -> int:
-        return self._read(lambda b: b.durable_offset(topic, partition))
+        return self._read_tp(topic, partition,
+                             lambda b: b.durable_offset(topic, partition))
 
     def wait_durable(self, topic: str, partition: int, offset: int,
                      timeout_s: float) -> bool:
         try:
-            return self._read(
+            return self._read_tp(
+                topic, partition,
                 lambda b: b.wait_durable(topic, partition, offset,
                                          timeout_s))
         except LeaderChangedError:
@@ -274,8 +399,14 @@ class ClusterBroker(Broker):
     def close(self) -> None:
         with self._lock:
             inner, self._inner = self._inner, None
-        if inner is not None and self._owns_inner:
-            inner.close()
+            opened, self._opened = list(self._opened.values()), {}
+        if self._owns_inner:
+            for handle in ([inner] if inner is not None else []) + [
+                    b for _, b in opened]:
+                try:
+                    handle.close()
+                except Exception:
+                    pass
 
     def healthy(self) -> bool:
         try:

@@ -20,6 +20,20 @@ still has no way to win an epoch.
 Fencing epochs are ALSO persisted in each broker's own segment log
 (:func:`~swarmdb_tpu.broker.replica.persist_epoch`), so a restarted node
 remembers its last epoch even if the map is lost.
+
+Partition-level leadership (ISSUE 10): alongside the node-level leader
+(which remains the CONTROLLER — admin ops, assignment authority), the
+map carries an epoch-versioned ``assignments`` table mapping
+``"topic:partition" -> {"leader": node_id, "epoch": int}``. Each
+partition's fencing epoch is an INDEPENDENT CAS space:
+:meth:`ClusterMap.try_promote_partition` checks only THAT assignment's
+epoch, so two coordinators promoting different partitions never
+serialize on (or clobber) each other's epoch bumps — the
+:class:`FileClusterMap` implementation does the whole read-modify-write
+of the shared JSON under one ``fcntl`` lock precisely so a concurrent
+CAS on partition A can never store a state that has forgotten partition
+B's fresh bump (the stale-read window a load-outside-the-lock
+implementation would have).
 """
 
 from __future__ import annotations
@@ -33,7 +47,19 @@ from typing import Any, Dict, Optional
 from ..broker.replica import read_log_epoch, persist_epoch  # noqa: F401  (re-export)
 
 __all__ = ["NodeInfo", "ClusterMap", "InMemoryClusterMap", "FileClusterMap",
-           "read_log_epoch", "persist_epoch"]
+           "read_log_epoch", "persist_epoch", "tp_key", "parse_tp_key"]
+
+
+def tp_key(topic: str, partition: int) -> str:
+    """Assignment-table key for one partition; the partition is always
+    the LAST ``:``-segment, so :func:`parse_tp_key` round-trips even for
+    topic names that themselves contain ``:``."""
+    return f"{topic}:{int(partition)}"
+
+
+def parse_tp_key(key: str) -> "tuple[str, int]":
+    topic, _, part = key.rpartition(":")
+    return topic, int(part)
 
 
 @dataclass
@@ -49,7 +75,25 @@ class NodeInfo:
 
 
 def _empty_state() -> Dict[str, Any]:
-    return {"epoch": 0, "leader": None, "nodes": {}}
+    return {"epoch": 0, "leader": None, "nodes": {}, "assignments": {}}
+
+
+def _promote_partition(state: Dict[str, Any], topic: str, partition: int,
+                       node_id: str, new_epoch: int,
+                       expect_epoch: Optional[int]) -> bool:
+    """Shared per-partition CAS arithmetic, applied to a state dict the
+    caller holds exclusively (both map impls run it inside their lock).
+    The epoch space is the ASSIGNMENT's, not the node-level one: a CAS
+    on partition A neither reads nor writes partition B's epoch."""
+    key = tp_key(topic, partition)
+    a = state["assignments"].get(key, {"leader": None, "epoch": 0})
+    cur = int(a.get("epoch", 0))
+    if new_epoch <= cur:
+        return False
+    if expect_epoch is not None and cur != expect_epoch:
+        return False
+    state["assignments"][key] = {"leader": node_id, "epoch": int(new_epoch)}
+    return True
 
 
 class ClusterMap:
@@ -57,7 +101,8 @@ class ClusterMap:
 
     def read(self) -> Dict[str, Any]:
         """Snapshot: ``{"epoch": int, "leader": node_id|None,
-        "nodes": {node_id: NodeInfo-dict}}``."""
+        "nodes": {node_id: NodeInfo-dict},
+        "assignments": {"topic:part": {"leader": node_id, "epoch": int}}}``."""
         raise NotImplementedError
 
     def register(self, info: NodeInfo) -> None:
@@ -78,6 +123,21 @@ class ClusterMap:
         one (its own ``current_epoch()`` may have already absorbed the
         winner's epoch, so "higher wins" alone is not enough)."""
         raise NotImplementedError
+
+    def try_promote_partition(self, topic: str, partition: int,
+                              node_id: str, new_epoch: int,
+                              expect_epoch: Optional[int] = None) -> bool:
+        """Per-partition CAS (ISSUE 10): seat ``node_id`` as the leader
+        of ``(topic, partition)`` at ``new_epoch`` iff it exceeds that
+        ASSIGNMENT's current epoch (and, when given, ``expect_epoch``
+        still matches it). Exactly one caller per partition-epoch wins;
+        promotions of different partitions are independent CAS spaces
+        and never fail (or clobber) each other."""
+        raise NotImplementedError
+
+    def assignments(self) -> Dict[str, Dict[str, Any]]:
+        """Convenience: the current assignment table snapshot."""
+        return self.read().get("assignments", {})
 
 
 class InMemoryClusterMap(ClusterMap):
@@ -109,6 +169,13 @@ class InMemoryClusterMap(ClusterMap):
             self._state["epoch"] = int(new_epoch)
             self._state["leader"] = node_id
             return True
+
+    def try_promote_partition(self, topic: str, partition: int,
+                              node_id: str, new_epoch: int,
+                              expect_epoch: Optional[int] = None) -> bool:
+        with self._lock:
+            return _promote_partition(self._state, topic, partition,
+                                      node_id, new_epoch, expect_epoch)
 
 
 class FileClusterMap(ClusterMap):
@@ -185,5 +252,21 @@ class FileClusterMap(ClusterMap):
                 return False
             state["epoch"] = int(new_epoch)
             state["leader"] = node_id
+            self._store(state)
+            return True
+
+    def try_promote_partition(self, topic: str, partition: int,
+                              node_id: str, new_epoch: int,
+                              expect_epoch: Optional[int] = None) -> bool:
+        # the WHOLE read-modify-write sits inside the flock: a state
+        # loaded before the lock would be a stale-read window in which a
+        # concurrent CAS on a DIFFERENT partition lands, and storing the
+        # stale snapshot would silently erase its epoch bump (the
+        # lost-update bug tests/test_partition_leadership.py drives)
+        with self._locked():
+            state = self._load()
+            if not _promote_partition(state, topic, partition, node_id,
+                                      new_epoch, expect_epoch):
+                return False
             self._store(state)
             return True

@@ -35,6 +35,13 @@ Failure mapping keeps :class:`~swarmdb_tpu.ha.client.ClusterBroker`'s
 contract intact: a dead/partitioned node surfaces as ``ConnectionError``
 (transient → re-resolve the leader), a fenced or unknown-topic error is
 re-raised under its own class, anything else as ``BrokerError``.
+
+Partition-level leadership (ISSUE 10) needs no wire change here: the
+server always dispatches against ``HANode.broker_facade``, which in
+partition mode is the partition-replicated facade — a remote append to
+a partition this node no longer leases raises the partition-scoped
+``FencedError`` across the wire, and the ClusterBroker that dialed us
+re-routes to the partition's current leader.
 """
 
 from __future__ import annotations

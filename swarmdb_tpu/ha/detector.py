@@ -31,6 +31,7 @@ DEAD (older than ``dead_s``). Knobs: ``SWARMDB_HA_SUSPECT_S`` (default
 from __future__ import annotations
 
 import enum
+import json
 import logging
 import os
 import socket
@@ -42,9 +43,10 @@ from typing import Callable, Optional, Tuple
 logger = logging.getLogger("swarmdb_tpu.ha")
 
 __all__ = ["DetectorState", "FailureDetector", "LivenessServer",
-           "probe_liveness"]
+           "probe_liveness", "probe_ends"]
 
 _LIVENESS = struct.Struct("<qq")  # epoch, catch-up total (sum of ends)
+_LEN = struct.Struct("<I")        # json length (the `#` ends probe)
 
 
 def suspect_s_default() -> float:
@@ -72,14 +74,22 @@ class LivenessServer:
     """One-shot TCP liveness endpoint: client sends ``?``, server answers
     ``!`` + <q epoch> + <q catchup> and closes. The catch-up total (sum
     of end offsets) is what the promotion coordinator ranks candidates
-    by — "most-caught-up follower wins"."""
+    by — "most-caught-up follower wins".
+
+    A ``#`` request (ISSUE 10) answers ``!`` + <u32 len> + JSON
+    ``{"epoch": int, "catchup": int, "ends": {topic: {part: end}}}`` —
+    the per-partition end offsets partition-level failover ranks the
+    "most-caught-up live replica PER PARTITION" with. ``get_ends`` is
+    optional; without it the JSON carries an empty ends map."""
 
     def __init__(self, get_epoch: Callable[[], int],
                  get_catchup: Callable[[], int],
                  host: str = "127.0.0.1", port: int = 0, *,
+                 get_ends: Optional[Callable[[], dict]] = None,
                  gate: Optional[Callable[[], bool]] = None) -> None:
         self._get_epoch = get_epoch
         self._get_catchup = get_catchup
+        self._get_ends = get_ends
         self.gate = gate
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -122,9 +132,23 @@ class LivenessServer:
                     conn.close()  # chaos partition: probe sees EOF
                     continue
                 conn.settimeout(2.0)
-                if conn.recv(1) == b"?":
+                op = conn.recv(1)
+                if op == b"?":
                     conn.sendall(b"!" + _LIVENESS.pack(
                         int(self._get_epoch()), int(self._get_catchup())))
+                elif op == b"#":
+                    ends = {}
+                    if self._get_ends is not None:
+                        try:
+                            ends = self._get_ends()
+                        except Exception:
+                            ends = {}
+                    payload = json.dumps({
+                        "epoch": int(self._get_epoch()),
+                        "catchup": int(self._get_catchup()),
+                        "ends": ends,
+                    }).encode("utf-8")
+                    conn.sendall(b"!" + _LEN.pack(len(payload)) + payload)
             except (OSError, ValueError):
                 pass
             finally:
@@ -154,6 +178,37 @@ def probe_liveness(addr: str,
                 buf += chunk
             epoch, catchup = _LIVENESS.unpack(buf)
             return int(epoch), int(catchup)
+    except (OSError, ValueError):
+        return None
+
+
+def probe_ends(addr: str, timeout_s: float = 1.0) -> Optional[dict]:
+    """Dial a node's liveness endpoint for the per-partition view:
+    ``{"epoch": int, "catchup": int, "ends": {topic: {part: end}}}`` or
+    None when the node is dead/partitioned. The partition-failover
+    coordinator ranks candidates per partition with this."""
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=timeout_s) as sock:
+            sock.settimeout(timeout_s)
+            sock.sendall(b"#")
+            if sock.recv(1) != b"!":
+                return None
+            head = b""
+            while len(head) < _LEN.size:
+                chunk = sock.recv(_LEN.size - len(head))
+                if not chunk:
+                    return None
+                head += chunk
+            (n,) = _LEN.unpack(head)
+            buf = b""
+            while len(buf) < n:
+                chunk = sock.recv(min(65536, n - len(buf)))
+                if not chunk:
+                    return None
+                buf += chunk
+            return json.loads(buf.decode("utf-8"))
     except (OSError, ValueError):
         return None
 

@@ -33,6 +33,29 @@ Promotion ("highest epoch wins", single winner):
    (``F`` frames / :class:`~swarmdb_tpu.broker.base.FencedError`) until
    re-seeded and restarted as a follower (see the README runbook).
 
+Partition-level leadership (ISSUE 10, ``partition_leadership=True`` /
+``SWARMDB_HA_PARTITION_LEADERSHIP=1``) layers a second, finer role
+machine on top: the node-level leader stays on as the CONTROLLER (admin
+ops, assignment of new topics), while every ``(topic, partition)`` gets
+its own leader from the cluster map's epoch-versioned ``assignments``
+table. Each node then runs:
+
+- a :class:`~swarmdb_tpu.ha.partition.PartitionReplicatedBroker` facade
+  — per-partition fencing on appends, partition-filtered replication to
+  every peer, majority-quorum durability;
+- one failure detector PER PEER (fed by that peer's replication-stream
+  frames via I-frame identity + the liveness probe). A confirmed-dead
+  peer is deregistered and its partitions become ORPHANS;
+- an orphan sweep that re-seats each orphaned partition on the
+  most-caught-up live replica (per-partition ends from the ``#``
+  liveness probe, deterministic spread-score tie-breaks, per-assignment
+  epoch CAS pinned with ``expect_epoch`` — exactly one winner per
+  partition-epoch), so a node kill degrades only the partitions it led;
+- an anti-entropy shed pass: an over-loaded node hands leaderships to a
+  healed, under-loaded peer through a drain handover (stop appends,
+  wait until the target's mirror acked our end, THEN CAS) — leadership
+  moves never race the log.
+
 Deterministic fault injection for all of the above lives in
 ``ha/chaos.py``; the node exposes the hooks it needs
 (:meth:`set_isolated`, :meth:`set_delay`, :meth:`kill`).
@@ -62,9 +85,13 @@ from ..broker.replica import (ReplicaServer, ReplicatedBroker,
                               persist_epoch, read_log_epoch)
 from ..obs import TRACER
 from ..obs.flight import FlightRecorder
-from .cluster import ClusterMap, NodeInfo
+from .cluster import ClusterMap, NodeInfo, parse_tp_key, tp_key
 from .detector import (DetectorState, FailureDetector, LivenessServer,
-                       dead_s_default, probe_liveness, suspect_s_default)
+                       dead_s_default, probe_ends, probe_liveness,
+                       suspect_s_default)
+from .partition import (PartitionReplicatedBroker, is_internal_topic,
+                        partition_leadership_default, spread_moves_default,
+                        spread_score)
 
 logger = logging.getLogger("swarmdb_tpu.ha")
 
@@ -89,11 +116,15 @@ class HANode:
                  suspect_s: Optional[float] = None,
                  dead_s: Optional[float] = None,
                  promotion: Optional[str] = None,
+                 partition_leadership: Optional[bool] = None,
                  flight: Optional[FlightRecorder] = None,
                  log_dir: str = "") -> None:
         self.node_id = node_id
         self.broker = broker
         self.cluster = cluster
+        self.partition_leadership = (
+            partition_leadership if partition_leadership is not None
+            else partition_leadership_default())
         self._listen_host = listen_host
         self._replica_port = replica_port
         self._liveness_port = liveness_port
@@ -130,6 +161,15 @@ class HANode:
         self._liveness: Optional[LivenessServer] = None
         self._data_plane = None  # DataPlaneServer when data_port is set
         self._detector: Optional[FailureDetector] = None
+
+        # partition-level leadership (ISSUE 10)
+        self._pbroker: Optional[PartitionReplicatedBroker] = None
+        # swarmlint: guarded-by[self._peers_lock]: _peer_detectors
+        self._peers_lock = threading.Lock()
+        self._peer_detectors: Dict[str, FailureDetector] = {}
+        self._sweeping = threading.Event()  # one orphan sweep at a time
+        self._shed_tick = 0
+        self.spread_moves = spread_moves_default()
 
     # ------------------------------------------------------------ chaos hooks
 
@@ -170,13 +210,23 @@ class HANode:
     # -------------------------------------------------------------- lifecycle
 
     def start(self, role: str = "follower") -> "HANode":
+        if self.partition_leadership:
+            self._pbroker = PartitionReplicatedBroker(
+                self.broker, self.node_id, gate=self._gate,
+                heartbeat_s=self.heartbeat_s,
+                on_lease_fenced=self._on_lease_fenced,
+                on_topic_created=self._on_topic_created)
         self._liveness = LivenessServer(
             self.current_epoch, self._catchup_total,
             self._listen_host, self._liveness_port,
+            get_ends=self._local_partition_ends,
             gate=self._gate).start()
         self._replica_server = ReplicaServer(
             self.broker, self._listen_host, self._replica_port,
-            on_activity=self._on_replica_activity, gate=self._gate).start()
+            on_activity=self._on_replica_activity,
+            on_peer_activity=self._on_peer_activity,
+            partition_mode=self.partition_leadership,
+            gate=self._gate).start()
         data_addr = ""
         if self._data_port is not None:
             from .dataplane import DataPlaneServer
@@ -211,6 +261,14 @@ class HANode:
                     f">= {new_epoch} (is another leader running?)")
             self._become_leader(new_epoch, self._read_map(),
                                 deposed=None)
+        if self.partition_leadership:
+            # seed replication targets / quorum size / peer detectors
+            # from the map NOW — the first appends must not race the
+            # first watch tick into single-copy quorums
+            try:
+                self._reconcile_partitions(self._read_map())
+            except Exception:
+                logger.exception("initial partition reconcile failed")
         t = threading.Thread(target=self._watch_loop, daemon=True,
                              name=f"swarmdb-ha-watch-{self.node_id}")
         t.start()
@@ -224,6 +282,13 @@ class HANode:
         self._stop.set()
         if self._detector is not None:
             self._detector.stop()
+        with self._peers_lock:
+            peer_dets = list(self._peer_detectors.values())
+            self._peer_detectors.clear()
+        for det in peer_dets:
+            det.stop()
+        if self._pbroker is not None:
+            self._pbroker.stop_replication()
         with self._lock:
             lb = self._leader_broker
             self._leader_broker = None
@@ -259,13 +324,18 @@ class HANode:
     def broker_facade(self) -> Broker:
         """What clients write through: the replicated (acks=all) wrapper
         while leading, the plain local broker otherwise (reads only —
-        ClusterBroker routes writes to the map leader). A killed node
-        raises — its real-deployment counterpart is a dead process whose
-        sockets refuse, and an in-process chaos kill must look the same
-        to a ClusterBroker (transient error -> re-resolve the leader)."""
+        ClusterBroker routes writes to the map leader). In partition
+        mode it is ALWAYS the partition-replicated facade: appends are
+        fence-checked per lease, so the same handle is correct whether
+        this node leads zero or all partitions. A killed node raises —
+        its real-deployment counterpart is a dead process whose sockets
+        refuse, and an in-process chaos kill must look the same to a
+        ClusterBroker (transient error -> re-resolve the leader)."""
         with self._lock:
             if self._role == "dead":
                 raise ConnectionError(f"node {self.node_id} is dead")
+            if self._pbroker is not None:
+                return self._pbroker
             return self._leader_broker or self.broker
 
     def status(self) -> Dict[str, Any]:
@@ -292,7 +362,48 @@ class HANode:
         if lb is not None:
             out["replication"] = lb.replication_stats()
             out["fenced_by"] = lb.fenced_by
+        pb = self._pbroker
+        if pb is not None:
+            try:
+                out["partition_leadership"] = self._partition_status(pb)
+            except Exception:
+                logger.exception("partition status failed")
         return out
+
+    def _partition_status(self, pb: PartitionReplicatedBroker
+                          ) -> Dict[str, Any]:
+        """The /admin/ha partition table + /metrics gauge inputs:
+        per-partition (leader, epoch, replica lag for partitions WE
+        lead), leaderships per node, and the leaderless count."""
+        try:
+            state = self._read_map()
+        except ClusterUnreachableError:
+            state = {"nodes": {}, "assignments": {}}
+        nodes = state.get("nodes", {})
+        lag = pb.partition_lag()
+        leaderships: Dict[str, int] = {nid: 0 for nid in nodes}
+        leaderless = 0
+        partitions: Dict[str, Any] = {}
+        for key, a in sorted(state.get("assignments", {}).items()):
+            nid = a.get("leader")
+            row = {"leader": nid, "epoch": int(a.get("epoch", 0))}
+            if nid in leaderships:
+                leaderships[nid] += 1
+            else:
+                leaderless += 1
+                row["leaderless"] = True
+            if key in lag:
+                row["replica_lag"] = lag[key]["replica_lag"]
+                row["end"] = lag[key]["end"]
+            partitions[key] = row
+        return {
+            "enabled": True,
+            "leases": pb.leases.count(),
+            "leaderships": leaderships,
+            "leaderless": leaderless,
+            "partitions": partitions,
+            "replication": pb.replication_stats(),
+        }
 
     def _catchup_total(self) -> int:
         total = 0
@@ -303,6 +414,397 @@ class HANode:
         except Exception:
             pass
         return total
+
+    def _local_partition_ends(self) -> Dict[str, Dict[str, int]]:
+        """Per-partition end offsets for the liveness ``#`` probe — the
+        per-partition catch-up view orphan sweeps rank candidates by."""
+        ends: Dict[str, Dict[str, int]] = {}
+        try:
+            for name, meta in self.broker.list_topics().items():
+                if is_internal_topic(name):
+                    continue
+                ends[name] = {
+                    str(p): self.broker.end_offset(name, p)
+                    for p in range(meta.num_partitions)
+                }
+        except Exception:
+            pass
+        return ends
+
+    # ----------------------------------------------- partition leadership
+
+    def _on_peer_activity(self, peer: str) -> None:
+        """A replication frame arrived from ``peer`` (I-frame-identified
+        stream): beat that peer's failure detector."""
+        with self._peers_lock:
+            det = self._peer_detectors.get(peer)
+        if det is not None:
+            det.beat()
+
+    def _on_lease_fenced(self, topic: str, part: int, epoch: int) -> None:
+        """A follower N-fenced one of our partition leases: a newer
+        leader exists. The lease is already revoked (pbroker did it);
+        record why and let the watch loop re-read the map."""
+        self._record("partition_deposed", {
+            "topic": topic, "partition": part, "fenced_epoch": epoch})
+        TRACER.instant("ha.rebalance", cat="ha", args={
+            "action": "deposed", "node": self.node_id,
+            "partition": tp_key(topic, part), "epoch": epoch})
+
+    def _on_topic_created(self, name: str, parts: int) -> None:
+        """Controller hook: assign a freshly created topic's partitions
+        across live nodes right away (the watch-loop pass is the
+        backstop for topics created elsewhere)."""
+        if not self.partition_leadership or self.role != "leader":
+            return
+        try:
+            state = self._read_map()
+        except ClusterUnreachableError:
+            return
+        self._assign_unassigned(state)
+
+    def _assign_unassigned(self, state: Dict[str, Any]) -> None:
+        """Controller: give every never-assigned partition (epoch 0) a
+        leader, least-loaded live node first with deterministic spread
+        tie-breaks. Orphans (epoch > 0, leader gone) are NOT handled
+        here — they need catch-up ranking, the orphan sweep's job."""
+        nodes = sorted(state.get("nodes", {}))
+        if not nodes:
+            return
+        assigns = state.get("assignments", {})
+        counts = {nid: 0 for nid in nodes}
+        for a in assigns.values():
+            if a.get("leader") in counts:
+                counts[a["leader"]] += 1
+        try:
+            topics = self.broker.list_topics()
+        except Exception:
+            return
+        for name, meta in sorted(topics.items()):
+            if is_internal_topic(name):
+                continue
+            for p in range(meta.num_partitions):
+                key = tp_key(name, p)
+                if int(assigns.get(key, {}).get("epoch", 0)) > 0:
+                    continue
+                target = min(nodes, key=lambda n: (
+                    counts[n], -spread_score(name, p, n)))
+                if self.cluster.try_promote_partition(
+                        name, p, target, 1, expect_epoch=0):
+                    counts[target] += 1
+                    assigns[key] = {"leader": target, "epoch": 1}
+                    if target == self.node_id and self._pbroker is not None:
+                        self._pbroker.leases.grant(name, p, 1)
+                    self._record("rebalance", {
+                        "action": "assign", "partition": key,
+                        "leader": target, "epoch": 1})
+                    TRACER.instant("ha.rebalance", cat="ha", args={
+                        "action": "assign", "partition": key,
+                        "leader": target, "epoch": 1})
+
+    def _on_peer_dead(self, peer: str) -> None:
+        """A peer's detector confirmed DEAD (beats and probes both
+        gone): deregister the corpse — its partitions become orphans the
+        sweep re-seats, and pruning it from every quorum lets surviving
+        majorities keep acking — then sweep."""
+        if self._isolated:
+            # a partitioned node sees EVERY peer as dead — it must not
+            # act on that: no deregistering healthy nodes, no claiming
+            # (the same no-dueling guard _read_map enforces for CASes)
+            return
+        self._record("peer_dead", {"peer": peer})
+        try:
+            self.cluster.deregister(peer)
+        except Exception:
+            logger.exception("deregistering dead peer %s failed", peer)
+        self._start_orphan_sweep()
+
+    def _start_orphan_sweep(self) -> None:
+        if self._sweeping.is_set() or self._stop.is_set():
+            return
+        self._sweeping.set()
+        t = threading.Thread(target=self._orphan_sweep_loop, daemon=True,
+                             name=f"swarmdb-ha-sweep-{self.node_id}")
+        t.start()
+        self._threads.append(t)
+
+    def _orphan_sweep_loop(self) -> None:
+        """Failure-scoped rebalance: re-seat ONLY orphaned partitions
+        (assignment leader no longer registered). Every survivor runs
+        the same deterministic ranking — most-caught-up live replica
+        first (per-partition ends from the ``#`` probe), spread-score
+        tie-break — and CASes only the partitions it wins, with
+        ``expect_epoch`` pinned to the ranked-at assignment so exactly
+        one winner per partition-epoch can seat. Loops (bounded) so a
+        designated winner that died mid-claim is swept up by the next
+        pass's re-ranking."""
+        t0 = time.monotonic()
+        try:
+            for _ in range(200):  # bounded: ~100x any sane convergence
+                if self._stop.is_set():
+                    return
+                try:
+                    state = self._read_map()
+                except ClusterUnreachableError:
+                    self._stop.wait(self.suspect_s)
+                    continue
+                nodes = state.get("nodes", {})
+                orphans = [
+                    (key, a) for key, a in
+                    sorted(state.get("assignments", {}).items())
+                    if a.get("leader") not in nodes
+                ]
+                if not orphans:
+                    return
+                # candidate views: per-partition ends of every LIVE node
+                views: Dict[str, Dict[str, Dict[str, int]]] = {
+                    self.node_id: self._local_partition_ends()}
+                for nid, info in nodes.items():
+                    if nid == self.node_id:
+                        continue
+                    addr = (info or {}).get("liveness_addr")
+                    if not addr:
+                        continue
+                    view = probe_ends(addr, max(0.05, self.suspect_s / 2))
+                    if view is not None:
+                        views[nid] = view.get("ends", {})
+                claimed = 0
+                for key, a in orphans:
+                    topic, part = parse_tp_key(key)
+
+                    def _end(nid: str) -> int:
+                        return int(views[nid].get(topic, {})
+                                   .get(str(part), 0))
+
+                    winner = max(views, key=lambda n: (
+                        _end(n), spread_score(topic, part, n), n))
+                    if winner != self.node_id:
+                        continue
+                    new_epoch = int(a.get("epoch", 0)) + 1
+                    won = False
+                    try:
+                        won = self.cluster.try_promote_partition(
+                            topic, part, self.node_id, new_epoch,
+                            expect_epoch=int(a.get("epoch", 0)))
+                    except Exception:
+                        logger.exception("partition CAS failed; retrying")
+                    if not won:
+                        continue
+                    claimed += 1
+                    self._ensure_local_partition(topic, part)
+                    if self._pbroker is not None:
+                        self._pbroker.leases.grant(topic, part, new_epoch)
+                    elapsed = round(time.monotonic() - t0, 4)
+                    logger.warning(
+                        "ha: %s promoted to PARTITION leader of %s at "
+                        "epoch %d (%.3fs into sweep)", self.node_id, key,
+                        new_epoch, elapsed)
+                    self._record("partition_promoted", {
+                        "partition": key, "epoch": new_epoch,
+                        "deposed": a.get("leader"), "elapsed_s": elapsed})
+                    TRACER.instant("ha.rebalance", cat="ha", args={
+                        "action": "failover", "partition": key,
+                        "leader": self.node_id, "epoch": new_epoch,
+                        "deposed": a.get("leader")})
+                if claimed:
+                    self.flight.auto_dump("ha_partition_promotion")
+                # give the other survivors a beat to claim their wins,
+                # then re-scan for leftovers (their deaths included)
+                self._stop.wait(max(0.05, self.suspect_s / 2))
+        finally:
+            self._sweeping.clear()
+
+    def _reconcile_partitions(self, state: Dict[str, Any]) -> None:
+        """Watch-loop duty in partition mode: converge local state onto
+        the map — replication targets, per-peer detectors, lease
+        grants/revocations, and the replica server's fencing floors."""
+        pb = self._pbroker
+        if pb is None:
+            return
+        nodes = state.get("nodes", {})
+        # replication streams + ack quorum follow the registered peers
+        pb.sync_targets(
+            info.get("replica_addr") for nid, info in nodes.items()
+            if nid != self.node_id and info.get("replica_addr"))
+        # one failure detector per peer (probe + I-frame beats)
+        with self._peers_lock:
+            for nid in [n for n in self._peer_detectors if n not in nodes]:
+                self._peer_detectors.pop(nid).stop()
+            for nid in nodes:
+                if nid == self.node_id or nid in self._peer_detectors:
+                    continue
+                self._peer_detectors[nid] = FailureDetector(
+                    self._peer_liveness_fn(nid),
+                    suspect_s=self.suspect_s, dead_s=self.dead_s,
+                    on_state=self._peer_state_fn(nid),
+                    name=f"{self.node_id}->{nid}",
+                ).start()
+        # self-heal: a deregistered (deposed/healed) node re-registers —
+        # safe under quorum acks, where a divergent replica gaps itself
+        # out of the quorum instead of freezing it
+        if self.node_id not in nodes:
+            self.cluster.register(self._my_info())
+        # leases and fencing floors follow the assignment table
+        mine = pb.leases.snapshot()
+        for key, a in state.get("assignments", {}).items():
+            topic, part = parse_tp_key(key)
+            epoch = int(a.get("epoch", 0))
+            if self._replica_server is not None:
+                self._replica_server.note_partition_epoch(topic, part,
+                                                          epoch)
+            held = mine.pop((topic, part), None)
+            if a.get("leader") == self.node_id:
+                if held != epoch:
+                    # the lease implies the topic: a T frame may not have
+                    # arrived yet (assignment raced replication), and a
+                    # leader without the topic would refuse its appends
+                    self._ensure_local_partition(topic, part)
+                    pb.leases.grant(topic, part, epoch)
+            elif held is not None:
+                # deposed (failover or a rebalance move): fence ONLY this
+                # lease; our other partitions keep writing
+                pb.leases.revoke(topic, part, fenced_epoch=epoch)
+                self._record("partition_deposed", {
+                    "topic": topic, "partition": part,
+                    "new_leader": a.get("leader"), "epoch": epoch})
+                TRACER.instant("ha.rebalance", cat="ha", args={
+                    "action": "deposed", "node": self.node_id,
+                    "partition": key, "new_leader": a.get("leader"),
+                    "epoch": epoch})
+        for (topic, part) in mine:
+            # leased but no longer in the table at all (topic dropped)
+            pb.leases.revoke(topic, part)
+        # orphan backstop: a sweep can be lost to a crash — any node
+        # noticing orphans restarts one
+        if any(a.get("leader") not in nodes
+               for a in state.get("assignments", {}).values()):
+            self._start_orphan_sweep()
+
+    def _ensure_local_partition(self, topic: str, part: int) -> None:
+        try:
+            meta = self.broker.list_topics().get(topic)
+            if meta is None:
+                self.broker.create_topic(topic, part + 1)
+            elif meta.num_partitions <= part:
+                self.broker.create_partitions(topic, part + 1)
+        except Exception:
+            logger.exception("ensuring local %s[%d] failed", topic, part)
+
+    def _peer_liveness_fn(self, nid: str):
+        def _resolve() -> Optional[str]:
+            try:
+                info = self._read_map().get("nodes", {}).get(nid)
+            except ClusterUnreachableError:
+                return None
+            return info.get("liveness_addr") if info else None
+        return _resolve
+
+    def _peer_state_fn(self, nid: str):
+        def _on_state(old: DetectorState, new: DetectorState) -> None:
+            self._record("peer_detector", {
+                "peer": nid, "from": old.name.lower(),
+                "to": new.name.lower()})
+            if new is DetectorState.DEAD and not self._stop.is_set():
+                t = threading.Thread(target=self._on_peer_dead,
+                                     args=(nid,), daemon=True,
+                                     name=f"swarmdb-ha-peerdead-{nid}")
+                t.start()
+                self._threads.append(t)
+        return _on_state
+
+    def _my_info(self) -> NodeInfo:
+        return NodeInfo(
+            node_id=self.node_id,
+            replica_addr=(f"{self._advertise_host}:"
+                          f"{self._replica_server.port}"
+                          if self._replica_server is not None else ""),
+            liveness_addr=(f"{self._advertise_host}:{self._liveness.port}"
+                           if self._liveness is not None else ""),
+            data_addr=(f"{self._advertise_host}:{self._data_plane.port}"
+                       if self._data_plane is not None else ""),
+            log_dir=self.log_dir,
+        )
+
+    def _shed_pass(self, state: Dict[str, Any]) -> None:
+        """Anti-entropy: when a healed node re-joins under-loaded, an
+        over-loaded node hands it leaderships — bounded to
+        ``spread_moves`` per pass (the SWARMDB_HA_SPREAD knob), each via
+        the drain handover so the move never races the log."""
+        pb = self._pbroker
+        if pb is None:
+            return
+        nodes = sorted(state.get("nodes", {}))
+        if len(nodes) < 2 or self.node_id not in nodes:
+            return
+        assigns = state.get("assignments", {})
+        counts = {nid: 0 for nid in nodes}
+        for a in assigns.values():
+            if a.get("leader") in counts:
+                counts[a["leader"]] += 1
+        for _ in range(self.spread_moves):
+            under = min(nodes, key=lambda n: (counts[n], n))
+            if under == self.node_id:
+                return
+            if counts[self.node_id] - counts[under] < 2:
+                return  # within one leadership of balanced: done
+            info = state["nodes"].get(under, {})
+            if probe_liveness(info.get("liveness_addr", ""),
+                              max(0.05, self.suspect_s / 2)) is None:
+                return  # never shed onto a corpse
+            moved = False
+            for (topic, part), epoch in sorted(pb.leases.snapshot().items()):
+                key = tp_key(topic, part)
+                if assigns.get(key, {}).get("leader") != self.node_id:
+                    continue
+                if self._handover(topic, part, epoch, under,
+                                  info.get("replica_addr", "")):
+                    counts[self.node_id] -= 1
+                    counts[under] += 1
+                    moved = True
+                    break
+            if not moved:
+                return  # nothing currently hand-over-able (lagging peer)
+
+    def _handover(self, topic: str, part: int, epoch: int,
+                  to_nid: str, to_addr: str) -> bool:
+        """Drain handover of one leadership: stop taking appends, wait
+        (bounded) until the target's mirror has acked everything we
+        hold, then CAS the assignment to the target. On any failure the
+        lease is simply not CASed away — the next watch tick re-grants
+        it from the unchanged map."""
+        pb = self._pbroker
+        if pb is None or not to_addr:
+            return False
+        with pb._repl_lock:
+            repl = pb._repls.get(to_addr)
+        if repl is None:
+            return False
+        if pb.leases.revoke(topic, part) is None:
+            return False  # lost it concurrently
+        try:
+            end = self.broker.end_offset(topic, part)
+        except Exception:
+            end = None
+        if end is not None and repl.wait_acked(
+                topic, part, end - 1, max(0.5, 4 * self.suspect_s)):
+            try:
+                if self.cluster.try_promote_partition(
+                        topic, part, to_nid, epoch + 1,
+                        expect_epoch=epoch):
+                    key = tp_key(topic, part)
+                    self._record("rebalance", {
+                        "action": "shed", "partition": key,
+                        "leader": to_nid, "epoch": epoch + 1})
+                    TRACER.instant("ha.rebalance", cat="ha", args={
+                        "action": "shed", "partition": key,
+                        "leader": to_nid, "epoch": epoch + 1,
+                        "from": self.node_id})
+                    return True
+            except Exception:
+                logger.exception("handover CAS failed")
+        # abort: map unchanged, the next reconcile tick re-grants us
+        pb.leases.grant(topic, part, epoch)
+        return False
 
     # ------------------------------------------------------------ map access
 
@@ -432,16 +934,24 @@ class HANode:
         with self._lock:
             self._role = "leader"
             self._epoch = new_epoch
-            self._leader_broker = ReplicatedBroker(
-                self.broker, targets, epoch=new_epoch,
-                allow_no_targets=True, gate=self._gate,
-                heartbeat_s=self.heartbeat_s)
+            if not self.partition_leadership:
+                self._leader_broker = ReplicatedBroker(
+                    self.broker, targets, epoch=new_epoch,
+                    allow_no_targets=True, gate=self._gate,
+                    heartbeat_s=self.heartbeat_s)
+            # partition mode: the node-level leader is the CONTROLLER
+            # only — data-plane replication stays per-partition through
+            # the existing PartitionReplicatedBroker; the dead node's
+            # partitions fail over via the orphan sweep, not here
         if self._replica_server is not None:
             # the mirror listener stays up purely as a fencing endpoint:
             # raising its floor turns any stale leader's connect into an
             # F frame carrying our epoch
             self._replica_server.note_epoch(new_epoch)
-            self._replica_server.drop_connections()
+            if not self.partition_leadership:
+                # (partition mode keeps peer streams up: many concurrent
+                # leaders mirroring here is the normal state)
+                self._replica_server.drop_connections()
         if deposed is not None:
             # the dead leader leaves the map: it must re-register (after
             # re-seeding) to rejoin, and until then the reconcile loop
@@ -466,7 +976,12 @@ class HANode:
         with self._lock:
             if self._role != "leader":
                 return
-            self._role = "deposed"
+            # partition mode: losing the CONTROLLER role is routine (an
+            # isolated-then-healed controller rejoins as a follower);
+            # data-plane writes stay governed by per-partition leases,
+            # which the map reconcile fences individually
+            self._role = ("follower" if self.partition_leadership
+                          else "deposed")
             # the fenced ReplicatedBroker STAYS the facade: reads keep
             # working (re-seeding needs the log) but every write raises
             # FencedError with the epoch — a deposed leader must fail
@@ -504,6 +1019,20 @@ class HANode:
             leader = state.get("leader")
             with self._lock:
                 role, epoch, lb = self._role, self._epoch, self._leader_broker
+            if self.partition_leadership and role != "dead":
+                try:
+                    self._reconcile_partitions(state)
+                    if role == "leader":
+                        # controller duties: new topics get leaders
+                        self._assign_unassigned(state)
+                    self._shed_tick += 1
+                    if self._shed_tick % 4 == 0:
+                        # anti-entropy: re-spread onto healed peers (every
+                        # few ticks — a shed is a drain handover and may
+                        # block this loop for up to ~4x suspect_s)
+                        self._shed_pass(state)
+                except Exception:
+                    logger.exception("partition reconcile failed")
             if role == "leader":
                 if (state.get("epoch", 0) > epoch
                         or (leader is not None and leader != self.node_id)):

@@ -1,0 +1,43 @@
+"""Seeded fencing-discipline violations (SWL603) — lint fixture.
+
+Not imported by anything; analyzed as text by tests/test_swarmlint.py.
+The shapes mirror the bug partition-level leadership (ISSUE 10) must
+never grow: an append to a replicated partition log that can run before
+the epoch-fence check — a deposed leader's unfenced append forks the
+log, which is exactly the loss class the fencing wire protocol exists
+to rule out.
+"""
+
+
+class UnfencedLeader:
+    def __init__(self, broker, leases):
+        self.inner = broker
+        self.leases = leases
+        self.pending = []
+
+    def _check_partition_fence(self, topic, partition):
+        if self.leases.epoch_of(topic, partition) is None:
+            raise RuntimeError("fenced")
+
+    # swarmlint: ha
+    def append_unfenced(self, topic, partition, value):
+        # no fence check at all before the write
+        return self.inner.append(topic, partition, value)  # EXPECT: SWL603
+
+    # swarmlint: ha
+    def append_fence_after(self, topic, partition, value, key=None):
+        off = self.inner.append(topic, partition, value,  # EXPECT: SWL603
+                                key=key)
+        self._check_partition_fence(topic, partition)  # too late
+        return off
+
+    # swarmlint: ha
+    def append_fenced_ok(self, topic, partition, value):
+        # fence check BEFORE the write — no finding
+        self._check_partition_fence(topic, partition)
+        self.pending.append(value)  # list append: never a finding
+        return self.inner.append(topic, partition, value)
+
+    def append_unmarked(self, topic, partition, value):
+        # NOT marked `ha`: plain broker plumbing — no finding
+        return self.inner.append(topic, partition, value)

@@ -292,6 +292,88 @@ def test_remote_data_plane_client_survives_failover(cluster3):
         remote.close()
 
 
+def test_consumer_group_continuity_across_partition_move():
+    """ISSUE 10 satellite: committed offsets and retention trims are
+    served by a partition's NEW leader from the replicated C/X state —
+    not log start — after a leadership MOVE (no node died, the
+    assignment just changed hands)."""
+    from swarmdb_tpu.ha import tp_key
+
+    harness, cluster, client = build_local_cluster(
+        ["n0", "n1", "n2"], suspect_s=SUSPECT_S, dead_s=DEAD_S,
+        partition_leadership=True)
+    try:
+        wait_until(lambda: cluster.read()["leader"] == "n0", 5.0,
+                   what="bootstrap leader")
+        client.create_topic("t", 3)
+        wait_until(lambda: len(cluster.read()["assignments"]) == 3, 5.0,
+                   what="assignment")
+        part = 0
+        deadline = time.monotonic() + 10.0
+        off = -1
+        for i in range(40):
+            while True:
+                try:
+                    off = client.append(
+                        "t", part, f"m{i}".encode(),
+                        timestamp=1000.0 if i < 10 else 2000.0)
+                    break
+                except LeaderChangedError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.02)
+        assert client.wait_durable("t", part, off, 5.0)
+        client.commit_offset("workers", "t", part, 30)
+        client.trim_older_than("t", 1500.0)
+        old_leader = cluster.read()["assignments"][tp_key("t", part)]
+
+        def converged(nid):
+            b = harness.nodes[nid].broker
+            try:
+                return (b.committed_offset("workers", "t", part) == 30
+                        and b.begin_offset("t", part) >= 10)
+            except Exception:
+                return False
+
+        followers = [n for n in ("n0", "n1", "n2")
+                     if n != old_leader["leader"]]
+        wait_until(lambda: all(converged(n) for n in followers), 5.0,
+                   what="C/X replication to every peer")
+
+        # MOVE the leadership (epoch CAS, no failure involved)
+        target = followers[0]
+        assert cluster.try_promote_partition(
+            "t", part, target, old_leader["epoch"] + 1,
+            expect_epoch=old_leader["epoch"])
+        wait_until(
+            lambda: harness.nodes[target]._pbroker.leases.epoch_of(
+                "t", part) is not None,
+            5.0, what="new leader leases the partition")
+
+        # the group resumes where it committed, via the client (which
+        # routes to the CURRENT leader — the anti-entropy shed pass may
+        # legally move the now-imbalanced leadership again, so reads are
+        # retried through any in-progress handover), and retention
+        # survived the move
+        def _retrying(op):
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    return op()
+                except LeaderChangedError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+
+        assert _retrying(
+            lambda: client.committed_offset("workers", "t", part)) == 30
+        assert _retrying(lambda: client.begin_offset("t", part)) >= 10
+        got = [r.value.decode() for r in
+               _retrying(lambda: client.fetch("t", part, 30, 100))]
+        assert got == [f"m{i}" for i in range(30, 40)]
+    finally:
+        harness.stop()
+        client.close()
+
+
 def test_stale_epoch_mirror_connect_refused(tmp_path):
     """Epoch persistence end-to-end: a leader's epoch lands in its OWN
     segment log and replicates to followers, so a follower restarted

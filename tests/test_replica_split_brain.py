@@ -19,7 +19,7 @@ import time
 
 from swarmdb_tpu.broker.base import BrokerError
 from swarmdb_tpu.broker.local import LocalBroker
-from swarmdb_tpu.broker.replica import (_EPOCH, _LEN, _REC_HDR,
+from swarmdb_tpu.broker.replica import (_EPOCH, _LEN, _PART_HDR, _REC_HDR,
                                         ReplicaServer, _recv_exact)
 
 
@@ -130,6 +130,96 @@ def test_stale_epoch_leader_is_fenced_without_disturbing_active():
         assert _recv_exact(late, 1) == b"F"
         late.close()
     finally:
+        server.stop()
+        broker.close()
+
+
+def _send_lease(sock, topic, part, epoch):
+    t = topic.encode()
+    sock.sendall(b"Q" + _PART_HDR.pack(len(t), part, epoch) + t)
+
+
+def _recv_partition_fence(sock):
+    """Next N frame on the follower->leader channel (skipping the ack
+    loop's interleaved A frames, exactly like Replicator.recv_acks)."""
+    from swarmdb_tpu.broker.replica import _ACK_HDR
+
+    while True:
+        ftype = _recv_exact(sock, 1)
+        if ftype == b"A":
+            tlen, _, _ = _ACK_HDR.unpack(_recv_exact(sock, _ACK_HDR.size))
+            _recv_exact(sock, tlen)
+            continue
+        assert ftype == b"N"
+        tlen, part, epoch = _PART_HDR.unpack(
+            _recv_exact(sock, _PART_HDR.size))
+        topic = _recv_exact(sock, tlen).decode()
+        return topic, part, epoch
+
+
+def test_partition_scoped_fencing_on_the_wire():
+    """ISSUE 10: fencing at (topic, partition) granularity. In partition
+    mode the follower admits MANY concurrent leader streams; a Q frame
+    with a stale lease epoch is answered with an N frame carrying the
+    higher epoch, records from a non-owner connection are dropped — and
+    BOTH effects are scoped to that one partition: the same connection's
+    other partitions keep mirroring, and the rightful owner's stream is
+    never disturbed."""
+    broker = LocalBroker()
+    server = ReplicaServer(broker, partition_mode=True).start()
+    socks = []
+    try:
+        fresh, _ = _connect_and_hello(server, epoch=0)
+        stale, _ = _connect_and_hello(server, epoch=0)
+        socks += [fresh, stale]
+        _send_topic(fresh, "t", 2)
+        # fresh leader owns t:0 at lease epoch 5 and mirrors into it
+        _send_lease(fresh, "t", 0, 5)
+        _send_record(fresh, "t", 0, 0, b"owner-write")
+        deadline = time.time() + 5
+        while time.time() < deadline and _end_offset(broker, "t", 0) < 1:
+            time.sleep(0.01)
+        assert _end_offset(broker, "t", 0) == 1
+
+        # stale leader announces t:0 at a LOWER epoch: N frame back,
+        # records never land
+        _send_lease(stale, "t", 0, 3)
+        assert _recv_partition_fence(stale) == ("t", 0, 5)
+        _send_record(stale, "t", 0, 1, b"from-the-dead")
+        # ...but the SAME connection owns t:1 at any epoch: scoped, not
+        # connection-wide, fencing
+        _send_lease(stale, "t", 1, 1)
+        _send_record(stale, "t", 1, 0, b"other-partition-fine")
+        deadline = time.time() + 5
+        while time.time() < deadline and _end_offset(broker, "t", 1) < 1:
+            time.sleep(0.01)
+        assert _end_offset(broker, "t", 1) == 1
+        assert [r.value for r in broker.fetch("t", 1, 0, 10)] == \
+            [b"other-partition-fine"]
+        # t:0 holds exactly the owner's record (the stale write dropped)
+        time.sleep(0.1)
+        assert [r.value for r in broker.fetch("t", 0, 0, 10)] == \
+            [b"owner-write"]
+        # the rightful owner keeps streaming undisturbed
+        _send_record(fresh, "t", 0, 1, b"owner-write-2")
+        deadline = time.time() + 5
+        while time.time() < deadline and _end_offset(broker, "t", 0) < 2:
+            time.sleep(0.01)
+        assert _end_offset(broker, "t", 0) == 2
+        # a HIGHER epoch takes the partition over (highest epoch wins)
+        _send_lease(stale, "t", 0, 7)
+        _send_record(stale, "t", 0, 2, b"new-leader-write")
+        deadline = time.time() + 5
+        while time.time() < deadline and _end_offset(broker, "t", 0) < 3:
+            time.sleep(0.01)
+        assert [r.value for r in broker.fetch("t", 0, 2, 10)] == \
+            [b"new-leader-write"]
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
         server.stop()
         broker.close()
 

@@ -44,6 +44,7 @@ def expected_findings(path: Path):
     "metrics_bad.py",           # histogram discipline (SWL503)
     "exemplar_bad.py",          # exemplar/sentinel allocation (SWL504)
     "heartbeat_bad.py",         # heartbeat-safety family (SWL601/602)
+    "fence_bad.py",             # fencing discipline (SWL603)
     "retry_bad.py",             # retry-discipline family (SWL701)
 ])
 def test_each_family_detects_seeded_violations(name):
@@ -130,5 +131,6 @@ def test_cli_module_smoke():
         cwd=str(REPO), capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
     for rule in ("SWL101", "SWL203", "SWL301", "SWL401", "SWL501",
-                 "SWL502", "SWL503", "SWL504", "SWL601", "SWL602"):
+                 "SWL502", "SWL503", "SWL504", "SWL601", "SWL602",
+                 "SWL603"):
         assert rule in proc.stdout
