@@ -331,6 +331,13 @@ def _device_extras(service, model: str) -> dict:
         extras["kv_cache"] = "paged"
         extras["kv_pool_pages"] = st["num_pages"]
         extras["kv_page_size"] = st["page_size"]
+        # which decode-attention path this record measured (pallas ragged
+        # kernel vs XLA page gather): bench_trend gates like-for-like —
+        # a promoted TPU/pallas record must not be "regressed" against
+        # by a CPU/gather one, or vice versa
+        from swarmdb_tpu.ops.layers import decode_kernel_choice
+
+        extras["kernel"] = decode_kernel_choice(service.engine.max_seq)
     else:
         extras["kv_cache"] = "dense"
     # warmup cost rides the record (VERDICT r5 #6: the warmup-time drop
@@ -405,6 +412,10 @@ _PHASES = ("queue_wait", "prefill", "decode", "host_sync", "reply_emit")
 def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
                     prompt_toks) -> dict:
     reused = db.metrics.counters["prefix_reused_tokens"]
+    # prefill grid efficiency: padding (dispatched-but-dead grid tokens)
+    # vs packed (real prompt tokens) — the ragged-wave acceptance number
+    pad_c = db.metrics.counters["prefill_padding_tokens"]
+    packed_c = db.metrics.counters["prefill_packed_tokens"]
     # per-phase time accumulators (engine-side, microseconds): deltas
     # over the window become the phase breakdown that explains WHERE a
     # bad headline number went (queue wait vs prefill vs decode vs the
@@ -414,6 +425,7 @@ def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
     phase_counters = {p: db.metrics.counters[f"phase_us_{p}"]
                       for p in _PHASES}
     ph0 = {p: c.value for p, c in phase_counters.items()}
+    pad0, packed0 = pad_c.value, packed_c.value
     c0, k0, pt0, r0 = (completed.value, tokens.value, prompt_toks.value,
                        reused.value)
     sent0 = pump.sent
@@ -433,6 +445,10 @@ def _measure_window(db, seconds, pump, drain_grace, completed, tokens,
         "window_s": round(elapsed, 2),
         "window_completed": completed.value - c0,
     }
+    pad_d, packed_d = pad_c.value - pad0, packed_c.value - packed0
+    if pad_d or packed_d:
+        out["prefill_padding_ratio"] = round(
+            pad_d / max(1, pad_d + packed_d), 4)
     if reused.value - r0:
         # MFU must count COMPUTED tokens: prefix-cache hits skip their
         # prefill FLOPs entirely (the KV is read back, not recomputed)
@@ -939,6 +955,8 @@ def bench_dpserve(seconds: float) -> dict:
         "kv_cache": multi.get("kv_cache"),
         "kv_pool_shards": n,
         "prefix_hit_rate": multi.get("prefix_hit_rate"),
+        "prefill_padding_ratio": multi.get("prefill_padding_ratio"),
+        "kernel": multi.get("kernel"),
         "platform": multi.get("platform"),
         "dp1_msgs_per_sec": round(v1, 2),
         # equal-capacity ratio of the per-shard admission-lane path
@@ -1601,6 +1619,8 @@ _SUMMARY_KEYS = (
     ("mfu", "mfu"),
     ("p50", "p50_send_to_first_token_s"),
     ("hit", "prefix_hit_rate"),
+    ("pad", "prefill_padding_ratio"),
+    ("kern", "kernel"),
     ("pl", "platform"),
     ("native", "native_broker_msgs_per_sec"),
     ("dpx", "dp_scaling_x"),
@@ -1653,9 +1673,11 @@ def _compact_summary(results: dict, error: str | None = None) -> dict:
     line["detail"] = "per-mode JSON lines above"
     raw = json.dumps(line)
     if len(raw) > 1480:  # belt-and-braces: shed perf scalars, then errs.
-        # NEVER shed "pl": the cpu-fallback marker is what stops a CPU
-        # number from masquerading as a TPU perf claim in the record
-        keep = {"v", "pl", "native"}
+        # NEVER shed "pl" or "kern": the cpu-fallback/kernel markers are
+        # what stop a CPU or gather-path number from masquerading as a
+        # TPU/pallas perf claim in the record (bench_trend compares
+        # like-for-like on exactly these fields)
+        keep = {"v", "pl", "kern", "native"}
         for mode_sum in line["modes"].values():
             mode_sum.pop("ph", None)
             for short, _ in _SUMMARY_KEYS:

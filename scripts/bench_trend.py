@@ -141,6 +141,15 @@ def _signals(base: Dict[str, Any], test: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _platform_class(mode_rec: Dict[str, Any]) -> str:
+    """Collapse the record's platform marker to cpu-vs-native: mode=all
+    summaries stamp ``pl: cpu-fallback`` only when the TPU probe failed,
+    single-mode lifts carry the raw jax platform, and a real on-silicon
+    record has no marker at all."""
+    return ("cpu" if mode_rec.get("pl") in ("cpu", "cpu-fallback")
+            else "native")
+
+
 def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
                   threshold: float) -> List[Dict[str, Any]]:
     verdicts: List[Dict[str, Any]] = []
@@ -154,6 +163,25 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
             verdicts.append({"mode": mode, "comparable": False,
                              "reason": "no numeric throughput on both "
                                        "sides"})
+            continue
+        # like-for-like gate (ISSUE 11): a promoted TPU (or pallas-
+        # kernel) record must gate TPU perf — comparing it against a
+        # CPU-fallback / gather-path base would flag phantom
+        # regressions in both directions. Mismatched pairs are reported
+        # as incomparable, never as regressed.
+        bpc, tpc = _platform_class(b), _platform_class(t)
+        if bpc != tpc:
+            verdicts.append({
+                "mode": mode, "comparable": False,
+                "reason": f"platform changed ({bpc} -> {tpc}); the gate "
+                          f"compares like-for-like records only"})
+            continue
+        bk, tk = b.get("kern"), t.get("kern")
+        if bk is not None and tk is not None and bk != tk:
+            verdicts.append({
+                "mode": mode, "comparable": False,
+                "reason": f"decode kernel changed ({bk} -> {tk}); "
+                          f"compare like-for-like records only"})
             continue
         ratio = tv / bv
         entry: Dict[str, Any] = {

@@ -114,13 +114,20 @@ def release_lock() -> None:
         pass
 
 
-def fire(session: str) -> int:
+def fire(session: str, steps: str = "") -> int:
     ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
     tee_path = os.path.join(LOGS, f"tpu_session_{ts}.log")
-    log(f"tunnel is UP — firing {session} (tee: {tee_path})")
+    env = dict(os.environ)
+    if steps:
+        # step filter for short windows (the session script honors
+        # SWARMDB_TPU_STEPS — e.g. --steps 6 fires only the
+        # ragged-vs-gather prefill A/B)
+        env["SWARMDB_TPU_STEPS"] = steps
+    log(f"tunnel is UP — firing {session}"
+        f"{f' steps={steps}' if steps else ''} (tee: {tee_path})")
     with open(tee_path, "a") as tee:
         proc = subprocess.Popen(
-            ["bash", session], cwd=REPO, stdout=tee, stderr=tee,
+            ["bash", session], cwd=REPO, stdout=tee, stderr=tee, env=env,
         )
         rc = proc.wait()
     log(f"session finished rc={rc}")
@@ -139,6 +146,11 @@ def main() -> int:
                     help="exit after the first fired session")
     ap.add_argument("--once-probe", action="store_true",
                     help="one probe cycle then exit (cron mode)")
+    ap.add_argument("--steps", default=os.environ.get("SWARMDB_TPU_STEPS",
+                                                      ""),
+                    help="comma-separated session step filter exported as "
+                         "SWARMDB_TPU_STEPS (e.g. --steps 6 = only the "
+                         "ragged-vs-gather prefill A/B); default all")
     args = ap.parse_args()
 
     if not take_lock():
@@ -146,11 +158,12 @@ def main() -> int:
         return 0
     try:
         log(f"armed: session={args.session} interval={args.interval:.0f}s "
-            f"probe_timeout={args.probe_timeout:.0f}s")
+            f"probe_timeout={args.probe_timeout:.0f}s"
+            f"{f' steps={args.steps}' if args.steps else ''}")
         while True:
             p = probe(args.probe_timeout)
             if p["ok"]:
-                fire(args.session)
+                fire(args.session, args.steps)
                 if args.once or args.once_probe:
                     return 0
                 log("rearmed — waiting for the next window")

@@ -1,14 +1,21 @@
 #!/bin/bash
-# Round-5 TPU measurement queue — run when the tunnel answers.
+# TPU measurement queue — run when the tunnel answers (rounds 5+).
 # Serialized: ONE process owns the chip at a time. Each step tees its
 # record into bench_logs/ so a mid-run tunnel death still leaves
 # committed evidence (VERDICT r4: the round-4 recovery queue landed
 # zero logs; this one writes as it goes).
+#
+# SWARMDB_TPU_STEPS filters which steps fire (comma-separated ids,
+# default all) — the poller's --steps flag exports it, so a short
+# tunnel window can be spent on exactly the A/B that round needs
+# (e.g. SWARMDB_TPU_STEPS=6 runs only the ragged-prefill A/B).
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_logs
 TS=$(date -u +%Y%m%dT%H%M%S)
+STEPS="${SWARMDB_TPU_STEPS:-all}"
 log() { echo "[tpu-r5 $(date -u +%H:%M:%S)] $*"; }
+want() { [ "$STEPS" = all ] || case ",$STEPS," in *",$1,"*) ;; *) return 1;; esac; }
 
 probe() {
   timeout 90 python -c "import jax; d=jax.devices()[0]; print(d.platform)" \
@@ -18,39 +25,66 @@ probe() {
 if [ "$(probe)" != "axon" ] && [ "$(probe)" != "tpu" ]; then
   log "tunnel down; aborting"; exit 1
 fi
-log "tunnel is up"
+log "tunnel is up (steps: $STEPS)"
 
 # 1. merge-formulation race (PROFILE r4 session 2: ~27 ms fixed
 #    overhead — six full-cache copies + the one-hot merge)
-log "step 1: profile_merge race"
-timeout 1800 python scripts/profile_merge.py \
-  2>&1 | tee "bench_logs/profile_merge_${TS}.txt"
+if want 1; then
+  log "step 1: profile_merge race"
+  timeout 1800 python scripts/profile_merge.py \
+    2>&1 | tee "bench_logs/profile_merge_${TS}.txt"
+fi
 
-# 2. dense-chunked Pallas kernel A/B (new this round; env-gated)
-log "step 2: pallas chunked kernel serve A/B"
-for p in 0 1; do
-  SWARMDB_PALLAS=$p SWARMDB_BENCH_MODE=serve SWARMDB_BENCH_MAX_S=900 \
-    timeout 1000 python bench.py 2>/dev/null | tail -1 \
-    | tee "bench_logs/serve_pallas${p}_${TS}.json"
-done
+# 2. dense-chunked Pallas kernel A/B (env-gated)
+if want 2; then
+  log "step 2: pallas chunked kernel serve A/B"
+  for p in 0 1; do
+    SWARMDB_PALLAS=$p SWARMDB_BENCH_MODE=serve SWARMDB_BENCH_MAX_S=900 \
+      timeout 1000 python bench.py 2>/dev/null | tail -1 \
+      | tee "bench_logs/serve_pallas${p}_${TS}.json"
+  done
+fi
 
 # 3. full bench (the driver-format record, on silicon)
-log "step 3: bench mode=all"
-SWARMDB_BENCH_MAX_S=900 timeout 5600 python bench.py \
-  2>/dev/null | tee "bench_logs/all_${TS}.jsonl"
+if want 3; then
+  log "step 3: bench mode=all"
+  SWARMDB_BENCH_MAX_S=900 timeout 5600 python bench.py \
+    2>/dev/null | tee "bench_logs/all_${TS}.jsonl"
+fi
 
 # 4. long-context (S=1024 paged + in-place prefix reuse)
-log "step 4: longctx"
-SWARMDB_BENCH_MODE=longctx SWARMDB_BENCH_MAX_S=1200 timeout 1300 \
-  python bench.py 2>/dev/null | tail -1 \
-  | tee "bench_logs/longctx_${TS}.json"
+if want 4; then
+  log "step 4: longctx"
+  SWARMDB_BENCH_MODE=longctx SWARMDB_BENCH_MAX_S=1200 timeout 1300 \
+    python bench.py 2>/dev/null | tail -1 \
+    | tee "bench_logs/longctx_${TS}.json"
+fi
 
 # 5. rolling-KV serve A/B (paged), incl. the r5 self-reuse extraction
-log "step 5: rolling A/B"
-for r in 0 1; do
-  SWARMDB_PAGED=1 SWARMDB_ROLLING_KV=$r SWARMDB_BENCH_MODE=serve \
-    SWARMDB_BENCH_MAX_S=900 timeout 1000 python bench.py 2>/dev/null \
-    | tail -1 | tee "bench_logs/serve_paged_roll${r}_${TS}.json"
-done
+if want 5; then
+  log "step 5: rolling A/B"
+  for r in 0 1; do
+    SWARMDB_PAGED=1 SWARMDB_ROLLING_KV=$r SWARMDB_BENCH_MODE=serve \
+      SWARMDB_BENCH_MAX_S=900 timeout 1000 python bench.py 2>/dev/null \
+      | tail -1 | tee "bench_logs/serve_paged_roll${r}_${TS}.json"
+  done
+fi
+
+# 6. ragged-vs-gather prefill A/B (ISSUE 11): packed ragged waves + the
+#    Pallas ragged-paged-prefill kernel against the row-bucketed gather
+#    path, on the paged serve workload and the dpserve scaling A/B. The
+#    records carry `kernel` + `prefill_padding_ratio`, so a promoted
+#    record gates TPU perf like-for-like (scripts/bench_trend.py).
+if want 6; then
+  log "step 6: ragged prefill A/B"
+  for r in 0 1; do
+    SWARMDB_PAGED=1 SWARMDB_RAGGED_PREFILL=$r SWARMDB_BENCH_MODE=serve \
+      SWARMDB_BENCH_MAX_S=900 timeout 1000 python bench.py 2>/dev/null \
+      | tail -1 | tee "bench_logs/serve_ragged${r}_${TS}.json"
+    SWARMDB_RAGGED_PREFILL=$r SWARMDB_BENCH_MODE=dpserve \
+      SWARMDB_BENCH_MAX_S=900 timeout 1000 python bench.py 2>/dev/null \
+      | tail -1 | tee "bench_logs/dpserve_ragged${r}_${TS}.json"
+  done
+fi
 
 log "queue complete; records in bench_logs/"

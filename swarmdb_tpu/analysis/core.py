@@ -108,6 +108,11 @@ RULES: Dict[str, Rule] = {
         Rule("SWL204", "recompile-hazard",
              "len()-shaped host array reaches a jit-wrapped callable — "
              "every distinct count is a fresh traced shape (compile mine)"),
+        Rule("SWL205", "recompile-hazard",
+             "dispatch shape derived from descriptor-array len()/.shape "
+             "math in hot kernel-dispatch code — packed-wave widths must "
+             "come off the quantized ladder, not the data (variant "
+             "explosion: one compile per distinct count)"),
         Rule("SWL301", "lock-discipline",
              "guarded attribute accessed outside a `with` on its declared "
              "lock/Condition"),

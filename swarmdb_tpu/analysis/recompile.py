@@ -27,6 +27,15 @@ a latency cliff, not a nuisance. Four statically checkable shapes:
   the "compile mine" class PROFILE r4 stepped on twice (the eager
   page-table zeroing and the first ``_extract_lane`` dispatch). The fix
   is always the same — pad to a fixed wave size or bucket the count.
+- SWL205: the SCALAR-laundered twin of SWL204, scoped to ``# swarmlint:
+  hot`` kernel-dispatch code — ``n = len(rows)`` / ``n = arr.shape[0]``
+  descriptor math whose name then shapes an array constructor handed to
+  a jit-wrapped callable. The ragged packed-wave path's
+  variant-explosion hazard (ISSUE 11): a wave width copied straight off
+  the descriptors compiles one program per distinct token count, where
+  the engine's width ladder (``_ragged_width_for`` / ``_rows_for``)
+  quantizes it to a warmed bucket. Routing the count through such a
+  bucketing helper is exactly what breaks the taint — by design.
 """
 
 from __future__ import annotations
@@ -90,6 +99,7 @@ def check(src: SourceFile) -> List[Finding]:
     findings.extend(_check_call_sites(src))
     findings.extend(_check_warmup_coverage(src))
     findings.extend(_check_len_shaped_args(src))
+    findings.extend(_check_descriptor_shape_math(src))
     return findings
 
 
@@ -271,6 +281,98 @@ def _check_len_shaped_args(src: SourceFile) -> List[Finding]:
                         f"shape — every distinct count is a fresh traced "
                         f"shape (a compile mine); pad to a fixed wave "
                         f"size or bucket the count"))
+    return findings
+
+
+# ------------------------------------------------------------------ SWL205
+
+def _is_len_or_shape_expr(node: ast.AST) -> bool:
+    """``len(x)`` or ``x.shape`` / ``x.shape[i]`` — descriptor math that
+    turns data into a traced dimension."""
+    if isinstance(node, ast.Call) and dotted_name(node.func) == "len":
+        return True
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    return isinstance(node, ast.Attribute) and node.attr == "shape"
+
+
+def _check_descriptor_shape_math(src: SourceFile) -> List[Finding]:
+    """SWL205: in HOT functions, a scalar local bound to len()/.shape
+    descriptor math that then shapes an array constructor reaching a
+    jit-wrapped callable (directly or through a one-hop array binding).
+    SWL204 catches ``np.zeros((len(x), K))`` spelled inline; this is the
+    laundered form — ``n = len(stream); np.zeros(n)`` — which is exactly
+    how a ragged dispatch path accidentally keys its compiled-variant
+    space on per-wave token counts. A bucketing call
+    (``self._ragged_width_for(len(stream))``) breaks the taint: the
+    result is a method value, not descriptor math."""
+    findings: List[Finding] = []
+    jitted = _collect_jitted(src)
+    if not jitted:
+        return findings
+    hot_fns = [n for n in ast.walk(src.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and src.is_hot(n)]
+    for fn in hot_fns:
+        tainted: Set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and _is_len_or_shape_expr(node.value)):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    # unpacking: W, Hq = q.shape — every bound name is
+                    # a traced dimension
+                    for elt in tgt.elts:
+                        if isinstance(elt, ast.Name):
+                            tainted.add(elt.id)
+        if not tainted:
+            continue
+
+        def _shape_uses_taint(sh: ast.AST) -> bool:
+            return any(isinstance(n, ast.Name) and n.id in tainted
+                       for n in ast.walk(sh))
+
+        def _is_tainted_ctor(node: ast.AST) -> bool:
+            if not (isinstance(node, ast.Call) and node.args):
+                return False
+            name = dotted_name(node.func)
+            if not name or name.split(".")[-1] not in _ARRAY_CTORS:
+                return False
+            return _shape_uses_taint(node.args[0])
+
+        mined: Dict[str, ast.AST] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_tainted_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        mined[tgt.id] = node.value
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = dotted_name(node.func)
+            if cname is None or cname.split(".")[-1] not in jitted:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    break
+                via = None
+                if _is_tainted_ctor(arg):
+                    via = arg
+                elif isinstance(arg, ast.Name) and arg.id in mined:
+                    via = mined[arg.id]
+                if via is not None:
+                    findings.append(make_finding(
+                        src, "SWL205", via,
+                        f"argument of jit-wrapped "
+                        f"`{cname.split('.')[-1]}` is shaped by "
+                        f"descriptor len()/.shape math in hot dispatch "
+                        f"code — every distinct count compiles a new "
+                        f"variant; quantize the width through the "
+                        f"engine's ladder (e.g. _ragged_width_for / "
+                        f"_rows_for) instead"))
     return findings
 
 

@@ -19,9 +19,14 @@ fixed-shape decode loop under ``jax.jit`` with slot management —
   the garbage is never read.
 - Prefill runs per-sequence at bucketed lengths (powers of two) to bound
   the number of compiled variants, then the prefix cache is inserted into
-  the slot's rows of the batch KV cache. Prefill never syncs: its sampled
-  first token is scattered into the on-device ``last_tokens`` vector and
-  reaches the host as row 0 of the next chunk's token block.
+  the slot's rows of the batch KV cache. Single-shard PAGED engines
+  instead pack each admission wave into ONE ragged no-padding token
+  stream (``_prefill_ragged_waves``: per-row (start, len, prefix_len)
+  descriptors, prefix KV read in place from the page pool, widths off a
+  power-of-two ladder — ``SWARMDB_RAGGED_PREFILL=0`` restores the
+  bucketed waves). Prefill never syncs: its sampled first token is
+  scattered into the on-device ``last_tokens`` vector and reaches the
+  host as row 0 of the next chunk's token block.
 - Admission is priority-ordered (MessagePriority: CRITICAL first — the
   reference stores priorities but never uses them, SURVEY §2.2).
 - Tokens stream to per-request callbacks as they are sampled; the HTTP
@@ -179,6 +184,14 @@ class PagedKV:
     # per-shard row blocks (Engine._packed_geometry sizes the blocks).
     # None = the generic GSPMD prefill (single-chip, or prefix waves).
     prefill_packed: Optional[Callable] = None
+    # Single-shard pools only (lane engines included): packed RAGGED
+    # prefill (ISSUE 11) — (params, tokens[W], tok_row[W], tok_pos[W],
+    # row_tables[R, maxp], starts[R], lens[R], prefix_lens[R], k_pool,
+    # v_pool) -> ([R, V] last-token logits, sfx_k, sfx_v [L, W, Hkv, D]).
+    # One no-padding token stream per admission wave; prefix KV (cache
+    # hits and earlier chunks of a split prompt) is read straight from
+    # the page pool. None = the row-bucketed dense-bucket prefill.
+    prefill_ragged: Optional[Callable] = None
 
 
 class Engine:
@@ -750,6 +763,83 @@ class Engine:
                     _prefill_packed_pinned, donate_argnums=(5, 6, 7, 8)
                 )
 
+        # ---- RAGGED packed prefill (ISSUE 11 tentpole) --------------------
+        # One no-padding token stream per admission wave: rows concatenate
+        # back to back, per-row (start, len, prefix_len) descriptors ride
+        # the dispatch, and attention reads each row's prefix KV straight
+        # from the page pool (ops/layers.ragged_prefill_dispatch — the
+        # Pallas ragged-paged-prefill kernel on TPU). Wave widths come off
+        # a power-of-two ladder whose smallest rung (SWARMDB_RAGGED_MIN_
+        # WIDTH, default 1) makes every admission round an EXACT binary
+        # decomposition — padding_tokens ~0 where the row-bucketed path
+        # paid 12% — and the ladder is the ONLY compiled-variant axis:
+        # |widths| programs replace |buckets| x |row buckets| (+ the whole
+        # prefix-variant family, since a cache hit is just a nonzero
+        # prefix_len here). SWARMDB_RAGGED_PREFILL=0 restores the
+        # row-bucketed waves.
+        self._prefill_ragged_fused = None
+        self._ragged_widths: List[int] = []
+        self._last_wave_kind: Optional[str] = None
+        # which decode-attention path serves this engine's waves (paged
+        # only): stamped on flight-step records so kernel-vs-gather
+        # regressions are attributable from a dump alone
+        self._decode_kernel: Optional[str] = None
+        if paged is not None:
+            from ..ops.layers import decode_kernel_choice
+
+            self._decode_kernel = decode_kernel_choice(
+                paged.allocator.maxp * paged.page_size)
+        if (paged is not None and paged.prefill_ragged is not None
+                and getattr(paged.allocator, "n_shards", 1) <= 1
+                and os.environ.get("SWARMDB_RAGGED_PREFILL", "auto") != "0"):
+            try:
+                min_w = int(os.environ.get("SWARMDB_RAGGED_MIN_WIDTH", "1"))
+            except ValueError:
+                logger.warning("SWARMDB_RAGGED_MIN_WIDTH=%r is not an int; "
+                               "using 1",
+                               os.environ.get("SWARMDB_RAGGED_MIN_WIDTH"))
+                min_w = 1
+            ladder = [max(1, min(min_w, max_seq))]
+            while ladder[-1] < max_seq:
+                ladder.append(min(max_seq, ladder[-1] * 2))
+            self._ragged_widths = ladder
+            _ragged_body_fn = paged.prefill_ragged
+
+            def _prefill_ragged_insert(params, tokens, tok_row, tok_pos,
+                                       starts, lens, plens, row_tables,
+                                       scatter, k_pool, v_pool,
+                                       last_tokens, last_lps, base_keys,
+                                       temp, topk, topp):
+                # tokens/tok_row/tok_pos [W] packed stream (padding:
+                # row >= R, pos >= table coverage -> trash writes);
+                # descriptors [R]; scatter [R] fed-token targets —
+                # max_batch (dropped) for padding rows AND rows whose
+                # prompt continues in a later wave of the same round.
+                from ..ops.paged_kv import paged_write_ragged
+
+                last, sk, sv = _ragged_body_fn(
+                    params, tokens, tok_row, tok_pos, row_tables, starts,
+                    lens, plens, k_pool, v_pool)
+                # absolute-position PRNG fold == the bucketed paths'
+                # (prefix_lens + lengths - 1): identical sampling for an
+                # identical prompt whichever path admitted it
+                next_tok = sample_tokens(
+                    last, base_keys, jnp.maximum(plens + lens - 1, 0),
+                    temp, topk, topp)
+                lp = token_logprob(last, next_tok)
+                k_pool, v_pool = paged_write_ragged(
+                    k_pool, v_pool, sk, sv, tok_row, tok_pos, row_tables)
+                last_tokens = last_tokens.at[scatter].set(next_tok,
+                                                          mode="drop")
+                last_lps = last_lps.at[scatter].set(lp, mode="drop")
+                last_tokens, last_lps = self._pin_slot_state(last_tokens,
+                                                             last_lps)
+                return k_pool, v_pool, last_tokens, last_lps
+
+            self._prefill_ragged_fused = jax.jit(
+                _prefill_ragged_insert, donate_argnums=(9, 10, 11, 12)
+            )
+
         # ---- automatic prefix caching --------------------------------------
         # Chat serving re-prefills each conversation's WHOLE history every
         # turn (prefill dominated decode ~15:1 on the round-4 serve
@@ -1144,6 +1234,7 @@ class Engine:
     CALL_SET_PT_ROWS = 3
     CALL_DENSE_PREFIX_PREFILL = 4
     CALL_PAGED_PREFILL_PACKED = 5
+    CALL_PAGED_PREFILL_RAGGED = 6
 
     def _replicate_block(self, all_toks, all_lps):
         """Constrain the chunk's sampled-token block to REPLICATED when the
@@ -1249,6 +1340,19 @@ class Engine:
         self.cache = self._paged_cache_with(pk, pv)
 
     # swarmlint: hot
+    def _call_paged_ragged_prefill(self, tokens, tok_row, tok_pos, starts,
+                                   lens, plens, row_tables, scatter, keys,
+                                   temp, topk, topp) -> None:
+        k_pool, v_pool, self._last_tokens, self._last_lps = \
+            self._prefill_ragged_fused(
+                self.params, tokens, tok_row, tok_pos, starts, lens,
+                plens, row_tables, scatter, self.cache["k"],
+                self.cache["v"], self._last_tokens, self._last_lps, keys,
+                temp, topk, topp,
+            )
+        self.cache = self._paged_cache_with(k_pool, v_pool)
+
+    # swarmlint: hot
     def _call_set_pt_rows(self, rows, vals) -> None:
         from ..ops.paged_kv import set_page_table_rows
 
@@ -1275,6 +1379,7 @@ class Engine:
         CALL_SET_PT_ROWS: _call_set_pt_rows,
         CALL_DENSE_PREFIX_PREFILL: _call_dense_prefix_prefill,
         CALL_PAGED_PREFILL_PACKED: _call_paged_prefill_packed,
+        CALL_PAGED_PREFILL_RAGGED: _call_paged_ragged_prefill,
     }
 
     def restart(self) -> None:
@@ -1461,9 +1566,35 @@ class Engine:
         zero_f = np.zeros(Bp, np.float32)
         ones_f = np.ones(Bp, np.float32)
         keys = self._base_keys_np[np.zeros(Bp, np.int64)]
+        if self._ragged_active():
+            # packed ragged waves: ONE variant per packed width — every
+            # input is padding (dead rows, trash-routed positions)
+            R = self.max_batch
+            maxp = self.paged.allocator.maxp
+            cap = maxp * self.paged.page_size
+            for wd in self._ragged_widths:
+                self._mirrored(
+                    self.CALL_PAGED_PREFILL_RAGGED,
+                    np.full(wd, self.pad_id, np.int32),
+                    np.full(wd, R, np.int32),
+                    np.full(wd, cap, np.int32),
+                    np.zeros(R, np.int32),
+                    np.zeros(R, np.int32),
+                    np.zeros(R, np.int32),
+                    np.zeros((R, maxp), np.int32),
+                    np.full(R, self.max_batch, np.int32),
+                    self._base_keys_np[np.zeros(R, np.int64)],
+                    np.zeros(R, np.float32),
+                    np.zeros(R, np.int32),
+                    np.ones(R, np.float32),
+                )
         for bucket in self.prefill_buckets:
             tokens = np.full((Bp, bucket), self.pad_id, np.int32)
             if self.paged:
+                if self._ragged_active():
+                    # ragged waves replace the bucketed (and prefix)
+                    # variants entirely — warmed above
+                    continue
                 # target page 0 = the trash page (absorbs garbage writes);
                 # fed-token rows scatter to max_batch (dropped)
                 chunks = -(-bucket // self.paged.page_size)
@@ -1517,21 +1648,26 @@ class Engine:
                     tokens = np.full((Bp, bucket), self.pad_id, np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        for rb in self._row_buckets:
-                            self._mirrored(
-                                self.CALL_PAGED_PREFIX_PREFILL,
-                                np.full((rb, bucket), self.pad_id,
-                                        np.int32),
-                                np.ones(rb, np.int32),
-                                np.zeros(rb, np.int32),
-                                np.zeros((rb, ppb), np.int32),
-                                np.zeros((rb, chunks), np.int32),
-                                np.full(rb, self.max_batch, np.int32),
-                                self._base_keys_np[np.zeros(rb, np.int64)],
-                                np.zeros(rb, np.float32),
-                                np.zeros(rb, np.int32),
-                                np.ones(rb, np.float32),
-                            )
+                        if not self._ragged_active():
+                            # ragged engines serve cache hits through the
+                            # ragged waves (a hit is just a prefix_len);
+                            # only the rolling-resume variants below stay
+                            for rb in self._row_buckets:
+                                self._mirrored(
+                                    self.CALL_PAGED_PREFIX_PREFILL,
+                                    np.full((rb, bucket), self.pad_id,
+                                            np.int32),
+                                    np.ones(rb, np.int32),
+                                    np.zeros(rb, np.int32),
+                                    np.zeros((rb, ppb), np.int32),
+                                    np.zeros((rb, chunks), np.int32),
+                                    np.full(rb, self.max_batch, np.int32),
+                                    self._base_keys_np[np.zeros(rb,
+                                                                np.int64)],
+                                    np.zeros(rb, np.float32),
+                                    np.zeros(rb, np.int32),
+                                    np.ones(rb, np.float32),
+                                )
                         if self._warm_resume():
                             # rolling-KV resume variants (gated: each is a
                             # 30-90 s compile on the tunneled service and
@@ -1592,6 +1728,27 @@ class Engine:
         rows_per = max(1, min(self.prefill_batch, self.max_batch // n_sh))
         return n_sh, rows_per, n_sh * rows_per
 
+    def _ragged_active(self) -> bool:
+        """Whether paged admission runs PACKED RAGGED waves (one
+        no-padding token stream per wave, prefix KV read in place)
+        instead of row-bucketed dense-bucket prefills. ONE gate shared
+        by warmup(), warmup_call_plan() and _admit — the same
+        agree-or-cold-compile contract as _packed_active. Off when the
+        model has no ragged forward, on sharded pools (the shard-packed
+        path owns those), or under SWARMDB_RAGGED_PREFILL=0."""
+        return (self._prefill_ragged_fused is not None
+                and not self._packed_active())
+
+    def _ragged_width_for(self, n: int) -> int:
+        """Largest packed-width bucket <= ``n`` — waves peel off the
+        ladder top-down, so every wave is EXACTLY full (zero padding)
+        until the remainder drops below the smallest rung; that final
+        flush pads by < min_width tokens."""
+        for w in reversed(self._ragged_widths):
+            if w <= n:
+                return w
+        return self._ragged_widths[0]
+
     def _warm_resume(self) -> bool:
         """Whether warmup covers the rolling-KV resume variants (paged +
         prefix engines, SWARMDB_ROLLING_KV deployments only). ONE gate
@@ -1651,9 +1808,21 @@ class Engine:
 
         keys_Bp = sds((Bp,) + self._base_keys_np.shape[1:], key_dt)
         i32_Bp, f32_Bp = sds((Bp,), np.int32), sds((Bp,), np.float32)
+        if self._ragged_active():
+            maxp = self.paged.allocator.maxp
+            keys_R = sds((B,) + self._base_keys_np.shape[1:], key_dt)
+            for wd in self._ragged_widths:
+                w_i32 = sds((wd,), np.int32)
+                plan.append((self._prefill_ragged_fused, (
+                    params_s, w_i32, w_i32, w_i32, i32_B, i32_B, i32_B,
+                    sds((B, maxp), np.int32), i32_B, cache_s["k"],
+                    cache_s["v"], lt_s, llp_s, keys_R, f32_B, i32_B,
+                    f32_B)))
         for bucket in self.prefill_buckets:
             tok = sds((Bp, bucket), np.int32)
             if self.paged:
+                if self._ragged_active():
+                    continue
                 chunks = -(-bucket // self.paged.page_size)
                 if self._packed_active():
                     _, _, R = self._packed_geometry()
@@ -1687,18 +1856,23 @@ class Engine:
                     table = sds((Bp, ppb), np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        for rb in self._row_buckets:
-                            keys_rb = sds(
-                                (rb,) + self._base_keys_np.shape[1:],
-                                key_dt)
-                            i32_rb, f32_rb = (sds((rb,), np.int32),
-                                              sds((rb,), np.float32))
-                            plan.append((self._prefill_paged_prefix_fused, (
-                                params_s, sds((rb, bucket), np.int32),
-                                i32_rb, i32_rb, sds((rb, ppb), np.int32),
-                                sds((rb, chunks), np.int32), i32_rb,
-                                cache_s["k"], cache_s["v"], lt_s, llp_s,
-                                keys_rb, f32_rb, i32_rb, f32_rb)))
+                        if not self._ragged_active():
+                            for rb in self._row_buckets:
+                                keys_rb = sds(
+                                    (rb,) + self._base_keys_np.shape[1:],
+                                    key_dt)
+                                i32_rb, f32_rb = (sds((rb,), np.int32),
+                                                  sds((rb,), np.float32))
+                                plan.append(
+                                    (self._prefill_paged_prefix_fused, (
+                                        params_s,
+                                        sds((rb, bucket), np.int32),
+                                        i32_rb, i32_rb,
+                                        sds((rb, ppb), np.int32),
+                                        sds((rb, chunks), np.int32),
+                                        i32_rb, cache_s["k"],
+                                        cache_s["v"], lt_s, llp_s,
+                                        keys_rb, f32_rb, i32_rb, f32_rb)))
                         if self._warm_resume():
                             maxp = self.paged.allocator.maxp
                             plan.append((self._prefill_paged_resume_fused, (
@@ -2008,7 +2182,7 @@ class Engine:
         for name in ("_prefill_fused", "_prefill_paged_fused",
                      "_prefill_paged_packed", "_prefill_paged_prefix_fused",
                      "_prefill_paged_resume_fused", "_prefill_prefix_fused",
-                     "_extract_lane_fused"):
+                     "_prefill_ragged_fused", "_extract_lane_fused"):
             fn = getattr(self, name, None)
             if fn is not None:
                 fns.append(fn)
@@ -2056,10 +2230,20 @@ class Engine:
             "tokens_generated": c["tokens_generated"].value,
             "prompt_tokens": c["prompt_tokens"].value,
             "prefill_padding_tokens": c["prefill_padding_tokens"].value,
+            "prefill_packed_tokens": c["prefill_packed_tokens"].value,
             "host_syncs": c["engine_host_syncs"].value,
             "restarts": c["engine_restarts"].value,
             "compiled_variants": self._compiled_count(),
         }
+        if self._last_wave_kind is not None:
+            # which prefill family served the most recent wave (ragged
+            # packed stream vs bucketed dense batch)
+            rec["wave_kind"] = self._last_wave_kind
+        if self._decode_kernel is not None:
+            # which decode-attention path serves this engine (pallas
+            # kernel vs XLA page gather) — the analyzer needs it to
+            # attribute kernel-vs-gather regressions across records
+            rec["decode_kernel"] = self._decode_kernel
         if self._use_resident():
             # evidence-quality marker for the analyzer's stall split:
             # resident-path steps sample occupancy right AFTER admission
@@ -2488,7 +2672,10 @@ class Engine:
                     np.stack([r[1] for r in rows]).astype(np.int32),
                 )
             use_prefix = self._prefix is not None
-            groups: Dict[Tuple[int, int], List[Tuple]] = {}
+            ragged = self.paged is not None and self._ragged_active()
+            row_by_slot = dict(rows) if self.paged else {}
+            groups: Dict[Tuple[Any, int], List[Tuple]] = {}
+            ragged_batch: List[Tuple] = []
             prefix_batch: List[Tuple] = []
             resume_batch: List[Tuple] = []
             max_suffix = max_hits = 0
@@ -2503,6 +2690,18 @@ class Engine:
                     resume_batch.append((slot_id, req, resume_rows[slot_id]))
                     max_suffix_r = max(max_suffix_r, len(req.prompt))
                     max_pages_r = max(max_pages_r, len(req.resume_pages))
+                    continue
+                if ragged:
+                    # packed ragged waves absorb BOTH the plain and the
+                    # prefix-planned rows (a cache hit is just a nonzero
+                    # prefix_len descriptor); resume rows keep the
+                    # bucketed path (mid-page custody bookkeeping)
+                    if use_prefix and slot_id in plans:
+                        hits, chains = plans[slot_id]
+                    else:
+                        hits, chains = [], None
+                    ragged_batch.append((slot_id, req, hits, chains,
+                                         row_by_slot[slot_id]))
                     continue
                 if not self.paged and req.resume_pages is not None:
                     # dense rolling resume: kept prefix-pool pages compose
@@ -2551,9 +2750,13 @@ class Engine:
                 key = (self._bucket_for(max(1, max_suffix_r)),
                        -self._pp_bucket_for(max(1, max_pages_r)))
                 groups[key] = resume_batch
+            if ragged_batch:
+                groups[("ragged", 0)] = ragged_batch
             for (bucket, ppb), batch in groups.items():
                 try:
-                    if ppb < 0 and not self.paged:
+                    if bucket == "ragged":
+                        self._prefill_ragged_waves(batch)
+                    elif ppb < 0 and not self.paged:
                         self._prefill_dense_resume_batch(batch, bucket, -ppb)
                     elif ppb < 0:
                         self._prefill_paged_resume_batch(batch, bucket, -ppb)
@@ -2746,6 +2949,9 @@ class Engine:
         )
         self.metrics.counters["prefill_padding_tokens"].inc(
             int(padded.size) - int(lengths[:len(batch)].sum()))
+        self.metrics.counters["prefill_packed_tokens"].inc(
+            int(lengths[:len(batch)].sum()))
+        self._last_wave_kind = "bucketed"
         pins: Dict[int, List[int]] = {}
         for slot_id, chain, toks, page_id in reg_records:
             if self._prefix.register(chain, toks, page_id):
@@ -2798,6 +3004,9 @@ class Engine:
         )
         self.metrics.counters["prefill_padding_tokens"].inc(
             int(padded.size) - int(lengths[:len(batch)].sum()))
+        self.metrics.counters["prefill_packed_tokens"].inc(
+            int(lengths[:len(batch)].sum()))
+        self._last_wave_kind = "bucketed"
         self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
         self._activate([(s, r) for s, r, _ in batch], t0)
 
@@ -2848,6 +3057,9 @@ class Engine:
         self.metrics.counters["prefix_reused_tokens"].inc(int(plens.sum()))
         self.metrics.counters["prefill_padding_tokens"].inc(
             int(padded.size) - int(lengths[:len(rows)].sum()))
+        self.metrics.counters["prefill_packed_tokens"].inc(
+            int(lengths[:len(rows)].sum()))
+        self._last_wave_kind = "bucketed"
         self._activate([(r[0], r[1]) for r in rows], t0)
 
     # swarmlint: hot
@@ -2905,6 +3117,121 @@ class Engine:
         for rec in reg_records:
             self._prefix.register(*rec)
 
+    # swarmlint: hot
+    def _prefill_ragged_waves(self, batch: List[Tuple]) -> None:
+        """Packed ragged admission waves (ISSUE 11 tentpole): the wave's
+        rows concatenate into ONE token stream — no row buckets, no
+        length buckets — described by per-row (start, len, prefix_len)
+        descriptors, and every wave's width comes off the power-of-two
+        ladder LARGEST-FIT, so waves are exactly full (zero padding)
+        until the remainder drops under the smallest rung. A row longer
+        than a wave's remaining budget SPLITS: its head's K/V lands in
+        its pages this wave, and the tail rides the next wave with
+        prefix_len advanced — the ragged kernel reads the
+        already-written pages back in place, exactly like a prefix-cache
+        hit. Sampling fires only on a row's FINAL chunk (scatter id
+        max_batch drops the rest), with the same absolute-position PRNG
+        fold as the bucketed paths.
+
+        ``batch`` rows: (slot_id, req, hits, chains, table_row) — hits/
+        chains from the admission-time prefix plan (chains None = row not
+        prefix-planned: sub-page prompt, keep_pages, or prefix off)."""
+        t0 = time.time()
+        R = self.max_batch
+        ps = self.paged.page_size
+        maxp = self.paged.allocator.maxp
+        cap = maxp * ps
+        pend: List[List[Any]] = []
+        for slot_id, req, hits, chains, row in batch:
+            p0 = len(hits) * ps
+            pend.append([slot_id, req.prompt[p0:], p0, 0, row])
+            s = req.sampling
+            self._temp[slot_id] = s.temperature
+            self._topk[slot_id] = s.top_k
+            self._topp[slot_id] = s.top_p
+            self._set_slot_key(slot_id, s.seed)
+        packed_n = padding_n = 0
+        while pend:
+            total = 0
+            for it in pend:
+                total += len(it[1]) - it[3]
+            wd = self._ragged_width_for(total)
+            tokens = np.full(wd, self.pad_id, np.int32)
+            tok_row = np.full(wd, R, np.int32)   # R = dead row sentinel
+            tok_pos = np.full(wd, cap, np.int32)  # >= coverage -> trash
+            starts = np.zeros(R, np.int32)
+            lens = np.zeros(R, np.int32)
+            plens = np.zeros(R, np.int32)
+            tables = np.zeros((R, maxp), np.int32)
+            scatter = np.full(R, self.max_batch, np.int32)
+            gather = np.zeros(R, np.int64)
+            filled = 0
+            r = 0
+            for it in pend:
+                if filled >= wd or r >= R:
+                    break
+                slot_id, suffix, p0, consumed, row = (it[0], it[1], it[2],
+                                                      it[3], it[4])
+                take = min(len(suffix) - consumed, wd - filled)
+                if take <= 0:
+                    continue
+                abs0 = p0 + consumed
+                tokens[filled:filled + take] = suffix[consumed:
+                                                      consumed + take]
+                tok_row[filled:filled + take] = r
+                tok_pos[filled:filled + take] = np.arange(
+                    abs0, abs0 + take, dtype=np.int32)
+                starts[r] = filled
+                lens[r] = take
+                plens[r] = abs0
+                tables[r] = row
+                gather[r] = slot_id
+                if consumed + take == len(suffix):
+                    scatter[r] = slot_id     # final chunk: sample here
+                it[3] = consumed + take
+                filled += take
+                r += 1
+            self._mirrored(
+                self.CALL_PAGED_PREFILL_RAGGED, tokens, tok_row, tok_pos,
+                starts, lens, plens, tables, scatter,
+                self._base_keys_np[gather], self._temp[gather],
+                self._topk[gather], self._topp[gather],
+            )
+            packed_n += filled
+            padding_n += wd - filled
+            pend = [it for it in pend if it[3] < len(it[1])]
+        self.metrics.counters["prefill_packed_tokens"].inc(packed_n)
+        self.metrics.counters["prefill_padding_tokens"].inc(padding_n)
+        self._last_wave_kind = "ragged"
+        if self._prefix is not None:
+            # registration mirrors _prefill_paged_prefix_batch: custody
+            # of the prompt's fresh FULL pages moves to the cache with no
+            # copy; matched hits stay pinned until retirement
+            reused = 0
+            for slot_id, req, hits, chains, _row in batch:
+                if chains is None:
+                    continue
+                reused += len(hits) * ps
+                prompt = req.prompt
+                fresh = self.paged.allocator.pages_for(slot_id)
+                pins: List[int] = []
+                n_full = len(prompt) // ps
+                for page_idx in range(len(hits), n_full):
+                    f = page_idx - len(hits)
+                    if f >= len(fresh):
+                        break
+                    toks = tuple(prompt[page_idx * ps:(page_idx + 1) * ps])
+                    if self._prefix.register(chains[page_idx], toks,
+                                             fresh[f]):
+                        self.paged.allocator.transfer_to_cache(
+                            slot_id, [fresh[f]])
+                        self._prefix.pin([fresh[f]])
+                        pins.append(fresh[f])
+                self._slot_prefix_pins[slot_id] = hits + pins
+            if reused:
+                self.metrics.counters["prefix_reused_tokens"].inc(reused)
+        self._activate([(b[0], b[1]) for b in batch], t0)
+
     def _prefill_batch(self, batch: List[Tuple[int, GenRequest]]) -> None:  # swarmlint: hot
         """One compiled prefill for up to ``prefill_batch`` admissions.
 
@@ -2945,6 +3272,9 @@ class Engine:
         # (bucket rounding + padding rows) — flight-recorder occupancy
         self.metrics.counters["prefill_padding_tokens"].inc(
             int(padded.size) - int(lengths[:n].sum()))
+        self.metrics.counters["prefill_packed_tokens"].inc(
+            int(lengths[:n].sum()))
+        self._last_wave_kind = "bucketed"
 
         if not self.paged:
             # ONE dispatch: forward + sample + slot insert + token scatter.

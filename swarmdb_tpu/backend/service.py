@@ -255,6 +255,16 @@ def build_backend_engine(
             num_pages=num_pages,
             allocator=PageAllocator(num_pages, page_size, seq, max_batch),
         )
+        if hasattr(mod, "forward_ragged_prefill"):
+            # packed ragged admission waves (ISSUE 11): one no-padding
+            # token stream per wave, prefix KV read in place from the
+            # pool. Dense-Llama-family only today (mixtral has no ragged
+            # forward); the engine keeps the row-bucketed path as the
+            # SWARMDB_RAGGED_PREFILL=0 fallback either way.
+            paged_spec.prefill_ragged = (
+                lambda p, toks, trow, tpos, tables, st, ln, pl, pk, pv:
+                    mod.forward_ragged_prefill(p, cfg, toks, trow, tpos,
+                                               tables, st, ln, pl, pk, pv))
 
     # Automatic prefix caching: chat serving re-prefills each
     # conversation's history every turn, so reuse of page-aligned

@@ -277,6 +277,81 @@ def forward_prefix_pages(
     return logits, sfx_k, sfx_v
 
 
+def forward_ragged_prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [W] int32 packed token stream (rows concat)
+    tok_row: jnp.ndarray,     # [W] int32 owning wave row (>= R = padding)
+    tok_pos: jnp.ndarray,     # [W] int32 absolute position within the row
+    row_tables: jnp.ndarray,  # [R, maxp] int32 page-pool ids per row
+    starts: jnp.ndarray,      # [R] int32 row offset in the stream
+    lens: jnp.ndarray,        # [R] int32 row token count (0 = dead row)
+    prefix_lens: jnp.ndarray,  # [R] int32 tokens already in the row's pages
+    pool_k: jnp.ndarray,      # [L, P, ps, Hkv, D] MAIN paged pool
+    pool_v: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Packed ragged PREFILL forward (ISSUE 11): ONE no-padding token
+    stream per admission wave — the wave's rows concatenated back to back,
+    described by per-row ``(start, len, prefix_len)`` descriptors. Each
+    token attends its own row's prefix KV straight out of the page pool
+    (prefix-cache hits AND earlier chunks of a split prompt — the
+    ``ops.layers.ragged_prefill_dispatch`` kernel reads pages in place,
+    no ``paged_gather_kv`` densification) plus the row's suffix causally.
+
+    The layer scan addresses the pool through its flattened [L*P] view
+    with a per-layer table offset, so the kernel sees a single page axis
+    (a reshape, not a copy). Returns (fp32 logits [R, V] at each row's
+    LAST live token, sfx_k, sfx_v [L, W, Hkv, D] — packed, stream order,
+    for ``ops.paged_kv.paged_write_ragged``).
+    """
+    if cfg.is_moe:
+        raise ValueError(f"{cfg.name!r} is MoE; ragged prefill is "
+                         "dense-Llama-only for now")
+    from ..ops.layers import ragged_prefill_dispatch
+
+    W = tokens.shape[0]
+    L, P = pool_k.shape[0], pool_k.shape[1]
+    x = params["embed"][tokens][None]                    # [1, W, D]
+    cos, sin = rope_cos_sin(tok_pos[None], cfg.head_dim, cfg.rope_theta)
+    pool_k_flat = pool_k.reshape((L * P,) + pool_k.shape[2:])
+    pool_v_flat = pool_v.reshape((L * P,) + pool_v.shape[2:])
+    tables = row_tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    lens = lens.astype(jnp.int32)
+    plens = prefix_lens.astype(jnp.int32)
+
+    def layer_step(x, scanned):
+        lp, l = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cos, sin)
+        # suffix K/V cast to the pool dtype BEFORE attention (matching
+        # forward_prefix_pages): what this wave attends is bit-identical
+        # to what later waves/decodes read back from the pages
+        ks = k[0].astype(pool_k.dtype)
+        vs = v[0].astype(pool_v.dtype)
+        attn = ragged_prefill_dispatch(
+            q[0], ks, vs, pool_k_flat, pool_v_flat, tables + l * P,
+            starts, lens, plens, tok_row, window=cfg.sliding_window)
+        x = x + jnp.einsum("wh,hd->wd", attn.reshape(W, -1), lp["wo"])[None]
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (ks, vs)
+
+    x, (sfx_k, sfx_v) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    last_w = starts + jnp.maximum(lens - 1, 0)           # dead rows -> 0
+    logits = jnp.einsum("rd,dv->rv", x[0, last_w], head,
+                        preferred_element_type=jnp.float32)
+    return logits, sfx_k, sfx_v
+
+
 def forward_prefix_lane(
     params: Params,
     cfg: ModelConfig,

@@ -205,12 +205,21 @@ def summarize_flight(dump: Dict[str, Any]) -> Dict[str, Any]:
              if r.get("admitted_at") and r.get("submitted_at")]
     ttft = [r["first_token_at"] - r["submitted_at"] for r in reqs
             if r.get("first_token_at") and r.get("submitted_at")]
+    # which attention paths served these steps (ISSUE 11): a regression
+    # whose base and test dumps disagree here is a PATH change
+    # (pallas<->gather, ragged<->bucketed), not a perf drift of one path
+    kernels = sorted({s["decode_kernel"] for s in steps
+                      if s.get("decode_kernel")})
+    wave_kinds = sorted({s["wave_kind"] for s in steps
+                         if s.get("wave_kind")})
     return {
         "steps": len(steps),
         "requests": len(reqs),
         "shard_imbalance": round(med(imbalances), 4) if imbalances else 0.0,
         "shards": len((steps[0].get("active_by_shard") or {})) if steps
         else 0,
+        "decode_kernels": kernels,
+        "wave_kinds": wave_kinds,
         "padding_ratio": round(padding / prompt, 4) if prompt > 0 else 0.0,
         "admission_stall_frac": round(stall_w / stall_total, 4)
         if stall_total > 0 else 0.0,

@@ -318,6 +318,186 @@ def paged_decode_gqa_attention_chunked(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Ragged paged PREFILL attention (ISSUE 11 tentpole).
+#
+# One packed token STREAM per admission wave: the engine concatenates the
+# wave's rows back to back (no per-row bucket padding) and describes them
+# with per-row ``(start, len, prefix_len)`` descriptors that ride as
+# scalar-prefetch operands (SMEM). Grid (R, maxp + n_suffix_tiles): grid
+# row ``r`` streams row r's PREFIX pages straight out of the page pool via
+# the page table (no ``paged_gather_kv`` densification — the dead-iteration
+# DMA-skip trick from the decode kernels bounds HBM traffic at live pages),
+# then the packed suffix K/V in [tile]-token slices, all folded into one
+# online softmax (`_online_update`, the same machinery the decode kernels
+# use). Causality inside the stream is POSITIONAL: rows are contiguous, so
+# "key index <= query index within the same row" is exactly causal order
+# and no per-token position array is needed in the kernel.
+#
+# v1 keeps the whole packed stream (q, suffix K/V, fp32 accumulators)
+# VMEM-resident — right-sized for serving waves up to a few hundred tokens
+# at repro-scale models; production-scale head counts want a query-axis
+# block loop on top (noted in ROADMAP). Per grid row the kernel computes
+# scores for every stream query against that row's KV and discards the
+# foreign rows' results at the masked finalize write — wasted MACs scale
+# with R, but the HBM story (pages read once, in place) is what the gather
+# fallback cannot do.
+
+
+def _ragged_prefill_kernel(table_ref, starts_ref, lens_ref, plens_ref,
+                           q_ref, sk_ref, sv_ref, kp_ref, vp_ref, o_ref,
+                           acc_ref, m_ref, l_ref, *, page_size: int,
+                           n_kv_heads: int, n_pages: int, tile: int,
+                           window):
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    n_steps = pl.num_programs(1)
+    W, Hq, D = q_ref.shape
+    Hkv = n_kv_heads
+    G = Hq // Hkv
+    ps = page_size
+    start = starts_ref[r]
+    ln = lens_ref[r]
+    plen = plens_ref[r]
+    scale = 1.0 / (D ** 0.5)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when((r == 0) & (j == 0))
+    def _zero_out():
+        # the output block is revisited by every grid row (constant index
+        # map) and finalized with a masked write per row — positions no
+        # row owns (none when the stream is packed dense) stay zero
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    # stream index of each score row (score rows are (w, g) pairs,
+    # w-major — matching q.reshape(W, Hkv, G, D))
+    wq = jax.lax.div(
+        jax.lax.broadcasted_iota(jnp.int32, (W * G, 1), 0), jnp.int32(G))
+    q_abs = plen + wq - start    # absolute position of query w IN ROW r
+
+    def fold(k_tile, v_tile, valid):
+        # k_tile/v_tile [Tk, Hkv, D]; valid [W*G, Tk]
+        q = q_ref[...].reshape(W, Hkv, G, D).astype(jnp.float32)
+        k = k_tile.astype(jnp.float32)
+        v = v_tile.astype(jnp.float32)
+        for h in range(Hkv):
+            qh = q[:, h].reshape(W * G, D)
+            s = jax.lax.dot_general(
+                qh, k[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                  # [W*G, Tk]
+            _online_update(h, jnp.where(valid, s, -1e30), v[:, h, :],
+                           acc_ref, m_ref, l_ref)
+
+    @pl.when((j < n_pages) & (j * ps < plen))
+    def _prefix():
+        kpos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        valid = kpos < plen
+        if window is not None:
+            valid &= kpos > (q_abs - window)
+        fold(kp_ref[0], vp_ref[0], jnp.broadcast_to(valid, (W * G, ps)))
+
+    @pl.when((j >= n_pages) & (ln > 0))
+    def _suffix():
+        t = j - n_pages
+        first = jax.lax.div(start, jnp.int32(tile))
+        last = jax.lax.div(start + ln - 1, jnp.int32(tile))
+        tt = first + t
+
+        @pl.when(tt <= last)
+        def _live():
+            # dynamic [tile]-slice of the resident packed K/V; the slice
+            # start clamps to W - tile, so the anti-overlap term
+            # (x >= tt*tile) keeps a clamped tail tile from re-folding
+            # keys the previous tile already saw
+            s0 = jnp.minimum(tt * tile, jnp.int32(W - tile))
+            x = s0 + jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+            valid = ((x >= tt * tile) & (x >= start) & (x < start + ln)
+                     & (x <= wq))
+            if window is not None:
+                valid &= x > (wq - window)
+            fold(sk_ref[pl.ds(s0, tile)], sv_ref[pl.ds(s0, tile)], valid)
+
+    @pl.when(j == n_steps - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)    # [Hkv, W*G, 1]
+        out = (acc_ref[...] / denom).reshape(Hkv, W, G, D)
+        out = out.transpose(1, 0, 2, 3).reshape(W, Hq, D)
+        w_iota = jax.lax.broadcasted_iota(jnp.int32, (W, 1, 1), 0)
+        mine = (w_iota >= start) & (w_iota < start + ln)
+        o_ref[...] = jnp.where(mine, out.astype(o_ref.dtype), o_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("window", "tile", "interpret"))
+def ragged_paged_prefill_attention(
+    q: jnp.ndarray,           # [W, Hq, D] packed query stream
+    sfx_k: jnp.ndarray,       # [W, Hkv, D] packed suffix K (this wave's)
+    sfx_v: jnp.ndarray,
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] single-layer page pool
+    v_pages: jnp.ndarray,
+    row_tables: jnp.ndarray,  # [R, maxp] int32 page ids per wave row
+    starts: jnp.ndarray,      # [R] int32 — row r's offset in the stream
+    lens: jnp.ndarray,        # [R] int32 — row r's token count (0 = dead)
+    prefix_lens: jnp.ndarray,  # [R] int32 — tokens already in r's pages
+    window=None,
+    tile: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged paged prefill attention over a packed wave; returns
+    [W, Hq, D] in q.dtype (positions outside every row are zero)."""
+    W, Hq, D = q.shape
+    _, ps, Hkv, _ = k_pages.shape
+    R, maxp = row_tables.shape
+    G = Hq // Hkv
+    Tk = min(tile, W)
+    n_st = -(-W // Tk)
+    table = row_tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    lens = lens.astype(jnp.int32)
+    plens = prefix_lens.astype(jnp.int32)
+
+    def stream_map(r, j, table_ref, starts_ref, lens_ref, plens_ref):
+        return (0, 0, 0)
+
+    def kv_map(r, j, table_ref, starts_ref, lens_ref, plens_ref):
+        # dead page iterations AND every suffix-tile iteration re-point at
+        # the last live prefix page, so their DMA is skipped; empty prefix
+        # -> table[r, 0] (trash page 0 for fresh rows)
+        last_live = _last_live_page(plens_ref[r], ps)
+        return (table_ref[r, jnp.minimum(j, last_live)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(R, maxp + n_st),
+        in_specs=[
+            pl.BlockSpec((W, Hq, D), stream_map),
+            pl.BlockSpec((W, Hkv, D), stream_map),
+            pl.BlockSpec((W, Hkv, D), stream_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((W, Hq, D), stream_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, W * G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, W * G, 128), jnp.float32),  # running max
+            pltpu.VMEM((Hkv, W * G, 128), jnp.float32),  # running denom
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_prefill_kernel, page_size=ps,
+                          n_kv_heads=Hkv, n_pages=maxp, tile=Tk,
+                          window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, Hq, D), q.dtype),
+        interpret=interpret,
+    )(table, starts, lens, plens, q, sfx_k, sfx_v, k_pages, v_pages)
+
+
 def _dense_chunk_attn_kernel(start_ref, step_ref, q_ref, k_ref, v_ref,
                              ck_ref, cv_ref, o_ref, acc_ref, m_ref, l_ref,
                              *, tile: int, n_kv_heads: int, window):

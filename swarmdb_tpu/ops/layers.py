@@ -59,9 +59,12 @@ def _paged_pallas_enabled(kv_span: Optional[int] = None) -> bool:
     the MXU where the kernel's per-page [G, ps] dots cannot (swarm100 on
     v5e at S=256: gather 2150 tok/s vs kernel 1484), so the TPU default
     flips to the kernel only when the table's coverage ``kv_span`` (maxp *
-    page_size) reaches SWARMDB_PALLAS_MIN_SEQ (default 1024). SWARMDB_
-    PALLAS=0 forces the gather fallback everywhere, =1 forces the kernel
-    even off-TPU (interpret mode — slow, for tests)."""
+    page_size) reaches SWARMDB_PALLAS_KV_SPAN (default 1024 — the one
+    v5e measurement above; retune the knob, not the code, when new
+    silicon numbers land; the legacy SWARMDB_PALLAS_MIN_SEQ name is still
+    honored). SWARMDB_PALLAS=0 forces the gather fallback everywhere,
+    =1 forces the kernel even off-TPU (interpret mode — slow, for
+    tests)."""
     if getattr(_pallas_ctx, "disabled", False):
         return False
     env = os.environ.get("SWARMDB_PALLAS", "")
@@ -73,7 +76,37 @@ def _paged_pallas_enabled(kv_span: Optional[int] = None) -> bool:
         return False
     if kv_span is None:
         return True
-    return kv_span >= int(os.environ.get("SWARMDB_PALLAS_MIN_SEQ", "1024"))
+    thr = os.environ.get(
+        "SWARMDB_PALLAS_KV_SPAN",
+        os.environ.get("SWARMDB_PALLAS_MIN_SEQ", "1024"))
+    return kv_span >= int(thr)
+
+
+def decode_kernel_choice(kv_span: Optional[int] = None) -> str:
+    """Host-side view of the decode-attention dispatch: ``"pallas"`` when
+    the ragged paged kernel would serve a table of ``kv_span`` coverage,
+    ``"gather"`` for the XLA page-gather fallback. The engine stamps this
+    on flight-step records (and the bench on its mode record) so the
+    analyzer can attribute a kernel-vs-gather regression instead of
+    guessing which path a record measured."""
+    return "pallas" if _paged_pallas_enabled(kv_span) else "gather"
+
+
+def _ragged_prefill_kernel_enabled() -> bool:
+    """Gate for the ragged paged PREFILL kernel: SWARMDB_PALLAS=0 forces
+    the XLA reference fallback, =1 forces the kernel even off-TPU
+    (interpret mode — tests), default = kernel exactly on TPU. No
+    kv-span crossover here: prefill waves amortize the page reads over
+    the whole suffix, so the kernel's in-place page streaming wins as
+    soon as there is any prefix at all and merely ties without one."""
+    if getattr(_pallas_ctx, "disabled", False):
+        return False
+    env = os.environ.get("SWARMDB_PALLAS", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
 
 
 def paged_attention_dispatch(
@@ -140,6 +173,115 @@ def paged_attention_dispatch_chunked(
     kg, vg = paged_gather_kv(k_pages, v_pages, page_table)
     return gqa_attention_chunked(q, kg, vg, chunk_k, chunk_v, q_positions,
                                  step, window=window)
+
+
+def ragged_prefill_attention_reference(
+    q: jnp.ndarray,           # [W, Hq, D] packed query stream
+    sfx_k: jnp.ndarray,       # [W, Hkv, D] packed suffix K
+    sfx_v: jnp.ndarray,
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] page pool (single layer)
+    v_pages: jnp.ndarray,
+    row_tables: jnp.ndarray,  # [R, maxp] int32
+    starts: jnp.ndarray,      # [R] int32 stream offset per row
+    lens: jnp.ndarray,        # [R] int32 suffix length per row (0 = dead)
+    prefix_lens: jnp.ndarray,  # [R] int32 tokens already in the pages
+    tok_row: jnp.ndarray,     # [W] int32 owning row per token (>= R = pad)
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Dense XLA reference for the ragged paged prefill kernel — and its
+    off-TPU fallback. Every packed token attends its own row's prefix
+    pages (gathered dense, positions ``0..prefix_lens[r]``) plus the
+    row's suffix tokens causally; one fp32 softmax spans both segments.
+    Cross-row scores are masked via ``tok_row``; padding tokens (row id
+    >= R) match no real row and produce garbage the caller discards.
+
+    Materializes [W, Pt] gathered prefix KV and [W, Pt + W] fp32 scores —
+    the densification the Pallas kernel exists to avoid; fine for CPU
+    tests/fallback waves, wrong for silicon. Returns [W, Hq, D]."""
+    W, Hq, D = q.shape
+    Hkv = sfx_k.shape[1]
+    G = Hq // Hkv
+    R, maxp = row_tables.shape
+    ps = k_pages.shape[1]
+    Pt = maxp * ps
+
+    row = jnp.clip(tok_row, 0, R - 1)
+    kp = k_pages[row_tables].reshape(R, Pt, Hkv, D)
+    vp = v_pages[row_tables].reshape(R, Pt, Hkv, D)
+    kp_t = kp[row]                                       # [W, Pt, Hkv, D]
+    vp_t = vp[row]
+
+    qg = q.reshape(W, Hkv, G, D)
+    s_p = jnp.einsum("wkgd,wpkd->wkgp", qg, kp_t,
+                     preferred_element_type=jnp.float32)
+    s_s = jnp.einsum("wkgd,xkd->wkgx", qg, sfx_k,
+                     preferred_element_type=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    x = jnp.arange(W, dtype=jnp.int32)
+    q_abs = prefix_lens[row] + x - starts[row]           # [W]
+    p_pos = jnp.arange(Pt, dtype=jnp.int32)
+    valid_p = p_pos[None, :] < prefix_lens[row][:, None]  # [W, Pt]
+    if window is not None:
+        valid_p &= p_pos[None, :] > (q_abs[:, None] - window)
+    same = tok_row[:, None] == tok_row[None, :]          # [W, W]
+    valid_s = same & (x[None, :] <= x[:, None])          # packed causal
+    if window is not None:
+        valid_s &= x[None, :] > (x[:, None] - window)
+
+    s_p = jnp.where(valid_p[:, None, None, :], s_p * scale,
+                    jnp.float32(-1e30))
+    s_s = jnp.where(valid_s[:, None, None, :], s_s * scale,
+                    jnp.float32(-1e30))
+    s = jnp.concatenate([s_p, s_s], axis=-1)             # [W, Hkv, G, Pt+W]
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("wkgp,wpkd->wkgd", p[..., :Pt].astype(vp_t.dtype),
+                     vp_t, preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("wkgx,xkd->wkgd",
+                           p[..., Pt:].astype(sfx_v.dtype), sfx_v,
+                           preferred_element_type=jnp.float32)
+    return out.reshape(W, Hq, D).astype(q.dtype)
+
+
+def ragged_prefill_dispatch(
+    q: jnp.ndarray,           # [W, Hq, D] packed query stream
+    sfx_k: jnp.ndarray,       # [W, Hkv, D]
+    sfx_v: jnp.ndarray,
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    row_tables: jnp.ndarray,  # [R, maxp]
+    starts: jnp.ndarray,
+    lens: jnp.ndarray,
+    prefix_lens: jnp.ndarray,
+    tok_row: jnp.ndarray,     # [W]
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Packed ragged PREFILL attention over the paged pool: the Pallas
+    ragged kernel on TPU (prefix pages read in place via the page table —
+    no gather densification, no bucket padding), the dense XLA reference
+    elsewhere. Same TPU-gated / interpreter-tested pattern as the paged
+    decode dispatchers above. Returns [W, Hq, D]."""
+    if _ragged_prefill_kernel_enabled():
+        from .attention_pallas import ragged_paged_prefill_attention
+
+        W = q.shape[0]
+        pad = (-W) % 8                 # TPU sublane quantum for tiny waves
+        if pad:
+            grow = ((0, pad), (0, 0), (0, 0))
+            q = jnp.pad(q, grow)
+            sfx_k = jnp.pad(sfx_k, grow)
+            sfx_v = jnp.pad(sfx_v, grow)
+        out = ragged_paged_prefill_attention(
+            q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts, lens,
+            prefix_lens, window=window,
+            interpret=jax.default_backend() != "tpu",
+        )
+        return out[:W] if pad else out
+    return ragged_prefill_attention_reference(
+        q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts, lens,
+        prefix_lens, tok_row, window=window)
 
 
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:

@@ -183,6 +183,34 @@ def paged_write_chunk(
     return k_pages, v_pages
 
 
+def paged_write_ragged(
+    k_pages: jnp.ndarray,    # [L, P, ps, Hkv, D]
+    v_pages: jnp.ndarray,
+    sfx_k: jnp.ndarray,      # [L, W, Hkv, D] packed wave K (stream order)
+    sfx_v: jnp.ndarray,
+    tok_row: jnp.ndarray,    # [W] int32 owning wave row (>= R = padding)
+    tok_pos: jnp.ndarray,    # [W] int32 absolute position within the row
+    row_tables: jnp.ndarray,  # [R, maxp] int32 page ids per wave row
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Positional per-token scatter of a PACKED ragged prefill wave's K/V
+    into the page pool: stream token t lands at page
+    ``row_tables[tok_row[t], tok_pos[t] // ps]`` offset ``tok_pos[t] %
+    ps``. Padding tokens (row id out of range, or positions past the
+    table's coverage) land in trash page 0 — the same invariants as
+    :func:`paged_write_decode` / :func:`paged_write_chunk`."""
+    ps = k_pages.shape[2]
+    R, maxp = row_tables.shape
+    col = jnp.clip(tok_pos // ps, 0, maxp - 1)
+    row = jnp.clip(tok_row, 0, R - 1)
+    page = row_tables[row, col]                          # [W]
+    dead = (tok_pos >= maxp * ps) | (tok_row < 0) | (tok_row >= R)
+    page = jnp.where(dead, 0, page)
+    off = jnp.where(dead, 0, tok_pos % ps)
+    k_pages = k_pages.at[:, page, off].set(sfx_k.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page, off].set(sfx_v.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
 _set_page_table_rows = jax.jit(
     lambda pt, rows, values: pt.at[rows].set(values, mode="drop")
 )

@@ -145,6 +145,13 @@ class ShardLaneGroup:
             if info.mesh is not None else {},
             "paged_shards": len(lanes),
             "admit_overlap": True,
+            # per-lane waves run the packed ragged prefill (ISSUE 11):
+            # each lane's admission wave is ONE no-padding token stream
+            # whose width comes off the power-of-two ladder, dispatched
+            # on that lane's device stream — the packing is lane-local,
+            # so it composes with (not fights) the admission overlap
+            "ragged_prefill": bool(
+                getattr(ref, "_prefill_ragged_fused", None) is not None),
             "max_batch": self.max_batch,
             "max_seq": self.max_seq,
         })
@@ -337,6 +344,9 @@ class ShardLaneGroup:
             "lanes": len(per),
             "queued_by_lane": [p["queued"] for p in per],
             "active_by_lane": [p["active_slots"] for p in per],
+            "ragged_prefill": bool(
+                getattr(self.lanes[0], "_prefill_ragged_fused", None)
+                is not None),
         }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()

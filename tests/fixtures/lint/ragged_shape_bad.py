@@ -1,0 +1,41 @@
+"""Seeded SWL205 violations (swarmlint fixture — never imported):
+descriptor-array len()/.shape math shaping a jit dispatch in hot code —
+the ragged packed-wave variant-explosion hazard. ``# EXPECT``
+annotations are asserted by test_swarmlint.py."""
+import jax
+import numpy as np
+
+dispatch = jax.jit(lambda toks, rows: (toks, rows))
+
+
+class WaveBuilder:
+    _widths = (1, 2, 4, 8, 16)
+
+    def _width_for(self, n):
+        for w in reversed(self._widths):
+            if w <= n:
+                return w
+        return self._widths[0]
+
+    def bad_wave(self, stream, descs):  # swarmlint: hot
+        n = len(stream)
+        toks = np.zeros(n, np.int32)  # EXPECT: SWL205
+        dispatch(toks, descs)
+
+    def bad_shape_wave(self, descs):  # swarmlint: hot
+        rows = descs.shape[0]
+        dispatch(np.zeros((rows, 4), np.int32), descs)  # EXPECT: SWL205
+
+    def good_wave(self, stream, descs):  # swarmlint: hot
+        # the width ladder launders the count: one compiled variant per
+        # rung, not per distinct stream length
+        wd = self._width_for(len(stream))
+        toks = np.zeros(wd, np.int32)
+        dispatch(toks, descs)
+
+    def cold_wave(self, stream, descs):
+        # same math OUTSIDE hot code: setup paths may size host arrays
+        # freely (SWL204 still polices inline len()-shapes reaching jit)
+        n = len(stream)
+        toks = np.ones(n, np.int32)
+        dispatch(toks, descs)

@@ -173,3 +173,149 @@ def test_chunked_dispatch_env(monkeypatch):
     out = layers.gqa_attention_chunked(q[:, None], k, v, ck, cv, qpos, step)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+# ---- ragged paged PREFILL kernel (ISSUE 11) --------------------------------
+
+
+def _ragged_case(rows, ps=8, maxp=6, Hq=8, Hkv=2, D=16, seed=0,
+                 dtype=np.float32):
+    """Build a packed ragged wave from ``rows`` = [(prefix_len,
+    suffix_len)]: page pool + per-row tables covering each prefix,
+    packed q / suffix K/V streams, and the descriptor arrays."""
+    rng = np.random.default_rng(seed)
+    R = len(rows)
+    W = sum(s for _, s in rows)
+    P = 1 + sum(-(-p // ps) for p, _ in rows) + 2
+    kp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)).astype(dtype))
+    vp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)).astype(dtype))
+    tables = np.zeros((R, maxp), np.int32)
+    starts = np.zeros(R, np.int32)
+    lens = np.zeros(R, np.int32)
+    plens = np.zeros(R, np.int32)
+    tok_row = np.zeros(W, np.int32)
+    nxt, off = 1, 0
+    for r, (p, s) in enumerate(rows):
+        n = -(-p // ps)
+        assert n <= maxp
+        tables[r, :n] = range(nxt, nxt + n)
+        nxt += n
+        starts[r], lens[r], plens[r] = off, s, p
+        tok_row[off:off + s] = r
+        off += s
+    q = jnp.asarray(rng.normal(size=(W, Hq, D)).astype(dtype))
+    sk = jnp.asarray(rng.normal(size=(W, Hkv, D)).astype(dtype))
+    sv = jnp.asarray(rng.normal(size=(W, Hkv, D)).astype(dtype))
+    return (q, sk, sv, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(lens), jnp.asarray(plens)), jnp.asarray(tok_row)
+
+
+_MIXED_ROWS = [(0, 5), (13, 9), (7, 1), (20, 16)]  # page-crossing prefixes
+
+
+def _ragged_kernel_vs_reference(rows, tol=2e-5, window=None, **kw):
+    from swarmdb_tpu.ops.attention_pallas import (
+        ragged_paged_prefill_attention)
+    from swarmdb_tpu.ops.layers import ragged_prefill_attention_reference
+
+    args, tok_row = _ragged_case(rows, **kw)
+    ref = ragged_prefill_attention_reference(*args, tok_row, window=window)
+    out = ragged_paged_prefill_attention(*args, window=window, tile=16,
+                                         interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
+
+
+def test_ragged_mixed_rows_cross_page_boundaries():
+    """Mixed suffix lengths with prefixes that cross page boundaries —
+    the full acceptance grid shape — within 2e-5 of the dense XLA
+    reference."""
+    _ragged_kernel_vs_reference(_MIXED_ROWS)
+
+
+@pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 2), (8, 1), (4, 4)])
+def test_ragged_gqa_head_ratios(Hq, Hkv):
+    _ragged_kernel_vs_reference([(0, 7), (9, 12), (16, 3)], Hq=Hq,
+                                Hkv=Hkv, seed=Hq * 10 + Hkv)
+
+
+def test_ragged_single_token_rows():
+    """Every row contributes exactly one query token (the wave shape a
+    burst of cache-hit turns produces)."""
+    _ragged_kernel_vs_reference([(8, 1), (0, 1), (23, 1), (16, 1)], seed=3)
+
+
+def test_ragged_empty_row_is_inert():
+    """A dead descriptor row (len 0) must not perturb its neighbors and
+    must not produce NaNs."""
+    from swarmdb_tpu.ops.attention_pallas import (
+        ragged_paged_prefill_attention)
+
+    rows = [(0, 5), (13, 9), (0, 0), (20, 16)]
+    args, _ = _ragged_case(rows, seed=4)
+    out = ragged_paged_prefill_attention(*args, tile=16, interpret=True)
+    assert np.isfinite(np.asarray(out)).all()
+    # and the surviving rows still match the reference exactly
+    _ragged_kernel_vs_reference(rows, seed=4)
+
+
+def test_ragged_sliding_window_parity():
+    _ragged_kernel_vs_reference(_MIXED_ROWS, window=7, seed=5)
+
+
+def test_ragged_bfloat16():
+    from swarmdb_tpu.ops.attention_pallas import (
+        ragged_paged_prefill_attention)
+    from swarmdb_tpu.ops.layers import ragged_prefill_attention_reference
+
+    args, tok_row = _ragged_case(_MIXED_ROWS, seed=6)
+    bf = [a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
+          for a in args]
+    out = ragged_paged_prefill_attention(*bf, tile=16, interpret=True)
+    ref = ragged_prefill_attention_reference(*bf, tok_row)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_ragged_reference_anchored_on_prefix_attention():
+    """The ragged reference itself must agree with the TRUSTED two-
+    segment prefill attention (gqa_attention_prefix) row by row — so the
+    kernel parity above is anchored to the path serving already uses,
+    not to a second implementation of the same bug."""
+    from swarmdb_tpu.ops.layers import (gqa_attention_prefix,
+                                        ragged_prefill_attention_reference)
+
+    args, tok_row = _ragged_case(_MIXED_ROWS, seed=7)
+    (q, sk, sv, kp, vp, tables, starts, lens, plens) = args
+    out = ragged_prefill_attention_reference(*args, tok_row)
+    ps, maxp = kp.shape[1], tables.shape[1]
+    Pt = maxp * ps
+    for r, (p, s) in enumerate(_MIXED_ROWS):
+        s0 = int(starts[r])
+        kp_r = kp[tables[r]].reshape(1, Pt, *kp.shape[2:])
+        vp_r = vp[tables[r]].reshape(1, Pt, *vp.shape[2:])
+        ref_r = gqa_attention_prefix(
+            q[None, s0:s0 + s], kp_r, vp_r, sk[None, s0:s0 + s],
+            sv[None, s0:s0 + s], jnp.asarray([p], jnp.int32))[0]
+        np.testing.assert_allclose(
+            np.asarray(out[s0:s0 + s]), np.asarray(ref_r),
+            rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_dispatch_env(monkeypatch):
+    """SWARMDB_PALLAS=1 routes ragged_prefill_dispatch through the
+    kernel (interpret off-TPU, incl. the sublane pad for tiny waves) and
+    matches the reference."""
+    from swarmdb_tpu.ops import layers
+
+    rows = [(8, 3), (0, 2)]  # W=5: exercises the %8 sublane pad
+    args, tok_row = _ragged_case(rows, seed=8)
+    monkeypatch.setenv("SWARMDB_PALLAS", "0")
+    ref = layers.ragged_prefill_dispatch(*args, tok_row)
+    monkeypatch.setenv("SWARMDB_PALLAS", "1")
+    out = layers.ragged_prefill_dispatch(*args, tok_row)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,150 @@
+"""Packed ragged prefill waves (ISSUE 11): engine-level contracts.
+
+The kernel-vs-reference parity grid lives in test_pallas_attention.py;
+this file pins the ENGINE half of the tentpole:
+
+- zero prefill padding on the ragged path (exact binary-ladder wave
+  decomposition) where the row-bucketed path paid bucket rounding;
+- greedy decode bit-identical with SWARMDB_RAGGED_PREFILL=1 vs 0 —
+  including prompts long enough to split across waves (the tail chunk
+  reads its head's pages back through the ragged kernel's prefix path);
+- the compiled prefill variant count of the ragged plan is STRICTLY
+  below the bucketed plan's (the warmup_call_plan acceptance number);
+- warmup covers everything serving hits: no recompiles mid-traffic;
+- prefix-cache hits ride the ragged waves as prefix_len descriptors
+  (reuse counters move, outputs stay deterministic);
+- flight-step records carry wave_kind + decode_kernel tags.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from swarmdb_tpu.backend.sampling import SamplingParams
+from swarmdb_tpu.backend.service import build_backend_engine
+from swarmdb_tpu.models.configs import get_config
+
+CFG = get_config("tiny-debug")
+PROMPTS = [[1, 5, 9, 2, 7] * 3, [4] * 37, [7], [2, 3] * 11]
+
+
+def _build(ragged: bool, monkeypatch):
+    monkeypatch.setenv("SWARMDB_RAGGED_PREFILL", "1" if ragged else "0")
+    eng, _tok = build_backend_engine(CFG, max_batch=4, max_seq=96,
+                                     paged=True, page_size=16)
+    return eng
+
+
+def _greedy(eng, prompt, n=8):
+    return eng.generate_sync(prompt, SamplingParams(max_new_tokens=n))
+
+
+def test_ragged_engine_wiring(monkeypatch):
+    eng = _build(True, monkeypatch)
+    assert eng._ragged_active()
+    # power-of-two ladder from SWARMDB_RAGGED_MIN_WIDTH (1) to max_seq
+    assert eng._ragged_widths == [1, 2, 4, 8, 16, 32, 64, 96]
+    assert eng._ragged_width_for(96) == 96
+    assert eng._ragged_width_for(37) == 32   # largest-fit, never round up
+    assert eng._ragged_width_for(1) == 1
+    off = _build(False, monkeypatch)
+    assert not off._ragged_active()
+    # the row-bucketed fallback machinery stays intact under =0
+    assert off._row_buckets == [1, 2, 4]
+
+
+def test_ragged_zero_padding_and_exact_packing(monkeypatch):
+    eng = _build(True, monkeypatch)
+    c = eng.metrics.counters
+    eng.start()
+    try:
+        for p in PROMPTS:
+            _greedy(eng, p)
+        assert c["prefill_padding_tokens"].value == 0
+        assert c["prefill_packed_tokens"].value == sum(
+            len(p) for p in PROMPTS)
+    finally:
+        eng.stop()
+    # the flight record carries the wave-kind + decode-kernel tags
+    steps = eng.flight.steps()
+    assert any(s.get("wave_kind") == "ragged" for s in steps)
+    assert all(s.get("decode_kernel") in ("pallas", "gather")
+               for s in steps if "decode_kernel" in s)
+    assert any("prefill_packed_tokens" in s for s in steps)
+
+
+def test_ragged_greedy_bit_identical_to_bucketed(monkeypatch):
+    """Acceptance: engine greedy decode is bit-identical with
+    SWARMDB_RAGGED_PREFILL=1 vs 0 — same PRNG folds, same bf16 KV bytes,
+    prompts spanning single-wave, multi-wave-split, and sub-page
+    shapes."""
+    rag = _build(True, monkeypatch)
+    buck = _build(False, monkeypatch)
+    rag.start()
+    buck.start()
+    try:
+        for p in PROMPTS + [[9] * 61]:   # 61 splits as 32+16+8+4+1
+            tr, rr = _greedy(rag, p, n=10)
+            tb, rb = _greedy(buck, p, n=10)
+            assert tr == tb, (p, tr, tb)
+            assert rr == rb
+    finally:
+        rag.stop()
+        buck.stop()
+
+
+def test_ragged_plan_strictly_fewer_prefill_variants(monkeypatch):
+    """Acceptance: compiled prefill variant count strictly below the
+    bucketed plan's. The ragged plan's only prefill axis is the width
+    ladder; the bucketed plan multiplies buckets x row buckets and adds
+    the whole prefix (bucket x width x rows) family."""
+    rag = _build(True, monkeypatch)
+    buck = _build(False, monkeypatch)
+
+    def prefill_entries(eng):
+        decode = set(eng._decode_variants)
+        if eng._resident_variants is not None:
+            decode |= set(eng._resident_variants)
+        return [fn for fn, _ in eng.warmup_call_plan() if fn not in decode]
+
+    n_rag, n_buck = len(prefill_entries(rag)), len(prefill_entries(buck))
+    assert n_rag == len(rag._ragged_widths)
+    assert n_rag < n_buck, (n_rag, n_buck)
+
+
+def test_ragged_warmup_covers_serving(monkeypatch):
+    """No cold compiles mid-traffic: after warmup, serving mixed shapes
+    (splits, prefix hits, sub-page prompts) adds ZERO compiled
+    variants."""
+    eng = _build(True, monkeypatch)
+    eng.warmup()
+    n0 = eng._compiled_count()
+    assert n0 >= len(eng._ragged_widths)
+    eng.start()
+    try:
+        for p in PROMPTS:
+            _greedy(eng, p)
+        _greedy(eng, PROMPTS[0])         # prefix-cache hit wave
+    finally:
+        eng.stop()
+    assert eng._compiled_count() == n0
+
+
+def test_ragged_prefix_hits_ride_the_waves(monkeypatch):
+    """A repeated prompt's second admission reuses its registered pages
+    as a prefix_len descriptor: reuse counters move, padding stays zero,
+    and greedy output is unchanged."""
+    eng = _build(True, monkeypatch)
+    c = eng.metrics.counters
+    eng.start()
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6] * 5   # 40 tokens = 2.5 pages
+        t1, _ = _greedy(eng, prompt)
+        assert c["prefix_reused_tokens"].value == 0
+        t2, _ = _greedy(eng, prompt)
+        assert c["prefix_reused_tokens"].value == 32  # 2 full pages
+        assert t2 == t1
+        assert c["prefill_padding_tokens"].value == 0
+    finally:
+        eng.stop()

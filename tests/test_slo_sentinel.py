@@ -351,6 +351,44 @@ def test_bench_trend_attributes_regression(tmp_path):
     assert bt.main([]) == 0
 
 
+def test_bench_trend_kernel_and_platform_gate(tmp_path):
+    """ISSUE 11: the gate compares like-for-like only. A promoted TPU
+    record (no cpu-fallback marker) against a CPU-fallback base — or a
+    pallas-kernel record against a gather one — is reported incomparable,
+    never regressed; same-class pairs still gate normally."""
+    bt = _load_bench_trend()
+    base = {"parsed": {"modes": {
+        # platform flip: cpu-fallback base vs native test (lower value
+        # must NOT read as a regression — it is a different machine)
+        "serve": {"v": 100.0, "pl": "cpu-fallback"},
+        # kernel flip at same platform class
+        "dpserve": {"v": 100.0, "kern": "gather"},
+        # same class, genuinely regressed: still gated
+        "echo": {"v": 100.0, "pl": "cpu-fallback"},
+    }}}
+    test = {"parsed": {"modes": {
+        "serve": {"v": 20.0},
+        "dpserve": {"v": 20.0, "kern": "pallas"},
+        "echo": {"v": 20.0, "pl": "cpu-fallback"},
+    }}}
+    b, t = tmp_path / "a.json", tmp_path / "b.json"
+    b.write_text(json.dumps(base))
+    t.write_text(json.dumps(test))
+    report = bt.build_report(str(b), str(t), threshold=0.15)
+    by_mode = {v["mode"]: v for v in report["modes"]}
+    assert by_mode["serve"]["comparable"] is False
+    assert "platform changed" in by_mode["serve"]["reason"]
+    assert by_mode["dpserve"]["comparable"] is False
+    assert "kernel changed" in by_mode["dpserve"]["reason"]
+    assert by_mode["echo"]["regressed"] is True
+    assert report["regressed_modes"] == ["echo"]
+    # single-mode lifted records ("pl": raw jax platform) classify as
+    # cpu too — the r03-vs-r05 trajectory stays comparable
+    assert bt._platform_class({"pl": "cpu"}) == "cpu"
+    assert bt._platform_class({"pl": "cpu-fallback"}) == "cpu"
+    assert bt._platform_class({}) == "native"
+
+
 def test_bench_trend_pairs_without_phase_shares(tmp_path):
     bt = _load_bench_trend()
     base = {"parsed": {"modes": {"serve": {"v": 50.0, "p50": 1.0}}}}

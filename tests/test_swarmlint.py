@@ -38,6 +38,7 @@ def expected_findings(path: Path):
     "hot_sync_bad.py",          # host-sync family (SWL101/SWL102)
     "hot_sync_loop_bad.py",     # host-sync-in-loop family (SWL105)
     "recompile_bad.py",         # recompile family (SWL201/202/203)
+    "ragged_shape_bad.py",      # descriptor shape math in hot code (SWL205)
     "lock_bad.py",              # lock-discipline family (SWL301)
     "tracer_leak_bad.py",       # tracer-leak family (SWL401)
     "span_bad.py",              # span-discipline family (SWL501/502)
