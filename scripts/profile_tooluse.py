@@ -62,6 +62,7 @@ def section_moe_floor() -> dict:
         return (time.perf_counter() - t0) / reps
 
     for mode in ("einsum", "scatter"):
+        # swarmlint: disable=SWL201 -- one jit per A/B dispatch mode (2 total) by design
         blk = jax.jit(lambda x, m=mode: mixtral.moe_block(
             x, lp["router"][0], lp["w_gate"][0], lp["w_up"][0],
             lp["w_down"][0], cfg.experts_per_token, dispatch=m)[0])
@@ -70,6 +71,7 @@ def section_moe_floor() -> dict:
     toks = np.zeros((Bp, T), np.int32)
     pos = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (Bp, T))
     for mode in ("einsum", "scatter"):
+        # swarmlint: disable=SWL201 -- one jit per A/B dispatch mode (2 total) by design
         fwd = jax.jit(lambda p, t, po, c, m=mode: mixtral.forward(
             p, cfg, t, po, c, moe_dispatch=m)[0])
         cache = mixtral.init_kv_cache(cfg, Bp, T)

@@ -10,8 +10,13 @@ actually had (see ISSUE/ADVICE history):
   per call, per-call-varying argument signatures, and jit entry points a
   class's warmup plan doesn't cover (the static twin of the precompile
   drift test).
-- **lock-discipline** (SWL301, locks.py): reads/writes of declared
-  guarded attributes outside a ``with`` on their lock/Condition.
+- **lock-discipline** (SWL301 locks.py; SWL302-305 lockorder.py, the
+  ISSUE 12 swarmlock family): declared-guard violations (301),
+  interprocedural lock-order inversion over the callgraph.py call
+  graph (302), inferred guarded-by with zero annotations (303),
+  blocking-while-holding / wait-not-in-while (304), and stored
+  callbacks invoked under a lock (305). The runtime twin is
+  ``SWARMDB_LOCKCHECK=1`` (obs/lockcheck.py + utils/sync.py).
 - **tracer-leak** (SWL401, tracers.py): stores to self/global/nonlocal
   from inside traced functions.
 

@@ -17,15 +17,17 @@ import sys
 from typing import List, Optional
 
 from .core import (DEFAULT_BASELINE, RULES, Finding, analyze_paths,
-                   expand_rule_names, load_baseline, write_baseline)
+                   expand_rule_names, iter_py_files, load_baseline,
+                   load_baseline_entries, write_baseline)
 
 
 def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m swarmdb_tpu.analysis",
         description="swarmlint: JAX-aware static analysis (host-sync, "
-                    "recompile, lock-discipline, tracer-leak, "
-                    "span-discipline)")
+                    "recompile, lock-discipline incl. interprocedural "
+                    "lock-order/guarded-by inference, tracer-leak, "
+                    "span-discipline, heartbeat/fencing, retry)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to scan "
                          "(default: swarmdb_tpu/)")
@@ -42,7 +44,98 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(default: all)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="SWL<code>", default=None,
+                    help="print the rule's doc plus a minimal bad/good "
+                         "example and exit (family names print every "
+                         "member rule)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="report baseline entries whose finding no "
+                         "longer exists in the scanned tree (moved/fixed"
+                         "/deleted code); REPORT-ONLY unless --write")
+    ap.add_argument("--write", action="store_true",
+                    help="with --prune-baseline: rewrite the baseline "
+                         "keeping only the entries that still match")
     return ap
+
+
+def _explain(name: str) -> int:
+    from .explain import EXPLAIN
+
+    try:
+        rules = sorted(expand_rule_names([name]))
+    except KeyError as exc:
+        print(f"swarmlint: {exc.args[0]}", file=sys.stderr)
+        return 2
+    for i, rid in enumerate(rules):
+        if i:
+            print()
+        rule = RULES[rid]
+        print(f"{rid} [{rule.family}] — {rule.summary}")
+        entry = EXPLAIN.get(rid)
+        if entry is None:  # pragma: no cover - every rule has an entry
+            continue
+        print()
+        print(entry["doc"])
+        print()
+        print("  BAD:")
+        for line in entry["bad"].splitlines():
+            print(f"    {line}")
+        print("  GOOD:")
+        for line in entry["good"].splitlines():
+            print(f"    {line}")
+    return 0
+
+
+def _prune_baseline(paths, baseline_path: str, write: bool) -> int:
+    """Drop baseline entries whose finding no longer exists. An entry is
+    stale when its file is gone, or the file was scanned and no current
+    finding carries its fingerprint (the fingerprint is content-
+    addressed, so pure line-number churn does NOT stale an entry).
+    Entries for files outside the scanned set are kept untouched."""
+    try:
+        entries = load_baseline_entries(baseline_path)
+    except FileNotFoundError:
+        print(f"swarmlint: baseline {baseline_path} not found",
+              file=sys.stderr)
+        return 2
+    scanned = {os.path.normpath(p).replace(os.sep, "/")
+               for p in iter_py_files(paths)}
+    current = {f.fingerprint for f in analyze_paths(paths)}
+    kept, stale = [], []
+    for e in entries:
+        path = str(e.get("path", ""))
+        if not os.path.exists(path):
+            stale.append(e)
+        elif path in scanned and e.get("fingerprint") not in current:
+            stale.append(e)
+        else:
+            kept.append(e)
+    for e in stale:
+        why = ("file gone" if not os.path.exists(str(e.get("path", "")))
+               else "finding no longer produced")
+        print(f"stale: {e.get('path')}:{e.get('line')} {e.get('rule')} "
+              f"({why})")
+    if not stale:
+        print(f"swarmlint: baseline {baseline_path} has no stale "
+              f"entries ({len(kept)} current)")
+        return 0
+    if write:
+        payload = {
+            "version": 1,
+            "comment": ("Accepted swarmlint findings. CI fails only on "
+                        "NEW findings; regenerate with --update-baseline "
+                        "after reviewing every entry you are accepting."),
+            "findings": kept,
+        }
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        print(f"swarmlint: pruned {len(stale)} stale entrie(s), "
+              f"{len(kept)} kept -> {baseline_path}")
+    else:
+        print(f"swarmlint: {len(stale)} stale entrie(s) of "
+              f"{len(entries)} (report-only; pass --write to prune)")
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -51,8 +144,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rule in sorted(RULES.values(), key=lambda r: r.id):
             print(f"{rule.id}  [{rule.family}]  {rule.summary}")
         return 0
+    if args.explain:
+        return _explain(args.explain)
 
     paths = args.paths or ["swarmdb_tpu"]
+    if args.prune_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        try:
+            return _prune_baseline(paths, target, args.write)
+        except (OSError, SyntaxError) as exc:
+            print(f"swarmlint: {exc}", file=sys.stderr)
+            return 2
     select = None
     if args.select:
         try:

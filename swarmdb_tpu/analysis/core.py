@@ -116,6 +116,23 @@ RULES: Dict[str, Rule] = {
         Rule("SWL301", "lock-discipline",
              "guarded attribute accessed outside a `with` on its declared "
              "lock/Condition"),
+        Rule("SWL302", "lock-discipline",
+             "lock-order inversion: two locks acquired in both orders "
+             "(directly or through the call graph) — a cycle in the "
+             "acquisition-order graph deadlocks under concurrency"),
+        Rule("SWL303", "lock-discipline",
+             "inferred guarded-by violation: a field accessed under one "
+             "lock at most sites is read/written without it elsewhere "
+             "(no annotation needed — the majority of sites IS the "
+             "declaration)"),
+        Rule("SWL304", "lock-discipline",
+             "blocking while holding: Condition.wait outside a while-"
+             "predicate loop, or a blocking call (socket/join/file/"
+             "device_get/sleep) made while a lock is held in hot code"),
+        Rule("SWL305", "lock-discipline",
+             "stored hook/callback attribute invoked while holding a "
+             "lock — re-entrant callbacks can re-acquire (deadlock) or "
+             "observe half-updated state"),
         Rule("SWL401", "tracer-leak",
              "store to self/global/nonlocal from inside a traced (jit/"
              "shard_map/scan) function leaks a tracer"),
@@ -485,12 +502,18 @@ DEFAULT_BASELINE = os.path.join("analysis", "baseline.json")
 
 
 def load_baseline(path: str) -> Set[str]:
+    return {e["fingerprint"] for e in load_baseline_entries(path)}
+
+
+def load_baseline_entries(path: str) -> List[Dict[str, object]]:
+    """Full baseline entries (path/line/rule/fingerprint) — the prune
+    machinery needs more than the fingerprint set."""
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     if data.get("version") != BASELINE_VERSION:
         raise ValueError(f"unsupported baseline version in {path}: "
                          f"{data.get('version')!r}")
-    return {entry["fingerprint"] for entry in data.get("findings", [])}
+    return list(data.get("findings", []))
 
 
 def write_baseline(path: str, findings: Sequence[Finding]) -> None:
@@ -527,40 +550,67 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
     return files
 
 
-def analyze_file(path: str, select: Optional[Set[str]] = None,
-                 text: Optional[str] = None) -> List[Finding]:
-    from . import heartbeat, hostsync, locks, recompile, retry, spans, \
-        tracers
-
+def _parse_source(path: str, text: Optional[str] = None) -> SourceFile:
     try:
-        src = SourceFile(path, text=text)
+        return SourceFile(path, text=text)
     except SyntaxError as exc:
         if exc.filename:  # ast.parse errors already carry the path
             raise
         raise SyntaxError(f"{path}: {exc}") from None
+
+
+def _per_file_findings(src: SourceFile) -> List[Finding]:
+    from . import heartbeat, hostsync, locks, recompile, retry, spans, \
+        tracers
+
     findings: List[Finding] = []
     for checker in (hostsync.check, recompile.check, locks.check,
                     tracers.check, spans.check, heartbeat.check,
                     retry.check):
         findings.extend(checker(src))
+    return findings
+
+
+def _finalize(findings: List[Finding], srcs: Sequence[SourceFile],
+              select: Optional[Set[str]]) -> List[Finding]:
+    by_path = {os.path.normpath(s.path).replace(os.sep, "/"): s
+               for s in srcs}
     out = []
     seen = set()
     for f in findings:
-        key = (f.rule, f.line, f.col, f.message)
+        key = (f.rule, f.path, f.line, f.col, f.message)
         if key in seen:  # e.g. a scan body nested in a jitted fn
             continue
         seen.add(key)
         if select is not None and f.rule not in select:
             continue
-        if src.suppressed(f.rule, f.line):
+        src = by_path.get(f.path)
+        if src is not None and src.suppressed(f.rule, f.line):
             continue
         out.append(f)
     return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
+def analyze_file(path: str, select: Optional[Set[str]] = None,
+                 text: Optional[str] = None) -> List[Finding]:
+    from . import lockorder
+
+    src = _parse_source(path, text=text)
+    findings = _per_file_findings(src)
+    findings.extend(lockorder.check_project([src]))
+    return _finalize(findings, [src], select)
+
+
 def analyze_paths(paths: Sequence[str],
                   select: Optional[Set[str]] = None) -> List[Finding]:
+    """Per-file checks on every file, then the project-level lock pass
+    (lockorder.py) over ALL files as one program — the interprocedural
+    SWL302 edges only exist when the whole set is visible."""
+    from . import lockorder
+
+    srcs = [_parse_source(p) for p in iter_py_files(paths)]
     findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        findings.extend(analyze_file(path, select=select))
-    return findings
+    for src in srcs:
+        findings.extend(_per_file_findings(src))
+    findings.extend(lockorder.check_project(srcs))
+    return _finalize(findings, srcs, select)

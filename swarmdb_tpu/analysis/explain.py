@@ -1,0 +1,275 @@
+"""``--explain SWL<code>``: per-rule doc + a minimal bad/good pair.
+
+The fixtures under tests/fixtures/lint/ are the *executable* versions
+of these examples; the snippets here are deliberately smaller — just
+enough to recognize the shape in a code review. Keep each entry to the
+one-hazard core: the CLI prints it verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["EXPLAIN"]
+
+#: rule id -> {doc, bad, good}
+EXPLAIN: Dict[str, Dict[str, str]] = {
+    "SWL101": {
+        "doc": "Explicit host syncs (jax.device_get / block_until_ready) "
+               "in `# swarmlint: hot` code stall the device pipeline; "
+               "the engine's contract is <=3 syncs per request, not one "
+               "per step. Declared per-request drains use "
+               "`# swarmlint: sanctioned-drain`.",
+        "bad": "# swarmlint: hot\n"
+               "def step(self, logits):\n"
+               "    return jax.device_get(logits)  # sync per step",
+        "good": "# swarmlint: hot\n"
+                "def step(self, logits):\n"
+                "    self._pending.append(logits)  # drain once per request",
+    },
+    "SWL102": {
+        "doc": "Host materialization (.item(), np.asarray, device_put "
+               "round-trips) in hot code is an implicit sync — same cost "
+               "as SWL101 with less visibility.",
+        "bad": "# swarmlint: hot\n"
+               "def pick(self, scores):\n"
+               "    return int(scores.max().item())",
+        "good": "# swarmlint: hot\n"
+                "def pick(self, scores):\n"
+                "    return jnp.argmax(scores)  # stays on device",
+    },
+    "SWL105": {
+        "doc": "A host sync INSIDE A LOOP in hot code is a per-iteration "
+               "sync — the `sanctioned-drain` marker only covers "
+               "straight-line per-request drains, never loops.",
+        "bad": "# swarmlint: hot\n"
+               "def drain(self, chunks):\n"
+               "    for c in chunks:\n"
+               "        jax.block_until_ready(c)",
+        "good": "# swarmlint: hot\n"
+                "def drain(self, chunks):\n"
+                "    # swarmlint: sanctioned-drain -- one sync per request\n"
+                "    jax.block_until_ready(chunks)",
+    },
+    "SWL201": {
+        "doc": "jax.jit called inside a loop or hot function builds a "
+               "fresh wrapper (and a compile-cache miss) per call.",
+        "bad": "for batch in batches:\n"
+               "    out = jax.jit(forward)(params, batch)",
+        "good": "fwd = jax.jit(forward)  # module/init scope\n"
+                "for batch in batches:\n"
+                "    out = fwd(params, batch)",
+    },
+    "SWL202": {
+        "doc": "A per-call-varying static argument (f-string, len(), "
+               "dict display) to a jit-wrapped callable recompiles on "
+               "every distinct value.",
+        "bad": "out = jitted(x, tag=f\"req-{rid}\")",
+        "good": "out = jitted(x)  # identity travels outside the trace",
+    },
+    "SWL203": {
+        "doc": "A jit entry point not reachable from the class's warmup "
+               "call plan pays its cold compile on first real traffic.",
+        "bad": "self._extract = jax.jit(extract)  # never in warmup_call_plan",
+        "good": "warmup_call_plan() enumerates every jit entry point once",
+    },
+    "SWL204": {
+        "doc": "A len()-shaped host array reaching a jit-wrapped callable "
+               "makes every distinct count a fresh traced shape — a "
+               "compile mine.",
+        "bad": "idx = np.arange(len(reqs)); out = jitted(x, idx)",
+        "good": "idx = np.arange(BUCKET)  # padded to a fixed bucket\n"
+                "out = jitted(x, idx)",
+    },
+    "SWL205": {
+        "doc": "In hot kernel-dispatch code, a dispatch shape derived "
+               "from descriptor len()/.shape math explodes the variant "
+               "count; widths must come off the quantized ladder.",
+        "bad": "width = sum(r.len for r in rows)  # data-derived shape\n"
+               "out = kernel(stream[:width])",
+        "good": "width = ladder_fit(sum(r.len for r in rows))\n"
+                "out = kernel(stream[:width])",
+    },
+    "SWL301": {
+        "doc": "A `guarded-by[...]`-declared attribute read or written "
+               "outside `with <guard>:`. Constructors are exempt "
+               "(construction happens-before sharing); nested defs "
+               "inherit the declaration but not any held lock.",
+        "bad": "# swarmlint: guarded-by[self._mu]: _queue\n"
+               "def size(self):\n"
+               "    return len(self._queue)",
+        "good": "def size(self):\n"
+                "    with self._mu:\n"
+                "        return len(self._queue)",
+    },
+    "SWL302": {
+        "doc": "Lock-order inversion: the interprocedural acquisition "
+               "graph (with/acquire nesting propagated through calls) "
+               "contains a cycle — two threads taking the locks in "
+               "opposite orders deadlock. Each edge in the cycle is a "
+               "finding; the message prints both witness paths. The "
+               "runtime twin is SWARMDB_LOCKCHECK=1 (obs/lockcheck.py).",
+        "bad": "def alloc(self):\n"
+               "    with self._a:\n"
+               "        self._count()   # _count takes self._b\n"
+               "def report(self):\n"
+               "    with self._b:\n"
+               "        with self._a: ...",
+        "good": "def report(self):\n"
+                "    with self._a:      # same order everywhere\n"
+                "        with self._b: ...",
+    },
+    "SWL303": {
+        "doc": "Inferred guarded-by (RacerD-style): a self-attribute "
+               "accessed under one lock at >= 3 sites (a strict "
+               "majority, with at least one write) is inferred guarded; "
+               "the unguarded access elsewhere is the race. No "
+               "annotations needed — a `guarded-by[...]` declaration "
+               "moves the field to SWL301.",
+        "bad": "def add(self, k, v):\n"
+               "    with self._mu: self._items[k] = v\n"
+               "def size(self):\n"
+               "    return len(self._items)  # raced",
+        "good": "def size(self):\n"
+                "    with self._mu:\n"
+                "        return len(self._items)",
+    },
+    "SWL304": {
+        "doc": "Blocking while holding: (a) Condition.wait whose "
+               "predicate is not re-checked in a `while` loop — a "
+               "spurious wakeup or stale notify returns with the "
+               "predicate false; (b) in hot code, a blocking call "
+               "(socket ops, join, sleep, device_get, open) while any "
+               "lock is held — every queued thread inherits the stall.",
+        "bad": "with cv:\n"
+               "    if not ready():\n"
+               "        cv.wait(timeout)\n"
+               "    consume()",
+        "good": "with cv:\n"
+                "    while not ready():\n"
+                "        cv.wait(remaining())\n"
+                "    consume()",
+    },
+    "SWL305": {
+        "doc": "A stored hook/callback attribute (Callable field, attr "
+               "bound from a constructor arg or lambda, hook/handler "
+               "name) invoked while holding a lock: a re-entrant "
+               "callback can call back in and re-acquire (deadlock on a "
+               "plain Lock) or observe half-updated state. Snapshot "
+               "under the lock, invoke outside it.",
+        "bad": "with self._mu:\n"
+               "    self._seq += 1\n"
+               "    self._on_chunk(self._seq, tok)",
+        "good": "with self._mu:\n"
+                "    self._seq += 1\n"
+                "    seq = self._seq\n"
+                "self._on_chunk(seq, tok)",
+    },
+    "SWL401": {
+        "doc": "A store to self/global/nonlocal from inside a traced "
+               "(jit/shard_map/scan) function leaks a tracer object "
+               "into untraced state.",
+        "bad": "@jax.jit\n"
+               "def step(self, x):\n"
+               "    self.last = x  # tracer leak\n"
+               "    return x * 2",
+        "good": "@jax.jit\n"
+                "def step(self, x):\n"
+                "    return x * 2  # state travels via returns",
+    },
+    "SWL501": {
+        "doc": "span_begin without any span_end in the function (or a "
+               "discarded stamp) silently drops the span.",
+        "bad": "t = TRACER.span_begin()\n"
+               "do_work()  # never ended",
+        "good": "t = TRACER.span_begin()\n"
+                "do_work()\n"
+                "TRACER.span_end(\"work\", t)",
+    },
+    "SWL502": {
+        "doc": "The allocating span(...) context manager inside a hot "
+               "function; hot paths use the span_begin/span_end ring "
+               "writes.",
+        "bad": "# swarmlint: hot\n"
+               "def step(self):\n"
+               "    with TRACER.span(\"step\"): ...",
+        "good": "# swarmlint: hot\n"
+                "def step(self):\n"
+                "    t = TRACER.span_begin()\n"
+                "    ...\n"
+                "    TRACER.span_end(\"step\", t)",
+    },
+    "SWL503": {
+        "doc": "A histogram allocated or looked up per observation in "
+               "hot code; bind it once, observe through the bound "
+               "object.",
+        "bad": "# swarmlint: hot\n"
+               "def record(self, dt):\n"
+               "    HISTOGRAMS.get(\"ttft\").observe(dt)",
+        "good": "self._ttft = HISTOGRAMS.register(\"ttft\", ...)  # init\n"
+                "# swarmlint: hot\n"
+                "def record(self, dt):\n"
+                "    self._ttft.observe(dt)",
+    },
+    "SWL504": {
+        "doc": "Per-observation allocation (dict/list/str construction, "
+               "comprehension, f-string) in hot exemplar/sentinel "
+               "record-path code; retention must be an in-place slot "
+               "write.",
+        "bad": "def observe(self, v, rid):\n"
+               "    self._ex[bucket] = {\"rid\": rid, \"v\": v}",
+        "good": "def observe(self, v, rid):\n"
+                "    self._ex_rids[bucket] = rid\n"
+                "    self._ex_vals[bucket] = v",
+    },
+    "SWL601": {
+        "doc": "A blocking call inside `# swarmlint: heartbeat` code: a "
+               "stalled failure-detector evaluation reads as a dead "
+               "peer and triggers false-positive failover.",
+        "bad": "# swarmlint: heartbeat\n"
+               "def verdict(self):\n"
+               "    sock.connect(addr)  # detector blocks on I/O",
+        "good": "# swarmlint: heartbeat\n"
+                "def verdict(self):\n"
+                "    return now - self._last_beat > self.suspect_s",
+    },
+    "SWL602": {
+        "doc": "Lock acquisition inside `# swarmlint: heartbeat` code: "
+               "a writer holding the lock stalls the verdict.",
+        "bad": "# swarmlint: heartbeat\n"
+               "def verdict(self):\n"
+               "    with self._mu:\n"
+               "        return self._state",
+        "good": "# swarmlint: heartbeat\n"
+                "def verdict(self):\n"
+                "    return self._state  # single-writer float slot",
+    },
+    "SWL603": {
+        "doc": "A partition-log append in `# swarmlint: ha` code with no "
+               "epoch-fence check before the write: a deposed leader's "
+               "unfenced append forks the replicated log.",
+        "bad": "# swarmlint: ha\n"
+               "def append(self, topic, part, rec):\n"
+               "    self._log.append(topic, part, rec)",
+        "good": "# swarmlint: ha\n"
+                "def append(self, topic, part, rec):\n"
+                "    self._check_fence(topic, part)\n"
+                "    self._log.append(topic, part, rec)",
+    },
+    "SWL701": {
+        "doc": "A retry loop in `# swarmlint: retry` code must carry a "
+               "bound, a backoff, and a deadline check — otherwise one "
+               "failure becomes a retry storm and a hung dependency a "
+               "hung caller.",
+        "bad": "# swarmlint: retry\n"
+               "def fetch(self):\n"
+               "    while True:\n"
+               "        if self._try(): return",
+        "good": "# swarmlint: retry\n"
+                "def fetch(self):\n"
+                "    for i in range(self.retries):\n"
+                "        if time.time() > deadline: break\n"
+                "        if self._try(): return\n"
+                "        time.sleep(backoff * 2 ** i)",
+    },
+}

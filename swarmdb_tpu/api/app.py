@@ -37,6 +37,7 @@ from ..core.messages import MessageStatus
 from ..core.runtime import SwarmDB
 from ..obs import HISTOGRAMS, TRACER, propagate
 from ..utils import jwt as jwt_util
+from ..utils.sync import lockcheck_enabled
 from . import schemas
 
 logger = logging.getLogger("swarmdb_tpu.api")
@@ -670,6 +671,15 @@ def create_app(
         supervisor = getattr(serving, "supervisor", None)
         if supervisor is not None:
             lines.extend(await _run_sync(supervisor.prometheus_lines))
+        # runtime lock sanitizer (ISSUE 12, SWARMDB_LOCKCHECK=1):
+        # per-site contended-acquire and cumulative-hold counters for
+        # the top SWARMDB_LOCKCHECK_TOPN sites, plus the inversion-
+        # cycle count (>0 is a pager line: a detected deadlock order)
+        if lockcheck_enabled():
+            from ..obs import lockcheck
+
+            lines.extend(await _run_sync(
+                lockcheck.registry().prometheus_lines))
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -868,6 +878,22 @@ def create_app(
             return web.json_response(flight.last_dump)
         return web.json_response(await _run_sync(flight.dump))
 
+    async def admin_lockcheck(request: web.Request) -> web.Response:
+        """GET /admin/lockcheck — the runtime lock sanitizer's full
+        report (SWARMDB_LOCKCHECK=1): per-site acquire/contention/hold
+        stats, the observed acquisition-order edges (site pair, thread,
+        first-observation stack), and any inversion cycles. 503 with
+        the flag off — an empty report would read as "no deadlock
+        orders" when nothing was watching."""
+        require_admin(current_agent(request))
+        if not lockcheck_enabled():
+            raise _error(503, "lock sanitizer off — set "
+                              "SWARMDB_LOCKCHECK=1")
+        from ..obs import lockcheck
+
+        return web.json_response(
+            await _run_sync(lockcheck.registry().report))
+
     async def admin_lanes(request: web.Request) -> web.Response:
         """GET /admin/lanes — the lane supervisor's full status: per-lane
         state machine (alive/suspect/quarantined), beat ages, quarantine
@@ -1050,6 +1076,7 @@ def create_app(
         web.get("/admin/slo", admin_slo),
         web.get("/admin/ha", admin_ha),
         web.get("/admin/lanes", admin_lanes),
+        web.get("/admin/lockcheck", admin_lockcheck),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..obs.flight import FlightRecorder
 from ..ha.chaos import wait_until
 from .engine import Engine
+from ..utils.sync import make_lock
 
 __all__ = ["LaneKilled", "ServingChaos", "wait_until"]
 
@@ -92,7 +93,7 @@ class ServingChaos:
         self.flight = flight if flight is not None else getattr(
             engine_or_group, "flight", None) or FlightRecorder()
         self.events: List[Dict[str, Any]] = []
-        self._events_lock = threading.Lock()
+        self._events_lock = make_lock("backend.chaos.ServingChaos._events_lock")
         self._timers: List[threading.Timer] = []
         self._t0 = time.monotonic()
         self._reserved: Dict[int, List[int]] = {}

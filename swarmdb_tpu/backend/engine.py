@@ -59,6 +59,7 @@ from jax.experimental import io_callback
 from ..obs import TRACER, FlightRecorder
 from ..obs.metrics import (HIST_DECODE_CHUNK, HIST_QUEUE_WAIT, HIST_TTFT)
 from ..utils.metrics import MetricsRegistry
+from ..utils.sync import make_condition
 from .sampling import (SamplingParams, make_slot_keys,
                        sample_tokens, token_logprob)
 
@@ -413,7 +414,7 @@ class Engine:
         self._admitting: set = set()
         self._cancel_pending: set = set()
         self._tiebreak = itertools.count()
-        self._cv = threading.Condition()
+        self._cv = make_condition("backend.engine.Engine._cv")
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         # low-memory hook (ADVICE r4 medium #1): invoked (need_pages) from

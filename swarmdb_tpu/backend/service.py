@@ -40,6 +40,7 @@ from ..utils.hashing import stable_partition
 from .engine import Engine, GenRequest, PagedKV
 from .sampling import SamplingParams
 from .tokenizer import Tokenizer, default_tokenizer
+from ..utils.sync import make_lock
 
 logger = logging.getLogger("swarmdb_tpu.serving")
 
@@ -379,7 +380,7 @@ class ServingService:
         # baseline because the reply's KV is the model's own continuation
         # rather than a re-tokenization of its text.
         self._rolling: Optional[Dict[Tuple[str, str], Dict[str, Any]]] = None
-        self._rolling_lock = threading.Lock()
+        self._rolling_lock = make_lock("backend.service.ServingService._rolling_lock")
         # EMA of per-turn suffix length (tokens), sizing the restart
         # reserve (see _rolling_plan / serve_message keep-trim). Seeded
         # relative to the window: an absolute seed larger than a small
@@ -390,7 +391,7 @@ class ServingService:
         # first budget overflow and immutable after. Insertion order is
         # the LRU order for the size cap.
         self._anchors: Dict[Tuple[str, str], List[int]] = {}
-        self._anchor_lock = threading.Lock()
+        self._anchor_lock = make_lock("backend.service.ServingService._anchor_lock")
         self._anchor_cap = _env_int("SWARMDB_ANCHOR_MAX", 4096)
         # fixed elision marker between head and tail — constant tokens, so
         # it can never destabilize the prefix
@@ -575,6 +576,7 @@ class ServingService:
         with self._rolling_lock:
             self._rolling_evict(need)
 
+    # swarmlint: holds[self._rolling_lock]
     def _rolling_evict(self, need_free: int) -> None:
         """LRU-evict idle conversations until the pool can cover
         ``need_free`` pages (caller holds _rolling_lock)."""
@@ -980,7 +982,11 @@ class ServingService:
                     # the measured deltas say the window fits barely one
                     # turn
                     frac = _env_float("SWARMDB_ROLL_RESTART", 0.5)
-                    reserve = (int(2.5 * self._rolling_delta_ema)
+                    # EMA is written under _rolling_lock (_rolling_plan);
+                    # read it under the same lock (swarmlint SWL303)
+                    with self._rolling_lock:
+                        delta_ema = self._rolling_delta_ema
+                    reserve = (int(2.5 * delta_ema)
                                + self.engine.decode_chunk)
                     budget = max(16, min(
                         int(budget * min(0.9, max(0.1, frac))),
@@ -1102,7 +1108,7 @@ class ServingService:
         if base_seed is None and sampling.temperature > 0:
             base_seed = int.from_bytes(os.urandom(8), "little")
         results: Dict[int, Tuple[List[int], str, Optional[List[float]]]] = {}
-        lock = threading.Lock()
+        lock = make_lock("backend.service.ServingService._serve_n.lock")
 
         def mk_done(idx: int, reqs: List[GenRequest]):
             def _done_i(rid: str, tokens: List[int], reason: str) -> None:

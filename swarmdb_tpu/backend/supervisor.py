@@ -64,6 +64,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..obs import TRACER, FlightRecorder
 from ..utils.metrics import MetricsRegistry
 from .engine import Engine, GenRequest, is_retryable_reason
+from ..utils.sync import make_lock
 
 logger = logging.getLogger("swarmdb_tpu.supervisor")
 
@@ -134,7 +135,7 @@ class _Tracked:
         self.deadline = request.deadline
         self.retried = 0
         self.migrated = 0
-        self.lock = threading.Lock()
+        self.lock = make_lock("backend.supervisor._Tracked.lock")
         self.retry_timer: Optional[threading.Timer] = None
 
     @property
@@ -201,7 +202,7 @@ class LaneSupervisor:
             _LaneHealth() for _ in self.lanes]
         # swarmlint: guarded-by[self._lock]: _tracked
         self._tracked: Dict[str, _Tracked] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("backend.supervisor.LaneSupervisor._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._prev_retried = 0

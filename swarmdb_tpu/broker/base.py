@@ -30,6 +30,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from ..utils.sync import make_lock
 
 
 @dataclass(frozen=True)
@@ -219,12 +220,12 @@ class Producer:
         self._broker = broker
         self._pending: List[Tuple[DeliveryCallback, Optional[str], Record]] = []
         # swarmlint: guarded-by[self._pending_lock]: _pending
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock("broker.base.Producer._pending_lock")
         # serializes whole poll() invocations: two concurrent pollers (the
         # runtime's delivery-poll thread + send_message's inline poll) could
         # otherwise swap out separate batches and fire per-partition
         # callbacks out of order
-        self._poll_lock = threading.Lock()
+        self._poll_lock = make_lock("broker.base.Producer._poll_lock")
 
     def produce(
         self,

@@ -23,13 +23,14 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .base import Broker, BrokerError, Record, TopicMeta, UnknownTopicError
+from ..utils.sync import make_condition, make_lock
 
 
 class _Partition:
     __slots__ = ("cond", "records", "base_offset")
 
     def __init__(self) -> None:
-        self.cond = threading.Condition()
+        self.cond = make_condition("broker.local._Partition.cond")
         self.records: List[Record] = []
         self.base_offset = 0  # offset of records[0]; grows as retention trims
 
@@ -48,7 +49,7 @@ class LocalBroker(Broker):
         self._topics: Dict[str, TopicMeta] = {}
         self._parts: Dict[Tuple[str, int], _Partition] = {}
         self._offsets: Dict[Tuple[str, str, int], int] = {}  # (group, topic, part)
-        self._meta_lock = threading.Lock()
+        self._meta_lock = make_lock("broker.local.LocalBroker._meta_lock")
         self._snapshot_path = snapshot_path
         # durability watermark per (topic, partition): end offsets captured by
         # the last snapshot. Only meaningful in snapshot mode — pure in-memory
@@ -58,7 +59,7 @@ class LocalBroker(Broker):
         self._last_snapshot = 0.0
         # serializes snapshot writes: concurrent flush() callers (delivery
         # poller + explicit flush) share one fixed tmp path
-        self._snap_lock = threading.Lock()
+        self._snap_lock = make_lock("broker.local.LocalBroker._snap_lock")
         if snapshot_path and os.path.exists(snapshot_path):
             self._restore(snapshot_path)
 
@@ -93,9 +94,15 @@ class LocalBroker(Broker):
     # -- data plane ----------------------------------------------------------
 
     def _part(self, topic: str, partition: int) -> _Partition:
-        part = self._parts.get((topic, partition))
+        # under _meta_lock (swarmlint SWL303): an unguarded lookup racing
+        # create_topic could observe the topic registered but its
+        # partitions not yet built and mis-report "partition out of
+        # range" for a topic that is coming up fine
+        with self._meta_lock:
+            part = self._parts.get((topic, partition))
+            in_topics = topic in self._topics
         if part is None:
-            if topic not in self._topics:
+            if not in_topics:
                 raise UnknownTopicError(topic)
             raise BrokerError(f"partition {partition} out of range for topic {topic!r}")
         return part
@@ -140,11 +147,18 @@ class LocalBroker(Broker):
         self, topic: str, partition: int, offset: int, timeout_s: float
     ) -> bool:
         part = self._part(topic, partition)
+        deadline = time.time() + timeout_s
         with part.cond:
-            if part.end_offset() > offset:
-                return True
-            part.cond.wait(timeout_s)
-            return part.end_offset() > offset
+            # predicate re-checked in a while loop (swarmlint SWL304):
+            # the single-wait shape returned early on any spurious
+            # wakeup or a notify for an already-consumed append,
+            # degrading the long-poll into a busy poll
+            while part.end_offset() <= offset:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                part.cond.wait(left)
+            return True
 
     # -- consumer-group offsets ---------------------------------------------
 
@@ -289,8 +303,8 @@ class LocalBroker(Broker):
                 )
                 for r in pdata["records"]
             ]
-        for group, topic, pnum, off in state.get("offsets", []):
-            self._offsets[(group, topic, pnum)] = off
         with self._meta_lock:
+            for group, topic, pnum, off in state.get("offsets", []):
+                self._offsets[(group, topic, pnum)] = off
             for (topic, p), part in self._parts.items():
                 self._snap_ends[(topic, p)] = part.end_offset()

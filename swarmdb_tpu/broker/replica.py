@@ -129,6 +129,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..obs import TRACER, propagate
 from ..obs.metrics import HIST_REPLICATION_COMMIT
 from .base import Broker, BrokerError, FencedError, Record, TopicMeta
+from ..utils.sync import make_condition, make_lock
 
 logger = logging.getLogger("swarmdb_tpu.replica")
 
@@ -301,7 +302,7 @@ class ReplicaServer:
         # ends, so two leaders can never interleave appends into the
         # mirror); a connection with a LOWER epoch than the highest ever
         # seen is refused outright with an F frame (fencing).
-        self._conn_lock = threading.Lock()
+        self._conn_lock = make_lock("broker.replica.ReplicaServer._conn_lock")
         # swarmlint: guarded-by[self._conn_lock]: _active_conn, _conn_epochs, _highest_epoch, _tp_epochs, _tp_owner
         self._active_conn: Optional[socket.socket] = None
         self._conn_epochs: Dict[int, int] = {}  # id(conn) -> epoch
@@ -355,11 +356,16 @@ class ReplicaServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # snapshot under the lock (swarmlint SWL303): a connection
+        # registering concurrently must either be in the snapshot (and
+        # get shut down here) or observe _stop and exit on its own
+        with self._conn_lock:
+            conns = list(self._conns)
         # shutdown() BEFORE close(): a thread parked in accept()/recv()
         # holds the open file description, so close() alone leaves the
         # socket alive (and the port LISTENING) until that syscall
         # returns — shutdown wakes it
-        for sock in [self._listener] + self._conns:
+        for sock in [self._listener] + conns:
             for op in (lambda s=sock: s.shutdown(socket.SHUT_RDWR),
                        sock.close):
                 try:
@@ -432,12 +438,12 @@ class ReplicaServer:
         # swarmlint: guarded-by[lock]: appended
         appended: Dict[Tuple[str, int], int] = {}
         acked: Dict[Tuple[str, int], int] = {}
-        lock = threading.Lock()
+        lock = make_lock("broker.replica.ReplicaServer._serve.lock")
         done = threading.Event()
         # the follower->leader channel is written by TWO threads in
         # partition mode (ack_loop's A frames, this thread's N fences):
         # serialize sends so frames never interleave mid-payload
-        send_lock = threading.Lock()
+        send_lock = make_lock("broker.replica.ReplicaServer._serve.send_lock")
         peer_id: List[Optional[str]] = [None]  # from the I frame
         refused_tps: set = set()  # tps already N-fenced on this conn
 
@@ -790,7 +796,7 @@ class Replicator:
         # control frames queued while streaming; bounded because the
         # reconnect snapshot supersedes anything dropped here
         # swarmlint: guarded-by[self._ctrl_lock]: _ctrl, _last_trace
-        self._ctrl_lock = threading.Lock()
+        self._ctrl_lock = make_lock("broker.replica.Replicator._ctrl_lock")
         self._ctrl: collections.deque = collections.deque(maxlen=4096)
         self._last_trace: Optional[Dict] = None  # G-frame dedup
         # tp -> follower durable end, written by recv_acks / clamped at
@@ -805,7 +811,7 @@ class Replicator:
         self._started_at = time.time()
         self.gapped: set = set()
         self.connected = threading.Event()
-        self._cv = threading.Condition()
+        self._cv = make_condition("broker.replica.Replicator._cv")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -1204,7 +1210,7 @@ class ReplicatedBroker(Broker):
         # full on every follower (re)connect — the Broker ABC has no
         # enumeration API, so the leader is the source of truth here
         # swarmlint: guarded-by[self._ctrl_state_lock]: _commits, _trims
-        self._ctrl_state_lock = threading.Lock()
+        self._ctrl_state_lock = make_lock("broker.replica.ReplicatedBroker._ctrl_state_lock")
         self._commits: Dict[Tuple[str, str, int], int] = {}
         self._trims: Dict[str, float] = {}
         # explicit deposal (the HA watch loop saw a higher epoch in the

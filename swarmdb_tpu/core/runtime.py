@@ -40,6 +40,7 @@ from ..obs.metrics import HIST_PUBLISH
 from ..obs.sentinel import SLOSentinel
 from ..utils.hashing import stable_partition
 from ..utils.metrics import MetricsRegistry
+from ..utils.sync import make_rlock
 from .messages import (
     BrokerConfig,
     Message,
@@ -154,7 +155,7 @@ class SwarmDB:
         self.producer = Producer(self.broker)
         self._ensure_topics_exist()
 
-        self._lock = threading.RLock()
+        self._lock = make_rlock("core.runtime.SwarmDB._lock")
         # swarmlint: guarded-by[self._lock]: registered_agents, messages, agent_inbox, _conversations, message_count, _stats_by_type, _stats_by_status, _stats_by_agent
         self.registered_agents: Set[str] = set()
         self.consumers: Dict[str, Consumer] = {}
@@ -521,7 +522,10 @@ class SwarmDB:
         (reference ` main.py:521-601`). Bounded by ``max_messages`` and
         wall-clock ``timeout``; marks received messages READ."""
         self.register_agent(agent_id)
-        consumer = self.consumers[agent_id]
+        # consumers is maintained under _lock everywhere else; an
+        # unguarded read races a concurrent deregister (swarmlint SWL303)
+        with self._lock:
+            consumer = self.consumers[agent_id]
         t_recv = TRACER.span_begin()
         out: List[Message] = []
         deadline = time.time() + timeout

@@ -41,6 +41,7 @@ from ..obs.flight import FlightRecorder
 from .client import ClusterBroker
 from .cluster import InMemoryClusterMap
 from .node import HANode
+from ..utils.sync import make_lock
 
 __all__ = ["ChaosHarness", "build_local_cluster", "wait_until"]
 
@@ -114,7 +115,7 @@ class ChaosHarness:
         self.nodes: Dict[str, HANode] = {}
         self.flight = flight or FlightRecorder()
         self.events: List[Dict[str, Any]] = []
-        self._events_lock = threading.Lock()
+        self._events_lock = make_lock("ha.chaos.ChaosHarness._events_lock")
         self._timers: List[threading.Timer] = []
         self._t0 = time.monotonic()
 
@@ -168,7 +169,7 @@ class ChaosHarness:
         ranked_at = int(a.get("epoch", 0))
         start = threading.Barrier(len(live))
         winners: List[str] = []
-        winners_lock = threading.Lock()
+        winners_lock = make_lock("ha.chaos.ChaosHarness.duel_promotion.winners_lock")
 
         def race(nid: str) -> None:
             start.wait()

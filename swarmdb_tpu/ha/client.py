@@ -59,6 +59,7 @@ from ..broker.base import (Broker, BrokerError, FencedError,
                            UnknownTopicError)
 from ..obs import TRACER, propagate
 from .cluster import ClusterMap
+from ..utils.sync import make_rlock
 
 logger = logging.getLogger("swarmdb_tpu.ha")
 
@@ -95,7 +96,7 @@ class ClusterBroker(Broker):
         # owns_inner=False for in-process clusters where the inner broker
         # belongs to an HANode (closing it would kill the node)
         self._owns_inner = owns_inner
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ha.client.ClusterBroker._lock")
         # swarmlint: guarded-by[self._lock]: _inner, _leader_id, _leader_epoch, _next_check, _assignments, _nodes, _opened
         self._inner: Optional[Broker] = None
         self._leader_id: Optional[str] = None

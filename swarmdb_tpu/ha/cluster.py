@@ -45,6 +45,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, Optional
 
 from ..broker.replica import read_log_epoch, persist_epoch  # noqa: F401  (re-export)
+from ..utils.sync import make_lock
 
 __all__ = ["NodeInfo", "ClusterMap", "InMemoryClusterMap", "FileClusterMap",
            "read_log_epoch", "persist_epoch", "tp_key", "parse_tp_key"]
@@ -143,7 +144,7 @@ class ClusterMap:
 class InMemoryClusterMap(ClusterMap):
     def __init__(self) -> None:
         # swarmlint: guarded-by[self._lock]: _state
-        self._lock = threading.Lock()
+        self._lock = make_lock("ha.cluster.InMemoryClusterMap._lock")
         self._state = _empty_state()
 
     def read(self) -> Dict[str, Any]:

@@ -60,6 +60,7 @@ from ..broker.base import (Broker, BrokerError, FencedError,
                            UnknownTopicError)
 from ..obs import TRACER, propagate
 from ..obs.metrics import HIST_DATAPLANE_RTT
+from ..utils.sync import make_lock
 
 logger = logging.getLogger("swarmdb_tpu.ha")
 
@@ -149,7 +150,7 @@ class DataPlaneServer:
         self.host, self.port = self._listener.getsockname()
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("ha.dataplane.DataPlaneServer._conns_lock")
         # swarmlint: guarded-by[self._conns_lock]: _conns
         self._conns: List[socket.socket] = []
 
@@ -338,7 +339,7 @@ class RemoteBroker(Broker):
         self.addr = addr
         self._host, self._port = host or "127.0.0.1", int(port)
         self.timeout_s = timeout_s
-        self._pool_lock = threading.Lock()
+        self._pool_lock = make_lock("ha.dataplane.RemoteBroker._pool_lock")
         # swarmlint: guarded-by[self._pool_lock]: _pool, _closed
         self._pool: List[socket.socket] = []
         self._closed = False

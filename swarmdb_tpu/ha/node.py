@@ -89,6 +89,7 @@ from .cluster import ClusterMap, NodeInfo, parse_tp_key, tp_key
 from .detector import (DetectorState, FailureDetector, LivenessServer,
                        dead_s_default, probe_ends, probe_liveness,
                        suspect_s_default)
+from ..utils.sync import make_lock, make_rlock
 from .partition import (PartitionReplicatedBroker, is_internal_topic,
                         partition_leadership_default, spread_moves_default,
                         spread_score)
@@ -142,7 +143,7 @@ class HANode:
         self.flight.meta.setdefault("node_id", node_id)
         self.log_dir = log_dir
 
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ha.node.HANode._lock")
         # swarmlint: guarded-by[self._lock]: _role, _epoch, _leader_broker
         self._role = "follower"
         self._epoch = read_log_epoch(broker)
@@ -165,7 +166,7 @@ class HANode:
         # partition-level leadership (ISSUE 10)
         self._pbroker: Optional[PartitionReplicatedBroker] = None
         # swarmlint: guarded-by[self._peers_lock]: _peer_detectors
-        self._peers_lock = threading.Lock()
+        self._peers_lock = make_lock("ha.node.HANode._peers_lock")
         self._peer_detectors: Dict[str, FailureDetector] = {}
         self._sweeping = threading.Event()  # one orphan sweep at a time
         self._shed_tick = 0

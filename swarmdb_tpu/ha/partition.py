@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from ..broker.base import Broker, BrokerError, FencedError
 from ..broker.replica import Replicator
 from ..obs import propagate
+from ..utils.sync import make_lock
 
 __all__ = ["PartitionLeases", "PartitionReplicatedBroker",
            "partition_leadership_default", "spread_moves_default",
@@ -88,7 +89,7 @@ class PartitionLeases:
 
     def __init__(self) -> None:
         # swarmlint: guarded-by[self._lock]: _leases, _fenced
-        self._lock = threading.Lock()
+        self._lock = make_lock("ha.partition.PartitionLeases._lock")
         self._leases: Dict[Tuple[str, int], int] = {}
         # tp -> highest epoch that fenced us (error messages carry it)
         self._fenced: Dict[Tuple[str, int], int] = {}
@@ -162,7 +163,7 @@ class PartitionReplicatedBroker(Broker):
         # so producers can route them one map-refresh later
         self._on_topic_created = on_topic_created
         # swarmlint: guarded-by[self._repl_lock]: _repls, _cluster_size
-        self._repl_lock = threading.Lock()
+        self._repl_lock = make_lock("ha.partition.PartitionReplicatedBroker._repl_lock")
         self._repls: Dict[str, Replicator] = {}  # replica_addr -> stream
         # registered replica-set size (self included): the quorum floor.
         # A node whose peers all vanished must NOT fall back to acking
@@ -172,7 +173,7 @@ class PartitionReplicatedBroker(Broker):
         # leader-side control metadata (latest-wins), re-sent in full on
         # every follower (re)connect — same contract as ReplicatedBroker
         # swarmlint: guarded-by[self._ctrl_state_lock]: _commits, _trims
-        self._ctrl_state_lock = threading.Lock()
+        self._ctrl_state_lock = make_lock("ha.partition.PartitionReplicatedBroker._ctrl_state_lock")
         self._commits: Dict[Tuple[str, str, int], int] = {}
         self._trims: Dict[str, float] = {}
 

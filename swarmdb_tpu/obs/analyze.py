@@ -59,7 +59,9 @@ CI lint job runs it; stdlib-only, no jax).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -392,6 +394,9 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
         "version": 1,
         "inputs": inputs,
     }
+    lockchecks = _lockcheck_dumps(paths)
+    if lockchecks:
+        report["lockcheck_dumps"] = lockchecks
     base_flight = flights[0][1] if flights else None
     test_flight = flights[-1][1] if flights else None
     if len(traces) >= 2:
@@ -408,6 +413,38 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
                              "flight": test_flight}
         report["diagnosis"] = _solo_diagnosis(summary, test_flight)
     return report
+
+
+def _lockcheck_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Lock-sanitizer dumps (``lockcheck_<node>.json``, ISSUE 12)
+    sitting next to the analyzed flight/trace files: the flight dump
+    says what the node was doing, the lockcheck dump says which lock
+    orders it exercised doing it — an inversion cycle here IS the
+    diagnosis. Listed with their cycle counts so a report reader never
+    has to know the files exist to notice a detected deadlock order."""
+    seen: set = set()
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        if d in seen:
+            continue
+        seen.add(d)
+        for cand in sorted(glob.glob(os.path.join(d,
+                                                  "lockcheck_*.json"))):
+            try:
+                with open(cand, "r", encoding="utf-8") as f:
+                    dump = json.load(f)
+            except (OSError, ValueError):
+                continue
+            cycles = dump.get("cycles") or []
+            out.append({
+                "path": cand,
+                "node": dump.get("node"),
+                "cycles": len(cycles),
+                "cycle_sites": [c.get("sites") for c in cycles],
+                "sites_tracked": len(dump.get("sites") or {}),
+            })
+    return out
 
 
 # --------------------------------------------------------------- self-check

@@ -43,6 +43,7 @@ import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
+from ..utils.sync import make_lock
 
 logger = logging.getLogger("swarmdb_tpu.obs")
 
@@ -102,12 +103,20 @@ class FlightRecorder:
         self._events = _DictRing(max(8, n_events))
         # events come from arbitrary threads (HA detector/promotion,
         # chaos) — rare, so a lock is fine HERE and only here
-        self._events_lock = threading.Lock()
+        self._events_lock = make_lock("obs.flight.FlightRecorder._events_lock")
         # free-form identity (mesh shape, shard count, model) set by the
         # engine builder; rides every dump
         self.meta: Dict[str, Any] = {}
         self.last_dump: Optional[Dict[str, Any]] = None
         self.last_dump_path: Optional[str] = None
+        # with the lock sanitizer on (SWARMDB_LOCKCHECK=1), inversion
+        # violations land in this event ring as `lockcheck.inversion`
+        # instants — every subsystem's recorder registers itself so the
+        # cycle shows up next to whatever the subsystem was doing
+        if os.environ.get("SWARMDB_LOCKCHECK", "0") not in ("", "0"):
+            from . import lockcheck
+
+            lockcheck.registry().attach_flight(self)
 
     # ---------------------------------------------------------- record path
 

@@ -56,6 +56,7 @@ import threading
 import time
 from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Tuple
+from ..utils.sync import make_lock
 
 __all__ = ["Histogram", "HistogramRegistry", "HISTOGRAMS",
            "LADDER_FAST", "LADDER_WIDE",
@@ -198,7 +199,7 @@ class HistogramRegistry:
     def __init__(self, enabled: Optional[bool] = None) -> None:
         if enabled is None:
             enabled = os.environ.get("SWARMDB_HISTOGRAMS", "1") != "0"
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metrics.HistogramRegistry._lock")
         # swarmlint: guarded-by[self._lock]: _hists
         self._hists: Dict[str, Histogram] = {}
         self.enabled = bool(enabled)

@@ -52,6 +52,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .metrics import HISTOGRAMS, HIST_QUEUE_WAIT, HIST_TTFT
+from ..utils.sync import make_lock
 
 logger = logging.getLogger("swarmdb_tpu.obs")
 
@@ -148,9 +149,9 @@ class SLOSentinel:
         self.alerts_total = 0
         # swarmlint: guarded-by[self._alerts_lock]: _alerts
         self._alerts: List[Dict[str, Any]] = []
-        self._alerts_lock = threading.Lock()
+        self._alerts_lock = make_lock("obs.sentinel.SLOSentinel._alerts_lock")
         self._warmup: List[Dict[str, Any]] = []
-        self._tick_lock = threading.Lock()  # single-closer election only
+        self._tick_lock = make_lock("obs.sentinel.SLOSentinel._tick_lock")  # single-closer election only
         self._deadline = time.monotonic() + self.config.window_s
         self._window_opened = time.time()
         self._prev_counters: Optional[Dict[str, int]] = None

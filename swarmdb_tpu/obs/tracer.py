@@ -39,6 +39,7 @@ import threading
 import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
+from ..utils.sync import make_lock
 
 __all__ = ["SpanTracer", "TRACER"]
 
@@ -112,7 +113,7 @@ class SpanTracer:
         # ring registry: (ring, weakref-to-owning-thread); mutated only
         # under _reg_lock (once per thread lifetime + resets)
         self._rings: List[Tuple[_Ring, "weakref.ref"]] = []
-        self._reg_lock = threading.Lock()
+        self._reg_lock = make_lock("obs.tracer.SpanTracer._reg_lock")
         self._local = threading.local()
         # clock anchor: monotonic <-> epoch, captured together once
         self._anchor_mono_ns = time.monotonic_ns()

@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..utils.sync import make_lock
 
 PagedCache = Dict[str, jnp.ndarray]  # {"k", "v", "page_table"}
 
@@ -262,7 +263,7 @@ class PageAllocator:
         self.num_pages = num_pages
         self._by_slot: Dict[int, _SlotPages] = {}
         self._pending_free: List[int] = []  # slot ids retired, not yet flushed
-        self._lock = threading.Lock()
+        self._lock = make_lock("ops.paged_kv.PageAllocator._lock")
         self.batch = batch
         # pool generation: bumped by every reset(). Page ids held OUTSIDE
         # the allocator (the serving layer's rolling-KV registry) are only
@@ -274,9 +275,11 @@ class PageAllocator:
 
     # -- free-list geometry (the ONLY pieces the sharded subclass swaps) -----
 
+    # swarmlint: holds[self._lock]
     def _rebuild_free(self) -> None:
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
 
+    # swarmlint: holds[self._lock]
     def _take(self, slot_id: int, n: int) -> Optional[List[int]]:
         """Pop ``n`` pages usable by ``slot_id``; None if uncoverable.
         Caller holds the lock."""
@@ -284,6 +287,7 @@ class PageAllocator:
             return None
         return [self._free.pop() for _ in range(n)]
 
+    # swarmlint: holds[self._lock]
     def _give(self, page_ids: List[int]) -> None:
         """Return pages to the free list. Caller holds the lock."""
         self._free.extend(page_ids)

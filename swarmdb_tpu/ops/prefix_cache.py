@@ -36,6 +36,7 @@ from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+from ..utils.sync import make_lock
 
 
 def page_chains(tokens: Sequence[int], page_size: int,
@@ -90,7 +91,7 @@ class PrefixLRU:
             OrderedDict()
         )
         self._pins: dict = {}            # page_id -> pin count
-        self._lock = threading.Lock()
+        self._lock = make_lock("ops.prefix_cache.PrefixLRU._lock")
         self.hits = 0
         self.misses = 0
         # per-LOOKUP counters (vs the per-page hits/misses above):

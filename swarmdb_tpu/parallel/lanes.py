@@ -48,6 +48,7 @@ import jax
 from ..backend.engine import Engine, GenRequest
 from ..obs import TRACER, FlightRecorder
 from ..utils.metrics import MetricsRegistry
+from ..utils.sync import make_lock
 
 logger = logging.getLogger("swarmdb_tpu.lanes")
 
@@ -167,7 +168,7 @@ class ShardLaneGroup:
         # routing excludes quarantined lanes.
         self.supervisor = None
         self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = make_lock("parallel.lanes.ShardLaneGroup._rr_lock")
         for idx, eng in enumerate(lanes):
             eng.flight = self.flight
             eng.flight_shard = idx

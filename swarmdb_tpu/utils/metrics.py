@@ -13,6 +13,7 @@ import threading
 import time
 from collections import defaultdict, deque
 from typing import Deque, Dict, Optional
+from .sync import make_lock
 
 
 class Counter:
@@ -22,7 +23,7 @@ class Counter:
 
     def __init__(self) -> None:
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.metrics.Counter._lock")
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -40,7 +41,7 @@ class RateGauge:
     def __init__(self, window_s: float = 60.0) -> None:
         self.window_s = window_s
         self._events: Deque[float] = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.metrics.RateGauge._lock")
 
     def mark(self, ts: Optional[float] = None) -> None:
         now = ts if ts is not None else time.time()
@@ -70,7 +71,7 @@ class LatencyHistogram:
     def __init__(self, capacity: int = 4096) -> None:
         self.capacity = capacity
         self._ring: Deque[float] = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.metrics.LatencyHistogram._lock")
 
     def observe(self, seconds: float) -> None:
         with self._lock:
@@ -83,7 +84,8 @@ class LatencyHistogram:
 
     def count(self) -> int:
         """O(1) sample count (len() of a deque is constant-time)."""
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def percentile(self, q: float) -> Optional[float]:
         with self._lock:
@@ -98,7 +100,7 @@ class LatencyHistogram:
             "p50": self.percentile(50),
             "p95": self.percentile(95),
             "p99": self.percentile(99),
-            "count": float(len(self._ring)),
+            "count": float(self.count()),
         }
 
 

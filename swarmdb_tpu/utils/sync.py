@@ -1,0 +1,70 @@
+"""One lock factory for the whole package (ISSUE 12).
+
+Every module that used to call ``threading.Lock/RLock/Condition()``
+directly now allocates through here with a stable **site label**
+(``"backend.engine.Engine._cv"``). Two behaviors:
+
+- default (``SWARMDB_LOCKCHECK`` unset/0): returns the plain
+  ``threading`` classes — the factory is two dict-free statements, and
+  the object handed back is *exactly* what the caller allocated before
+  this PR existed (zero overhead, pinned by tests/test_lockcheck.py;
+  the bench echo A/B covers the full record path).
+- ``SWARMDB_LOCKCHECK=1``: returns the instrumented wrappers from
+  :mod:`swarmdb_tpu.obs.lockcheck` — per-thread held sets, the runtime
+  acquisition-order graph with inversion-cycle detection, per-site
+  hold/contention stats. The chaos/HA/partition CI suites run under
+  this flag so the hostile interleavings they generate assert lock
+  ordering, not just liveness.
+
+The flag is read per *allocation* (not per acquire): flipping the env
+var mid-process affects locks created afterwards, which is what the
+sanitizer tests rely on. The lockcheck import stays lazy so the off
+path never pays it and the obs package can itself allocate through
+this module during its own import.
+
+Site label convention: ``<module>.<Class>.<attr>`` for instance locks,
+``<module>.<function>.<name>`` for closure-shared locals — matching
+the static checker's lock identities (analysis/lockorder.py), so a
+runtime cycle report and an SWL302 finding name the same sites.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+__all__ = ["make_lock", "make_rlock", "make_condition",
+           "lockcheck_enabled"]
+
+
+def lockcheck_enabled() -> bool:
+    return os.environ.get("SWARMDB_LOCKCHECK", "0") not in ("", "0")
+
+
+def _checked(kind: str, site: str) -> Any:
+    from swarmdb_tpu.obs import lockcheck
+
+    return lockcheck.checked(kind, site)
+
+
+def make_lock(site: str) -> Any:
+    """A mutex for ``site`` (plain ``threading.Lock`` unless the
+    sanitizer is on)."""
+    if lockcheck_enabled():
+        return _checked("lock", site)
+    return threading.Lock()
+
+
+def make_rlock(site: str) -> Any:
+    if lockcheck_enabled():
+        return _checked("rlock", site)
+    return threading.RLock()
+
+
+def make_condition(site: str, lock: Optional[Any] = None) -> Any:
+    if lockcheck_enabled():
+        from swarmdb_tpu.obs import lockcheck
+
+        return lockcheck.CheckedCondition(site, lock=lock)
+    return threading.Condition(lock)

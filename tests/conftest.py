@@ -36,6 +36,37 @@ def tmp_swarm(tmp_path):
     db.close()
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """With the lock sanitizer on (SWARMDB_LOCKCHECK=1 — the CI
+    `lockcheck` job runs the chaos/HA/partition suites this way), a
+    green suite that exercised an inversion cycle is still a FAILURE:
+    the chaos harnesses generate the hostile interleavings, this hook
+    makes them assert lock ordering, not just liveness. Tests that
+    provoke cycles deliberately (tests/test_lockcheck.py) reset the
+    registry in their fixture teardown, so anything left here was
+    exercised by production code paths."""
+    if os.environ.get("SWARMDB_LOCKCHECK", "0") in ("", "0"):
+        return
+    try:
+        from swarmdb_tpu.obs import lockcheck
+    except Exception:
+        return
+    cycles = lockcheck.registry().cycles()
+    if not cycles:
+        return
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    lines = ["lock sanitizer detected inversion cycle(s):"]
+    for c in cycles:
+        lines.append("  " + " -> ".join(c["sites"] + [c["sites"][0]]))
+    if tr is not None:
+        tr.write_line("")
+        for line in lines:
+            tr.write_line(line, red=True)
+    else:  # pragma: no cover - terminal plugin always present in CI
+        print("\n".join(lines))
+    session.exitstatus = 3
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """Expose each test's call-phase outcome on the item so teardown

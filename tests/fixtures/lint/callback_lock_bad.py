@@ -1,0 +1,28 @@
+"""Seeded SWL305: stored callback invoked while holding a lock.
+
+``_on_chunk`` arrives from the constructor — the class has no idea
+what it does. Calling it inside ``with self._mu`` means a callback
+that re-enters ``emit`` (the emission-ring/supervisor shape) deadlocks
+on a plain Lock; ``emit_safe`` shows the fix: snapshot under the lock,
+invoke outside it.
+"""
+
+import threading
+
+
+class Emitter:
+    def __init__(self, on_chunk):
+        self._mu = threading.Lock()
+        self._on_chunk = on_chunk
+        self._seq = 0
+
+    def emit(self, token):
+        with self._mu:
+            self._seq += 1
+            self._on_chunk(self._seq, token)  # EXPECT: SWL305
+
+    def emit_safe(self, token):
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+        self._on_chunk(seq, token)

@@ -1,0 +1,31 @@
+"""Seeded SWL302: AB-BA inversion joined only through the call graph.
+
+``alloc`` never mentions ``_stats_mu`` — the A->B edge exists only
+because ``_count_alloc`` (reached by call while ``_alloc_mu`` is held)
+acquires it. ``report`` takes the two locks in the reverse order
+directly. Neither function is wrong alone; the cycle is the bug.
+"""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._alloc_mu = threading.Lock()
+        self._stats_mu = threading.Lock()
+        self.allocated = 0
+        self.peak = 0
+
+    def alloc(self, n):
+        with self._alloc_mu:
+            self.allocated += n
+            self._count_alloc()  # EXPECT: SWL302
+
+    def _count_alloc(self):
+        with self._stats_mu:
+            self.peak = max(self.peak, self.allocated)
+
+    def report(self):
+        with self._stats_mu:
+            with self._alloc_mu:  # EXPECT: SWL302
+                return (self.allocated, self.peak)

@@ -1,0 +1,28 @@
+"""Clean twin of deadlock_bad.py: same two locks, same call-graph
+shape, but ``report`` respects the ``_alloc_mu -> _stats_mu`` order the
+rest of the class establishes — the acquisition graph is acyclic, so
+SWL302 must stay quiet (zero findings; asserted by test_swarmlint)."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._alloc_mu = threading.Lock()
+        self._stats_mu = threading.Lock()
+        self.allocated = 0
+        self.peak = 0
+
+    def alloc(self, n):
+        with self._alloc_mu:
+            self.allocated += n
+            self._count_alloc()
+
+    def _count_alloc(self):
+        with self._stats_mu:
+            self.peak = max(self.peak, self.allocated)
+
+    def report(self):
+        with self._alloc_mu:
+            with self._stats_mu:
+                return (self.allocated, self.peak)

@@ -1,0 +1,31 @@
+"""Seeded SWL303: inferred guarded-by with ZERO annotations.
+
+``_items`` is accessed under ``_mu`` at three sites — that majority IS
+the declaration. The unguarded ``len()`` read in ``size_unsafe`` races
+with ``add``/``remove`` resizing the dict on another thread, exactly
+the Engine.stats shape ISSUE 1's annotated check caught — except no
+one wrote a ``guarded-by[...]`` comment here, so only inference sees it.
+"""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}
+
+    def add(self, key, value):
+        with self._mu:
+            self._items[key] = value
+
+    def remove(self, key):
+        with self._mu:
+            self._items.pop(key, None)
+
+    def lookup(self, key):
+        with self._mu:
+            return self._items.get(key)
+
+    def size_unsafe(self):
+        return len(self._items)  # EXPECT: SWL303

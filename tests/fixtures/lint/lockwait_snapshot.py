@@ -1,0 +1,37 @@
+"""Pre-fix snapshot of ``LocalBroker.wait_for_data`` (ISSUE 12).
+
+The in-tree shape before this PR did a single ``cond.wait(timeout)``
+guarded by an ``if``: any spurious wakeup — or a notify for an append
+the caller had already consumed — returned early with the predicate
+false, degrading the broker's long-poll into a busy poll. SWL304 must
+re-detect it here (test_swarmlint), and the fixed in-tree
+``broker/local.py`` (deadline ``while`` loop) must stay clean.
+"""
+
+import threading
+
+
+class _Partition:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.records = []
+        self.base_offset = 0
+
+    def end_offset(self):
+        return self.base_offset + len(self.records)
+
+
+class LocalBrokerPrefix:
+    def __init__(self):
+        self._parts = {}
+
+    def _part(self, topic, partition):
+        return self._parts[(topic, partition)]
+
+    def wait_for_data(self, topic, partition, offset, timeout_s):
+        part = self._part(topic, partition)
+        with part.cond:
+            if part.end_offset() > offset:
+                return True
+            part.cond.wait(timeout_s)  # EXPECT: SWL304
+            return part.end_offset() > offset

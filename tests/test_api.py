@@ -585,6 +585,51 @@ def test_metrics_scrape_endpoint(tmp_path):
     api_drive(drive, tmp_path)
 
 
+def test_admin_lockcheck_endpoint(tmp_path, monkeypatch):
+    """GET /admin/lockcheck: 503 with the sanitizer off (an empty
+    report would read as "no deadlock orders" when nothing watched);
+    with SWARMDB_LOCKCHECK=1 it returns the per-site stats + order
+    graph, and /metrics grows the lock gauges (ISSUE 12)."""
+    async def drive_off(client, db):
+        headers = await get_token(client, "admin", "pw")
+        r = await client.get("/admin/lockcheck", headers=headers)
+        assert r.status == 503
+
+    api_drive(drive_off, tmp_path)
+
+    monkeypatch.setenv("SWARMDB_LOCKCHECK", "1")
+    from swarmdb_tpu.obs import lockcheck
+    from swarmdb_tpu.utils.sync import make_lock
+
+    lockcheck.registry().reset()
+    try:
+        a = make_lock("api.test.a")
+        b = make_lock("api.test.b")
+        with a:
+            with b:
+                pass
+
+        async def drive_on(client, db):
+            headers = await get_token(client, "admin", "pw")
+            r = await client.get("/admin/lockcheck", headers=headers)
+            assert r.status == 200
+            report = await r.json()
+            assert report["enabled"] is True
+            assert "api.test.a" in report["sites"]
+            assert report["cycles"] == []
+            assert any(e["from_site"] == "api.test.a"
+                       and e["to_site"] == "api.test.b"
+                       for e in report["edges"])
+            m = await client.get("/metrics")
+            body = await m.text()
+            assert "swarmdb_lock_inversion_cycles 0" in body
+            assert "swarmdb_lock_hold_seconds" in body
+
+        api_drive(drive_on, tmp_path)
+    finally:
+        lockcheck.registry().reset()
+
+
 def test_worker_recycling_hook(tmp_path):
     """cfg.max_requests fires the recycle hook exactly once after the
     threshold (gunicorn max_requests counterpart)."""

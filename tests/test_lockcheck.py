@@ -1,0 +1,293 @@
+"""Runtime lock sanitizer tests (ISSUE 12 dynamic half).
+
+The contract: with ``SWARMDB_LOCKCHECK`` unset the factory returns the
+plain ``threading`` classes (zero overhead — type identity pinned
+here, the bench echo A/B covers the record path); with it set, a real
+AB-BA between two threads is detected as an inversion cycle whose
+report names both sites, lands in attached flight recorders, and is
+dumped to ``lockcheck_<node>.json`` for the CI artifact scan.
+"""
+
+import json
+import threading
+
+import pytest
+
+from swarmdb_tpu.utils import sync
+
+
+@pytest.fixture()
+def lockcheck_on(monkeypatch, tmp_path):
+    """Enable the sanitizer with a scratch dump dir and a clean
+    registry; always reset afterwards so deliberately-provoked cycles
+    never leak into the session-level zero-cycle assertion
+    (conftest.pytest_sessionfinish)."""
+    monkeypatch.setenv("SWARMDB_LOCKCHECK", "1")
+    monkeypatch.setenv("SWARMDB_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("SWARMDB_NODE_ID", "testnode")
+    from swarmdb_tpu.obs import lockcheck
+
+    lockcheck.registry().reset()
+    yield lockcheck
+    lockcheck.registry().reset()
+
+
+def test_factory_returns_plain_threading_types_when_off(monkeypatch):
+    """The zero-overhead contract: flag off = the exact objects the
+    callers allocated before the factory existed."""
+    monkeypatch.delenv("SWARMDB_LOCKCHECK", raising=False)
+    assert type(sync.make_lock("x")) is type(threading.Lock())
+    assert type(sync.make_rlock("x")) is type(threading.RLock())
+    assert type(sync.make_condition("x")) is threading.Condition
+
+
+def test_ab_ba_between_two_threads_reports_both_sites(lockcheck_on,
+                                                      tmp_path):
+    """A real AB-BA exercised by two threads (sequenced so it detects,
+    not deadlocks): the cycle report must name BOTH sites, both
+    threads, and carry per-edge stacks; the dump must land on disk."""
+    lockcheck = lockcheck_on
+    a = sync.make_lock("backend.engine.Engine._cv")
+    b = sync.make_lock("broker.local.LocalBroker._meta_lock")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward, name="fwd")
+    t1.start()
+    t1.join()
+    assert lockcheck.registry().cycles() == []  # one order alone is fine
+    t2 = threading.Thread(target=backward, name="bwd")
+    t2.start()
+    t2.join()
+
+    cycles = lockcheck.registry().cycles()
+    assert len(cycles) == 1
+    sites = set(cycles[0]["sites"])
+    assert sites == {"backend.engine.Engine._cv",
+                     "broker.local.LocalBroker._meta_lock"}
+    threads = {e["thread"] for e in cycles[0]["edges"]}
+    assert threads == {"fwd", "bwd"}
+    for edge in cycles[0]["edges"]:
+        assert edge["stack"], "each edge carries its acquisition stack"
+
+    # the violation dumped itself immediately (a SIGKILLed chaos victim
+    # never reaches atexit)
+    dump_path = tmp_path / "lockcheck_testnode.json"
+    assert dump_path.exists()
+    dump = json.loads(dump_path.read_text())
+    assert len(dump["cycles"]) == 1
+    assert set(dump["cycles"][0]["sites"]) == sites
+
+
+def test_same_order_twice_is_not_a_cycle(lockcheck_on):
+    lockcheck = lockcheck_on
+    a = sync.make_lock("s.A.a")
+    b = sync.make_lock("s.A.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lockcheck.registry().report()
+    assert rep["cycles"] == []
+    assert len(rep["edges"]) == 1
+    assert rep["edges"][0]["count"] == 3
+
+
+def test_rlock_reentrancy_records_no_self_edge(lockcheck_on):
+    lockcheck = lockcheck_on
+    r = sync.make_rlock("core.runtime.SwarmDB._lock")
+    with r:
+        with r:  # re-entrant: no edge, no cycle
+            pass
+    rep = lockcheck.registry().report()
+    assert rep["edges"] == [] and rep["cycles"] == []
+    assert rep["sites"]["core.runtime.SwarmDB._lock"]["acquires"] == 1
+
+
+def test_condition_wait_releases_and_reacquires_in_held_model(
+        lockcheck_on):
+    """cv.wait() must not leave the lock in the held set while parked:
+    a second thread acquiring an unrelated lock during the wait must
+    not create an edge from the cv."""
+    lockcheck = lockcheck_on
+    cv = sync.make_condition("backend.engine.Engine._cv")
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5.0)
+            woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # hand the waiter time to park, then notify
+    import time
+
+    time.sleep(0.1)
+    with cv:
+        cv.notify_all()
+    t.join(5.0)
+    assert woke.is_set()
+    rep = lockcheck.registry().report()
+    assert rep["cycles"] == []
+    # the cv site accrued 3 acquires: waiter enter, re-acquire after
+    # wait, notifier enter
+    assert rep["sites"]["backend.engine.Engine._cv"]["acquires"] >= 3
+
+
+def test_notifier_during_wait_leaves_no_stale_held_entry(lockcheck_on):
+    """Regression for the bug the serving-chaos drill caught on this
+    module's first run: a notifier acquiring the condition while a
+    waiter is parked must fully release its own held entry on exit —
+    a shared re-entry counter left the notifier's entry stale, and
+    every lock that thread touched afterwards grew phantom order
+    edges from the condition (reported as a false Engine._cv ->
+    Engine._cv inversion across lanes)."""
+    lockcheck = lockcheck_on
+    cv = sync.make_condition("backend.engine.Engine._cv")
+    other = sync.make_lock("broker.base.Producer._pending_lock")
+    parked = threading.Event()
+
+    def waiter():
+        with cv:
+            parked.set()
+            cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    parked.wait(5.0)
+    import time
+
+    time.sleep(0.05)  # let the waiter actually park
+    with cv:          # notifier acquires while the waiter is parked
+        cv.notify_all()
+    t.join(5.0)
+    # the notifier thread (this one) must hold nothing now...
+    reg = lockcheck.registry()
+    assert not reg.holds(getattr(cv, "_lock", cv))
+    # ...so touching another lock afterwards records NO edge from the cv
+    with other:
+        pass
+    edges = lockcheck.registry().report()["edges"]
+    assert [e for e in edges
+            if e["to_site"] == "broker.base.Producer._pending_lock"] == []
+    assert lockcheck.registry().cycles() == []
+
+
+def test_contention_and_hold_stats_on_metrics_lines(lockcheck_on):
+    lockcheck = lockcheck_on
+    lock = sync.make_lock("obs.metrics.HistogramRegistry._lock")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    entered.wait(5.0)
+
+    blocked = threading.Thread(target=lambda: lock.acquire() or
+                               lock.release())
+    blocked.start()
+    import time
+
+    time.sleep(0.05)
+    release.set()
+    t.join(5.0)
+    blocked.join(5.0)
+
+    stats = lockcheck.registry().report()["sites"][
+        "obs.metrics.HistogramRegistry._lock"]
+    assert stats["contended"] >= 1
+    assert stats["hold_s"] > 0.0
+    lines = lockcheck.registry().prometheus_lines()
+    text = "\n".join(lines)
+    assert ('swarmdb_lock_contended_acquires_total'
+            '{site="obs.metrics.HistogramRegistry._lock"}') in text
+    assert 'swarmdb_lock_hold_seconds' in text
+    assert "swarmdb_lock_inversion_cycles 0" in text
+
+
+def test_inversion_lands_in_attached_flight_recorder(lockcheck_on):
+    from swarmdb_tpu.obs.flight import FlightRecorder
+
+    lockcheck = lockcheck_on
+    flight = FlightRecorder(n_events=16)  # self-attaches under the flag
+    a = sync.make_lock("p.Q.a")
+    b = sync.make_lock("p.Q.b")
+
+    def fwd():
+        with a:
+            with b:
+                pass
+
+    def bwd():
+        with b:
+            with a:
+                pass
+
+    for fn in (fwd, bwd):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    events = [e for e in flight.events()
+              if e.get("kind") == "lockcheck.inversion"]
+    assert len(events) == 1
+    assert set(events[0]["sites"]) == {"p.Q.a", "p.Q.b"}
+
+
+def test_cycle_dedup_by_site_pair(lockcheck_on):
+    """Two lane instances inverting on the SAME site pair report one
+    cycle, not one per instance pair."""
+    lockcheck = lockcheck_on
+    for _ in range(2):
+        a = sync.make_lock("lanes.L.a")
+        b = sync.make_lock("lanes.L.b")
+        for fn in (lambda: (a.acquire(), b.acquire(), b.release(),
+                            a.release()),
+                   lambda: (b.acquire(), a.acquire(), a.release(),
+                            b.release())):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    assert len(lockcheck.registry().cycles()) == 1
+
+
+def test_analyzer_lists_lockcheck_dumps_next_to_flight_dumps(
+        lockcheck_on, tmp_path):
+    """obs/analyze.py: a lockcheck dump sitting beside the analyzed
+    trace shows up in the report with its cycle count."""
+    lockcheck = lockcheck_on
+    a = sync.make_lock("x.Y.a")
+    b = sync.make_lock("x.Y.b")
+    for fn in (lambda: (a.acquire(), b.acquire(), b.release(),
+                        a.release()),
+               lambda: (b.acquire(), a.acquire(), a.release(),
+                        b.release())):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert (tmp_path / "lockcheck_testnode.json").exists()
+
+    from swarmdb_tpu.obs.analyze import _synthetic_trace, analyze_files
+
+    trace_path = tmp_path / "trace.json"
+    trace_path.write_text(json.dumps(
+        {"traceEvents": _synthetic_trace(5.0, 10.0, 20.0)}))
+    report = analyze_files([str(trace_path)])
+    dumps = report.get("lockcheck_dumps")
+    assert dumps and dumps[0]["cycles"] == 1
+    assert dumps[0]["node"] == "testnode"
+    assert dumps[0]["cycle_sites"] == [list(
+        dict.fromkeys(dumps[0]["cycle_sites"][0]))]

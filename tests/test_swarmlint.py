@@ -47,6 +47,10 @@ def expected_findings(path: Path):
     "heartbeat_bad.py",         # heartbeat-safety family (SWL601/602)
     "fence_bad.py",             # fencing discipline (SWL603)
     "retry_bad.py",             # retry-discipline family (SWL701)
+    "deadlock_bad.py",          # lock-order inversion (SWL302)
+    "guarded_bad.py",           # inferred guarded-by (SWL303)
+    "callback_lock_bad.py",     # callback-under-lock (SWL305)
+    "lockwait_snapshot.py",     # wait-not-in-while (SWL304)
 ])
 def test_each_family_detects_seeded_violations(name):
     path = FIXTURES / name
@@ -73,6 +77,76 @@ def test_prefix_replica_snapshot_reproduces_advice_finding():
 
 def test_clean_fixture_has_zero_findings():
     assert analyze_file(str(FIXTURES / "clean.py")) == []
+
+
+def test_deadlock_ok_twin_is_clean():
+    """Same locks, same call-graph shape as deadlock_bad.py, but a
+    consistent acquisition order — the graph is acyclic, zero
+    findings."""
+    assert analyze_file(str(FIXTURES / "deadlock_ok.py")) == []
+
+
+def test_lockwait_snapshot_reproduces_prefix_finding():
+    """The pre-fix ``LocalBroker.wait_for_data`` shape (single
+    ``cond.wait`` under an ``if``) must be re-detected as SWL304 — and
+    the FIXED in-tree broker/local.py (deadline while loop) stays
+    clean of the rule."""
+    path = FIXTURES / "lockwait_snapshot.py"
+    findings = analyze_file(str(path))
+    assert [(f.rule, f.line) for f in findings] == [
+        ("SWL304", next(iter(expected_findings(path)))[0])]
+    assert "while" in findings[0].message
+    fixed = analyze_file(str(REPO / "swarmdb_tpu" / "broker" / "local.py"))
+    assert [f for f in fixed if f.rule == "SWL304"] == []
+
+
+def test_swl302_cycle_joined_only_across_files(tmp_path):
+    """The interprocedural case per-file analysis CANNOT see: the two
+    halves of an AB-BA living in different modules, joined by an
+    import edge. Each file alone is clean; the project pass over both
+    reports the inversion."""
+    from swarmdb_tpu.analysis.core import analyze_paths
+
+    (tmp_path / "store_mod.py").write_text(
+        "import threading\n"
+        "from log_mod import grab_log\n"
+        "\n"
+        "\n"
+        "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "\n"
+        "    def flush(self):\n"
+        "        with self._mu:\n"
+        "            grab_log(self)\n")
+    (tmp_path / "log_mod.py").write_text(
+        "import threading\n"
+        "\n"
+        "LOG = threading.Lock()\n"
+        "\n"
+        "\n"
+        "def grab_log(store):\n"
+        "    with LOG:\n"
+        "        pass\n"
+        "\n"
+        "\n"
+        "def snapshot(store: \"Store\"):\n"
+        "    with LOG:\n"
+        "        store.flush()\n"
+        "\n"
+        "\n"
+        "from store_mod import Store\n")
+    # each half alone: no resolvable cross-module edge, no finding
+    assert analyze_file(str(tmp_path / "store_mod.py")) == []
+    assert analyze_file(str(tmp_path / "log_mod.py")) == []
+    findings = analyze_paths([str(tmp_path)])
+    rules = {f.rule for f in findings}
+    assert rules == {"SWL302"}
+    msgs = " ".join(f.message for f in findings)
+    assert "Store._mu" in msgs and "LOG" in msgs
+    # a finding lands on each edge of the cycle: one per file
+    assert {f.path.split("/")[-1] for f in findings} == {
+        "store_mod.py", "log_mod.py"}
 
 
 def test_inline_disable_suppresses(tmp_path):
@@ -119,10 +193,63 @@ def test_select_restricts_families():
 
 
 def test_repo_tree_clean_against_committed_baseline():
-    """The acceptance invocation: `python -m swarmdb_tpu.analysis
-    swarmdb_tpu/` (default baseline analysis/baseline.json) exits 0."""
-    assert main([str(REPO / "swarmdb_tpu"),
+    """The acceptance invocation (matches CI's lint job, which since
+    ISSUE 12 also scans scripts/ and bench.py): exits 0 against the
+    committed baseline."""
+    assert main([str(REPO / "swarmdb_tpu"), str(REPO / "scripts"),
+                 str(REPO / "bench.py"),
                  "--baseline", str(REPO / "analysis" / "baseline.json")]) == 0
+
+
+def test_explain_covers_every_rule(capsys):
+    from swarmdb_tpu.analysis.core import RULES
+    from swarmdb_tpu.analysis.explain import EXPLAIN
+
+    assert set(EXPLAIN) == set(RULES), (
+        "every rule needs an --explain entry (doc + bad/good example)")
+    assert main(["--explain", "SWL303"]) == 0
+    out = capsys.readouterr().out
+    assert "BAD:" in out and "GOOD:" in out and "inferred" in out.lower()
+    # family names expand to every member
+    assert main(["--explain", "lock-discipline"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("SWL301", "SWL302", "SWL303", "SWL304", "SWL305"):
+        assert rid in out
+    assert main(["--explain", "SWL999"]) == 2
+
+
+def test_prune_baseline_reports_then_writes(tmp_path, capsys):
+    """--prune-baseline: entries whose finding is gone (file deleted or
+    code fixed) are reported; only --write rewrites the file."""
+    victim = tmp_path / "victim.py"
+    victim.write_text((FIXTURES / "guarded_bad.py").read_text())
+    keeper = tmp_path / "keeper.py"
+    keeper.write_text((FIXTURES / "callback_lock_bad.py").read_text())
+    baseline = tmp_path / "baseline.json"
+    assert main([str(victim), str(keeper), "--update-baseline",
+                 "--baseline", str(baseline)]) == 0
+    before = json.loads(baseline.read_text())
+    assert len(before["findings"]) == 2
+
+    # fix one finding by deleting its file
+    victim.unlink()
+    capsys.readouterr()
+    # report-only: stale named, file untouched
+    assert main([str(keeper), "--prune-baseline",
+                 "--baseline", str(baseline)]) == 0
+    out = capsys.readouterr().out
+    assert "stale:" in out and "victim.py" in out
+    assert "report-only" in out
+    assert len(json.loads(baseline.read_text())["findings"]) == 2
+
+    # --write prunes, keeping the live entry
+    assert main([str(keeper), "--prune-baseline", "--write",
+                 "--baseline", str(baseline)]) == 0
+    after = json.loads(baseline.read_text())
+    assert len(after["findings"]) == 1
+    assert after["findings"][0]["path"].endswith("keeper.py")
+    # and the pruned baseline still accepts the surviving finding
+    assert main([str(keeper), "--baseline", str(baseline)]) == 0
 
 
 def test_cli_module_smoke():
@@ -131,7 +258,8 @@ def test_cli_module_smoke():
         [sys.executable, "-m", "swarmdb_tpu.analysis", "--list-rules"],
         cwd=str(REPO), capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    for rule in ("SWL101", "SWL203", "SWL301", "SWL401", "SWL501",
+    for rule in ("SWL101", "SWL203", "SWL301", "SWL302", "SWL303",
+                 "SWL304", "SWL305", "SWL401", "SWL501",
                  "SWL502", "SWL503", "SWL504", "SWL601", "SWL602",
                  "SWL603"):
         assert rule in proc.stdout
