@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Seeded use-after-free drill — the page sanitizer's NEGATIVE test.
+
+The CI ``pagecheck`` job runs the serving-chaos and ragged-prefill
+suites under ``SWARMDB_PAGECHECK=1`` and fails on any violation; this
+script is the other direction: it deliberately commits every page
+crime the sanitizer hunts — a write into a freed (canary-poisoned)
+page, a reference to a dead page, a double-free — and exits non-zero
+unless the detector FIRED on each and dumped evidence to disk. A green
+chaos run only means something if this drill stays red-on-crime.
+
+Run: SWARMDB_PAGECHECK=1 python scripts/pagecheck_drill.py
+(the script forces the flag itself so a bare invocation also works).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("SWARMDB_PAGECHECK", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SWARMDB_NODE_ID", "pagecheck-drill")
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from swarmdb_tpu.obs import pagecheck
+    from swarmdb_tpu.ops.paged_kv import (CANARY_VALUE, canary_check,
+                                          canary_fill,
+                                          make_page_allocator)
+
+    dump_dir = os.environ.get("SWARMDB_FLIGHT_DIR")
+    if not dump_dir:
+        dump_dir = tempfile.mkdtemp(prefix="pagecheck-drill-")
+        os.environ["SWARMDB_FLIGHT_DIR"] = dump_dir
+
+    alloc = make_page_allocator(9, 4, 16, 2, label="drill")
+    if type(alloc).__name__ != "CheckedPageAllocator":
+        print("FAIL: factory did not return the checked allocator "
+              f"under SWARMDB_PAGECHECK=1 (got {type(alloc).__name__})")
+        return 1
+    # a tiny fake pool: [L=1, P=9, ps=4, Hkv=1, D=2]
+    k = jnp.zeros((1, 9, 4, 1, 2), jnp.float32)
+    v = jnp.zeros_like(k)
+
+    # -- crime 1: write-after-free (canary) ---------------------------
+    row = alloc.allocate(0, 2)
+    assert row is not None
+    row = None  # pages freed below via the slot-keyed retirement API
+    pages = alloc.pages_for(0)
+    alloc.mark_retired(0)
+    pending = alloc.take_pending_frees()
+    alloc.release_taken(pending)
+    k, v = canary_fill(k, v, pages)
+    alloc.pagecheck.mark_poisoned(pages)
+    k = k.at[:, pages[0]].set(3.14159)          # the rogue write
+    bad = canary_check(k, v, alloc.pagecheck.poisoned_pages(pages))
+    if bad:
+        alloc.pagecheck.canary_violation(bad, detail="seeded drill")
+
+    # -- crime 2: reference to a freed page (cross-lane aliasing) -----
+    # swarmlint: disable=SWL801 -- seeded crime: the drill exists to prove the runtime detector fires
+    alloc.allocate_with_prefix(1, [pages[1]], 1)
+
+    # -- crime 3: double-free -----------------------------------------
+    taken = alloc.reserve(1)
+    alloc.add_free(taken)
+    # swarmlint: disable=SWL803 -- seeded crime: the drill exists to prove the runtime detector fires
+    alloc.add_free(taken)
+
+    kinds = {vv["kind"] for vv in pagecheck.registry().violations()}
+    want = {"canary", "stale-reference", "double-free"}
+    missing = want - kinds
+    dump = os.path.join(dump_dir, "pagecheck_pagecheck-drill.json")
+    print(f"violations recorded: {sorted(kinds)}")
+    print(f"dump: {dump} exists={os.path.exists(dump)}")
+    print(f"canary value: {CANARY_VALUE}")
+    if missing:
+        print(f"FAIL: detector did not fire for: {sorted(missing)}")
+        return 1
+    if not os.path.exists(dump):
+        print("FAIL: violation dump never landed on disk")
+        return 1
+    print("OK: every seeded page crime was detected and dumped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,14 @@ actually had (see ISSUE/ADVICE history):
   ``SWARMDB_LOCKCHECK=1`` (obs/lockcheck.py + utils/sync.py).
 - **tracer-leak** (SWL401, tracers.py): stores to self/global/nonlocal
   from inside traced functions.
+- **page-lifetime** (SWL801-805, pagelife.py, the ISSUE 13 swarmpage
+  family): KV-page handle tracking over the same call graph — leaks
+  incl. exception paths (801), use-after-free into table writes (802),
+  double-free (803), pin discipline (804), table-write-before-alloc
+  (805), with ``owns[page]``/``borrows[page]`` declaring ownership
+  transfer at call boundaries. The runtime twin is
+  ``SWARMDB_PAGECHECK=1`` (obs/pagecheck.py + the ops/paged_kv.py and
+  ops/prefix_cache.py factories).
 
 Run it::
 

@@ -58,6 +58,20 @@ Directive grammar (comments beginning ``# swarmlint:``):
     declared per-REQUEST drain (the engine's one session/chunk sync), so
     SWL101 stays quiet. Never applies inside a loop — a sync you loop
     over is a per-iteration sync and stays an SWL105 finding (hostsync.py).
+``# swarmlint: owns[page]: <name>[, <name>]``
+    Page-ownership transfer declaration (pagelife.py, SWL801-805): on
+    (or directly above) a ``def``, the listed PARAMETERS receive
+    ownership of the page handles passed in — the caller is discharged
+    (and must not use the handle again: a later use is SWL802), and the
+    callee body is responsible for freeing/escaping them. The special
+    name ``return`` declares the function's return value an OWNED page
+    handle (wrappers around allocator calls propagate producer-ness
+    automatically; the directive covers the shapes inference can't see).
+``# swarmlint: borrows[page]: <name>[, <name>]``
+    The dual: the listed parameters only BORROW the handle — a call
+    does NOT discharge the caller's ownership (the default for an
+    unresolvable call is the conservative "escaped"), so the caller
+    must still free/escape the handle on every path.
 """
 
 from __future__ import annotations
@@ -168,6 +182,30 @@ RULES: Dict[str, Rule] = {
              "backoff, or no deadline check — an undisciplined retry "
              "loop turns one failure into a retry storm (and a hung "
              "dependency into a hung caller)"),
+        Rule("SWL801", "page-lifetime",
+             "page-handle leak: pages taken from the allocator/prefix "
+             "cache escape the function (return/raise/fall-through, "
+             "including exception paths across raising calls) without "
+             "reaching a free/registration/custody transfer"),
+        Rule("SWL802", "page-lifetime",
+             "page use-after-free: a handle flows into a page-table "
+             "write, dispatch descriptor, or any read after a path "
+             "that already freed it — the pages may belong to another "
+             "conversation by the time the write lands"),
+        Rule("SWL803", "page-lifetime",
+             "page double-free: a handle reaches a free sink twice on "
+             "one path — the second free forks custody and two future "
+             "allocations will alias the same pages"),
+        Rule("SWL804", "page-lifetime",
+             "pin-discipline: pages pinned via PrefixLRU.pin/"
+             "match_and_pin must be unpinned, released, or handed off "
+             "on every path out — a leaked pin drifts evictable_count, "
+             "which the pool backpressure gate trusts"),
+        Rule("SWL805", "page-lifetime",
+             "page-table write before allocation: a handle reaches a "
+             "table write before the allocator call that produces it "
+             "on this path — the row blesses page ids the pool has "
+             "not granted"),
     )
 }
 
@@ -244,6 +282,10 @@ class Directives:
         default_factory=list)
     # lines carrying `# swarmlint: sanctioned-drain` (hostsync SWL101/105)
     sanctioned_drains: Set[int] = field(default_factory=set)
+    # page-ownership transfer at call boundaries (pagelife SWL801-805):
+    # line -> parameter names (or "return") taking/borrowing ownership
+    page_owns: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
+    page_borrows: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
 
 
 def _parse_directive(body: str, line: int, out: Directives) -> None:
@@ -283,6 +325,15 @@ def _parse_directive(body: str, line: int, out: Directives) -> None:
     m = re.match(r"holds\[(?P<guard>[^\]]+)\]\s*$", body)
     if m:
         out.holds[line] = m.group("guard").strip()
+        return
+    m = re.match(r"(?P<kind>owns|borrows)\[page\]\s*:\s*(?P<names>.+)$",
+                 body)
+    if m:
+        names = tuple(n.strip() for n in m.group("names").split(",")
+                      if n.strip())
+        dest = (out.page_owns if m.group("kind") == "owns"
+                else out.page_borrows)
+        dest[line] = names
         return
     m = re.match(r"guarded-by\[(?P<guard>[^\]]+)\]\s*:\s*(?P<names>.+)$",
                  body)
@@ -450,6 +501,22 @@ class SourceFile:
                 return True
         return False
 
+    def page_decls(self, fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+        """(owns, borrows) parameter-name sets declared by
+        ``# swarmlint: owns[page]:`` / ``borrows[page]:`` directives
+        on/above the def (``"return"`` in owns marks the return value
+        an owned handle)."""
+        owns: Set[str] = set()
+        borrows: Set[str] = set()
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return owns, borrows
+        first = min([fn.lineno]
+                    + [d.lineno for d in fn.decorator_list]) - 1
+        for line in range(first, fn.body[0].lineno):
+            owns.update(self.directives.page_owns.get(line, ()))
+            borrows.update(self.directives.page_borrows.get(line, ()))
+        return owns, borrows
+
     def held_guards(self, fn: ast.AST) -> Set[str]:
         """Guards a ``# swarmlint: holds[...]`` directive on/above the
         def declares as already held by this function's callers."""
@@ -550,13 +617,40 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
     return files
 
 
+# Parsed-AST cache shared across rule families AND across analyze
+# calls in one process (keyed by (abspath, mtime_ns, size)). Before
+# this cache, every analyze_file/analyze_paths call re-parsed its
+# whole input set — the CI lint job's prune step and the swarmlint
+# test suite each re-parsed the ~100-file tree from scratch per
+# invocation. SourceFile is read-only to every checker, so sharing is
+# safe; a rewritten file misses on mtime/size and re-parses.
+_SRC_CACHE: Dict[str, Tuple[int, int, SourceFile]] = {}
+_SRC_CACHE_MAX = 512
+
+
 def _parse_source(path: str, text: Optional[str] = None) -> SourceFile:
+    if text is None:
+        key = os.path.abspath(path)
+        try:
+            st = os.stat(key)
+            stamp = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            stamp = None
+        if stamp is not None:
+            hit = _SRC_CACHE.get(key)
+            if hit is not None and (hit[0], hit[1]) == stamp:
+                return hit[2]
     try:
-        return SourceFile(path, text=text)
+        src = SourceFile(path, text=text)
     except SyntaxError as exc:
         if exc.filename:  # ast.parse errors already carry the path
             raise
         raise SyntaxError(f"{path}: {exc}") from None
+    if text is None and stamp is not None:
+        if len(_SRC_CACHE) >= _SRC_CACHE_MAX:
+            _SRC_CACHE.clear()
+        _SRC_CACHE[key] = (stamp[0], stamp[1], src)
+    return src
 
 
 def _per_file_findings(src: SourceFile) -> List[Finding]:
@@ -591,26 +685,37 @@ def _finalize(findings: List[Finding], srcs: Sequence[SourceFile],
     return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
+def _project_findings(srcs: Sequence[SourceFile]) -> List[Finding]:
+    """Project-level passes. ONE CallGraph is built here and shared by
+    every interprocedural family (lockorder SWL302-305, pagelife
+    SWL801-805) — each family re-deriving its own graph doubled the
+    project-pass indexing cost for zero semantic difference."""
+    from . import lockorder, pagelife
+    from .callgraph import CallGraph
+
+    graph = CallGraph(srcs)
+    findings = list(lockorder.check_project(srcs, graph=graph))
+    findings.extend(pagelife.check_project(srcs, graph=graph))
+    return findings
+
+
 def analyze_file(path: str, select: Optional[Set[str]] = None,
                  text: Optional[str] = None) -> List[Finding]:
-    from . import lockorder
-
     src = _parse_source(path, text=text)
     findings = _per_file_findings(src)
-    findings.extend(lockorder.check_project([src]))
+    findings.extend(_project_findings([src]))
     return _finalize(findings, [src], select)
 
 
 def analyze_paths(paths: Sequence[str],
                   select: Optional[Set[str]] = None) -> List[Finding]:
-    """Per-file checks on every file, then the project-level lock pass
-    (lockorder.py) over ALL files as one program — the interprocedural
-    SWL302 edges only exist when the whole set is visible."""
-    from . import lockorder
-
+    """Per-file checks on every file, then the project-level passes
+    (lockorder.py, pagelife.py) over ALL files as one program — the
+    interprocedural SWL302/SWL80x edges only exist when the whole set
+    is visible."""
     srcs = [_parse_source(p) for p in iter_py_files(paths)]
     findings: List[Finding] = []
     for src in srcs:
         findings.extend(_per_file_findings(src))
-    findings.extend(lockorder.check_project(srcs))
+    findings.extend(_project_findings(srcs))
     return _finalize(findings, srcs, select)

@@ -256,6 +256,75 @@ EXPLAIN: Dict[str, Dict[str, str]] = {
                 "    self._check_fence(topic, part)\n"
                 "    self._log.append(topic, part, rec)",
     },
+    "SWL801": {
+        "doc": "A page handle taken from the allocator/prefix cache "
+               "(allocate, allocate_with_prefix, reserve, acquire, "
+               "evict_lru, take_pending_frees) must reach a free sink, "
+               "registration, or custody transfer on EVERY path out — "
+               "including exception paths: a handle destined for a "
+               "free sink held across a raising call with no try "
+               "protection leaks when the call throws. Declare "
+               "transfer at call boundaries with `# swarmlint: "
+               "owns[page]:` / `borrows[page]:`. Runtime twin: "
+               "SWARMDB_PAGECHECK=1 (obs/pagecheck.py).",
+        "bad": "pending = alloc.take_pending_frees()\n"
+               "dispatch_zero_rows(pending)  # can raise -> pages leak\n"
+               "alloc.release_taken(pending)",
+        "good": "pending = alloc.take_pending_frees()\n"
+                "try:\n"
+                "    dispatch_zero_rows(pending)\n"
+                "except Exception:\n"
+                "    alloc.requeue_pending(pending)  # retry next round\n"
+                "    raise\n"
+                "alloc.release_taken(pending)",
+    },
+    "SWL802": {
+        "doc": "A handle that reached a free sink is dead: flowing it "
+               "into a page-table write, a dispatch descriptor, or any "
+               "later call blesses pages that another conversation may "
+               "already own — the paged-KV use-after-free that aliases "
+               "two requests' KV.",
+        "bad": "alloc.add_free(row)\n"
+               "set_page_table_rows(table, [slot], row)  # freed row",
+        "good": "set_page_table_rows(table, [slot], row)\n"
+                "alloc.add_free(row)  # free only after the write",
+    },
+    "SWL803": {
+        "doc": "Freeing the same handle twice puts its pages on the "
+               "free list twice: two future allocations receive the "
+               "same page ids and silently alias each other's KV.",
+        "bad": "alloc.add_free(pages)\n"
+               "alloc.add_free(pages)  # second free forks custody",
+        "good": "alloc.add_free(pages)\n"
+                "pages = None  # handle is dead after the free",
+    },
+    "SWL804": {
+        "doc": "Every PrefixLRU.pin / match_and_pin must be matched by "
+               "unpin/release or a custody handoff on all paths out of "
+               "the function. A leaked pin permanently inflates "
+               "evictable_count — which the pool backpressure gate "
+               "trusts as reclaimable headroom — so admission keeps "
+               "betting on pages it can never evict.",
+        "bad": "hits = prefix.match_and_pin(chains, prompt)\n"
+               "if too_long(hits):\n"
+               "    return []  # pins leak on the early return",
+        "good": "hits = prefix.match_and_pin(chains, prompt)\n"
+                "if too_long(hits):\n"
+                "    prefix.unpin(hits)\n"
+                "    return []",
+    },
+    "SWL805": {
+        "doc": "A handle written into a page-table row BEFORE the "
+               "allocator call that produces it on this path: the row "
+               "blesses page ids the pool has not granted, so the "
+               "device can read/write pages owned by nobody (or "
+               "somebody else).",
+        "bad": "set_page_table_rows(table, [slot], row)  # row not yet real\n"
+               "row = alloc.allocate(slot, need)",
+        "good": "row = alloc.allocate(slot, need)\n"
+                "if row is not None:\n"
+                "    set_page_table_rows(table, [slot], row)",
+    },
     "SWL701": {
         "doc": "A retry loop in `# swarmlint: retry` code must carry a "
                "bound, a backoff, and a deadline check — otherwise one "

@@ -36,6 +36,7 @@ from pydantic import ValidationError
 from ..core.messages import MessageStatus
 from ..core.runtime import SwarmDB
 from ..obs import HISTOGRAMS, TRACER, propagate
+from ..obs.pagecheck import enabled as pagecheck_enabled
 from ..utils import jwt as jwt_util
 from ..utils.sync import lockcheck_enabled
 from . import schemas
@@ -680,6 +681,58 @@ def create_app(
 
             lines.extend(await _run_sync(
                 lockcheck.registry().prometheus_lines))
+        # page-pool gauges (ISSUE 13 observability satellite): flag-
+        # independent — rendered straight off the serving engine's
+        # allocator/prefix stats, so capacity dashboards see
+        # allocated/pinned/free headroom whether or not the sanitizer
+        # is on. Under SWARMDB_PAGECHECK=1 the registry adds shadow-
+        # state gauges, per-lane churn counters, and the violation
+        # count (>0 is a pager line: a detected page-safety bug).
+        paged = getattr(getattr(serving, "engine", None), "paged", None)
+        if paged is not None:
+            try:
+                pstats = await _run_sync(paged.allocator.stats)
+            except Exception:
+                logger.exception("page-pool stats read failed")
+                pstats = None
+            if pstats is not None:
+                free = int(pstats.get("free_pages", 0))
+                total = int(pstats.get("num_pages", 0))
+                trash = int(pstats.get("n_shards")
+                            or pstats.get("lanes") or 1)
+                pinned = 0
+                prefix = getattr(serving.engine, "_prefix", None)
+                if prefix is not None:
+                    try:
+                        pinned = int((await _run_sync(prefix.stats)).get(
+                            "pinned_pages", 0))
+                    except Exception:
+                        pinned = 0
+                lines.append("# TYPE swarmdb_page_free gauge")
+                lines.append(f"swarmdb_page_free {free}")
+                lines.append("# TYPE swarmdb_page_allocated gauge")
+                lines.append(
+                    f"swarmdb_page_allocated "
+                    f"{max(0, total - trash - free)}")
+                lines.append("# TYPE swarmdb_page_pinned gauge")
+                lines.append(f"swarmdb_page_pinned {pinned}")
+                churn = pstats.get("churn_by_lane") or [
+                    (pstats.get("pages_allocated_total", 0),
+                     pstats.get("pages_freed_total", 0))]
+                lines.append(
+                    "# TYPE swarmdb_pages_allocated_total counter")
+                lines.append("# TYPE swarmdb_pages_freed_total counter")
+                for lane, (a, f) in enumerate(churn):
+                    lbl = f'{{lane="lane{lane}"}}'
+                    lines.append(
+                        f"swarmdb_pages_allocated_total{lbl} {a}")
+                    lines.append(
+                        f"swarmdb_pages_freed_total{lbl} {f}")
+        if pagecheck_enabled():
+            from ..obs import pagecheck
+
+            lines.extend(await _run_sync(
+                pagecheck.registry().prometheus_lines))
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -894,6 +947,22 @@ def create_app(
         return web.json_response(
             await _run_sync(lockcheck.registry().report))
 
+    async def admin_pagecheck(request: web.Request) -> web.Response:
+        """GET /admin/pagecheck — the runtime page sanitizer's full
+        report (SWARMDB_PAGECHECK=1): per-pool shadow-state counts,
+        per-lane churn, and every recorded violation (double-free,
+        use-after-free canary, epoch mismatch, cross-lane aliasing)
+        with owners and stacks. 503 with the flag off — an empty
+        report would read as "no page bugs" when nothing watched."""
+        require_admin(current_agent(request))
+        if not pagecheck_enabled():
+            raise _error(503, "page sanitizer off — set "
+                              "SWARMDB_PAGECHECK=1")
+        from ..obs import pagecheck
+
+        return web.json_response(
+            await _run_sync(pagecheck.registry().report))
+
     async def admin_lanes(request: web.Request) -> web.Response:
         """GET /admin/lanes — the lane supervisor's full status: per-lane
         state machine (alive/suspect/quarantined), beat ages, quarantine
@@ -1077,6 +1146,7 @@ def create_app(
         web.get("/admin/ha", admin_ha),
         web.get("/admin/lanes", admin_lanes),
         web.get("/admin/lockcheck", admin_lockcheck),
+        web.get("/admin/pagecheck", admin_pagecheck),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

@@ -69,6 +69,11 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <button onclick="download('/admin/flight', 'flight.json')">
     download flight record</button>
   (last engine steps + request timelines; auto-dumped on engine restart)
+  &middot;
+  <button onclick="download('/admin/pagecheck', 'pagecheck.json')">
+    download pagecheck report</button>
+  (page sanitizer: per-pool shadow states + violations; 503 unless
+  SWARMDB_PAGECHECK=1)
   &middot; admin token required
 </p>
 <script>

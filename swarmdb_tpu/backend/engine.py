@@ -290,6 +290,15 @@ class Engine:
         # discard. Depth 1 = the round-3 lockstep behavior.
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.paged = paged
+        # runtime page sanitizer (SWARMDB_PAGECHECK=1, obs/pagecheck.py):
+        # non-None only when the allocator came from the checked factory
+        # — one attr read on the flag-off path, nothing else
+        self._pagecheck = (getattr(paged.allocator, "pagecheck", None)
+                           if paged else None)
+        if self._pagecheck is not None:
+            from ..obs.pagecheck import registry as _pagecheck_registry
+
+            _pagecheck_registry().attach_flight(self.flight)
         # main decode cache: paged pool or dense slot buffer; prefill always
         # uses dense bucket-sized temp caches from init_cache_fn
         self.cache = paged.init_pool() if paged else init_cache_fn(max_batch, max_seq)
@@ -865,11 +874,15 @@ class Engine:
             if max_seq % paged.page_size:
                 raise ValueError("max_seq must be a page-size multiple "
                                  "for prefix caching")
-            from ..ops.prefix_cache import PrefixLRU
+            from ..ops.prefix_cache import make_prefix_lru
 
             self._prefix_ps = paged.page_size
-            self._prefix = PrefixLRU(paged.num_pages, paged.page_size,
-                                     manage_free=False)
+            # paged mode shares the allocator's pool (and, under
+            # SWARMDB_PAGECHECK=1, its shadow state — obs/pagecheck.py)
+            self._prefix = make_prefix_lru(paged.num_pages,
+                                           paged.page_size,
+                                           manage_free=False,
+                                           pool=paged.allocator)
             pages_fwd = prefix_fns[0]
             maxp_row = paged.allocator.maxp
             self._prefix_pp_buckets = self._pp_widths(maxp_row)
@@ -964,10 +977,11 @@ class Engine:
             if max_seq % prefix_page_size:
                 raise ValueError("max_seq must be a page-size multiple "
                                  "for prefix caching")
-            from ..ops.prefix_cache import PrefixLRU
+            from ..ops.prefix_cache import make_prefix_lru
 
             self._prefix_ps = prefix_page_size
-            self._prefix = PrefixLRU(max(2, prefix_pages), prefix_page_size)
+            self._prefix = make_prefix_lru(max(2, prefix_pages),
+                                           prefix_page_size)
             lane_fwd, init_pool = prefix_fns
             self._prefix_init_pool = init_pool
             self._prefix_pool = init_pool(max(2, prefix_pages),
@@ -1285,6 +1299,7 @@ class Engine:
                     out_shardings=sh)(),
         )
 
+    # swarmlint: borrows[page]: args
     def _mirrored(self, call_id: int, *args) -> None:  # swarmlint: hot
         """Publish (pod mode) then execute one mirrored device call.
         Publish FIRST, matching the decode/prefill pattern: if the local
@@ -2459,13 +2474,30 @@ class Engine:
             # pool (stale-table/reuse race)
             pending = self.paged.allocator.take_pending_frees()
             if pending:
-                self._mirrored(
-                    self.CALL_SET_PT_ROWS,
-                    np.asarray(pending, np.int32),
-                    np.zeros((len(pending), self.paged.allocator.maxp),
-                             np.int32),
-                )
+                freed_pages: List[int] = []
+                if self._pagecheck is not None:
+                    for sid in pending:
+                        freed_pages.extend(
+                            self.paged.allocator.pages_for(sid))
+                try:
+                    self._mirrored(
+                        self.CALL_SET_PT_ROWS,
+                        np.asarray(pending, np.int32),
+                        np.zeros((len(pending),
+                                  self.paged.allocator.maxp), np.int32),
+                    )
+                except Exception:
+                    # dispatch failed before the rows were zeroed:
+                    # freeing would reopen the stale-table race,
+                    # dropping the drained batch would leak its pages
+                    # forever (swarmlint SWL801) — requeue and let the
+                    # engine's error recovery run, the next admission
+                    # round retries the reclaim
+                    self.paged.allocator.requeue_pending(pending)
+                    raise
                 self.paged.allocator.release_taken(pending)
+                if self._pagecheck is not None and freed_pages:
+                    self._pagecheck_poison(freed_pages)
             if not self._backpressure_gate():
                 return
         pressure_called = False
@@ -2666,6 +2698,13 @@ class Engine:
                 if stale_resumes:
                     continue  # stale pops may have unblocked the queue head
                 return
+            if self.paged and rows and self._pagecheck is not None:
+                # sanitizer: stamp owners, then verify the canary of
+                # every re-allocated page is still intact — an
+                # overwritten canary is a write-after-free landing
+                # between free and re-allocation
+                for (sid, _row), req in zip(rows, popped):
+                    self._pagecheck_admit(sid, req)
             if self.paged and rows:
                 self._mirrored(
                     self.CALL_SET_PT_ROWS,
@@ -2894,6 +2933,48 @@ class Engine:
                     alloc.add_free(evicted)
             return alloc.allocate_with_prefix(slot_id, hits, n_fresh)
         return alloc.allocate(slot_id, n_fresh)
+
+    # ------------------------------------------------- page sanitizer
+    # Both helpers run ONLY under SWARMDB_PAGECHECK=1 (self._pagecheck
+    # set by the checked-allocator factory) — the flag-off path never
+    # reaches them. They are deliberately NOT marked hot: the canary
+    # verify is a sanctioned per-admission device sync the sanitizer
+    # pays for detection.
+
+    def _pagecheck_poison(self, pages: List[int]) -> None:
+        """Stamp freed pages' device K/V with the canary pattern (one
+        eager scatter per reclaim batch). Skipped in pod mode — a
+        local-only device write would desynchronize the SPMD mirrors."""
+        if self._mh is not None or not pages:
+            return
+        from ..ops.paged_kv import canary_fill
+
+        self.cache["k"], self.cache["v"] = canary_fill(
+            self.cache["k"], self.cache["v"], pages)
+        self._pagecheck.mark_poisoned(pages)
+
+    def _pagecheck_admit(self, slot_id: int, req: "GenRequest") -> None:
+        """Admission-side sanitizer bookkeeping: stamp the slot's owner
+        (request id — the aliasing reports name both conversations),
+        then verify the canary of every poisoned page this slot was
+        just handed is intact. A mismatch means something WROTE to the
+        page while it was free — the write-after-free no host-side
+        bookkeeping can see."""
+        pc = self._pagecheck
+        pc.set_owner(slot_id, req.request_id)
+        if self._mh is not None:
+            return
+        fresh = self.paged.allocator.pages_for(slot_id)
+        poisoned = pc.poisoned_pages(fresh)
+        if not poisoned:
+            return
+        from ..ops.paged_kv import canary_check
+
+        bad = canary_check(self.cache["k"], self.cache["v"], poisoned)
+        if bad:
+            pc.canary_violation(
+                bad, detail=f"at admission of {req.request_id}")
+        pc.clear_poison(poisoned)
 
     # swarmlint: hot
     def _prefill_paged_prefix_batch(self, batch: List[Tuple], bucket: int,
@@ -3354,6 +3435,13 @@ class Engine:
         self._activate(batch, t0)
 
     def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:  # swarmlint: hot
+        if self._pagecheck is not None:
+            # dispatch-time page validation: every page the slot's row
+            # was stamped with at allocation is still live at the same
+            # alloc epoch (a page freed+reallocated in between is the
+            # stale-table race; a foreign page is cross-lane aliasing)
+            for slot_id, _req in batch:
+                self._pagecheck.validate_row(slot_id)
         for slot_id, req in batch:
             slot = self.slots[slot_id]
             slot.active = True

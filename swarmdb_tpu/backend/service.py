@@ -235,7 +235,7 @@ def build_backend_engine(
 
     paged_spec = None
     if paged:
-        from ..ops.paged_kv import PageAllocator, pages_per_slot
+        from ..ops.paged_kv import make_page_allocator, pages_per_slot
 
         maxp = pages_per_slot(seq, page_size)
         if kv_pool_tokens is None and "SWARMDB_KV_POOL_TOKENS" in os.environ:
@@ -254,7 +254,8 @@ def build_backend_engine(
                 cfg, max_batch, seq, num_pages, page_size),
             page_size=page_size,
             num_pages=num_pages,
-            allocator=PageAllocator(num_pages, page_size, seq, max_batch),
+            allocator=make_page_allocator(num_pages, page_size, seq,
+                                          max_batch),
         )
         if hasattr(mod, "forward_ragged_prefill"):
             # packed ragged admission waves (ISSUE 11): one no-padding

@@ -397,6 +397,9 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
     lockchecks = _lockcheck_dumps(paths)
     if lockchecks:
         report["lockcheck_dumps"] = lockchecks
+    pagechecks = _pagecheck_dumps(paths)
+    if pagechecks:
+        report["pagecheck_dumps"] = pagechecks
     base_flight = flights[0][1] if flights else None
     test_flight = flights[-1][1] if flights else None
     if len(traces) >= 2:
@@ -443,6 +446,39 @@ def _lockcheck_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
                 "cycles": len(cycles),
                 "cycle_sites": [c.get("sites") for c in cycles],
                 "sites_tracked": len(dump.get("sites") or {}),
+            })
+    return out
+
+
+def _pagecheck_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Page-sanitizer dumps (``pagecheck_<node>.json``, ISSUE 13)
+    sitting next to the analyzed flight/trace files — the page twin of
+    the lockcheck listing above: the flight dump says what the node was
+    doing, the pagecheck dump says which page custody it violated doing
+    it. Listed with violation counts/kinds so a detected use-after-free
+    is never invisible in a report."""
+    seen: set = set()
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        if d in seen:
+            continue
+        seen.add(d)
+        for cand in sorted(glob.glob(os.path.join(d,
+                                                  "pagecheck_*.json"))):
+            try:
+                with open(cand, "r", encoding="utf-8") as f:
+                    dump = json.load(f)
+            except (OSError, ValueError):
+                continue
+            violations = dump.get("violations") or []
+            out.append({
+                "path": cand,
+                "node": dump.get("node"),
+                "violations": len(violations),
+                "violation_kinds": sorted(
+                    {v.get("kind") for v in violations}),
+                "pools": len(dump.get("pools") or []),
             })
     return out
 

@@ -34,9 +34,10 @@ follows the ragged paged attention design noted in PAPERS.md.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +45,78 @@ import numpy as np
 from ..utils.sync import make_lock
 
 PagedCache = Dict[str, jnp.ndarray]  # {"k", "v", "page_table"}
+
+
+def pagecheck_enabled() -> bool:
+    """Runtime page sanitizer flag (obs/pagecheck.py, ISSUE 13)."""
+    return os.environ.get("SWARMDB_PAGECHECK", "0") not in ("", "0")
+
+
+def make_page_allocator(num_pages: int, page_size: int, max_seq: int,
+                        batch: int, label: Optional[str] = None) -> Any:
+    """Allocator factory — the page-pool twin of ``utils/sync.py``'s
+    lock factory. Flag off (default): the plain :class:`PageAllocator`,
+    the *exact* object callers constructed before the sanitizer existed
+    (zero overhead, type identity pinned by tests/test_pagecheck.py).
+    ``SWARMDB_PAGECHECK=1``: the checked subclass that mirrors every
+    custody transition into the shadow registry."""
+    if pagecheck_enabled():
+        from ..obs import pagecheck
+
+        return pagecheck.CheckedPageAllocator(
+            num_pages, page_size, max_seq, batch, label=label)
+    return PageAllocator(num_pages, page_size, max_seq, batch)
+
+
+def make_sharded_page_allocator(pages_per_shard: int, n_shards: int,
+                                page_size: int, max_seq: int,
+                                batch: int,
+                                label: Optional[str] = None) -> Any:
+    if pagecheck_enabled():
+        from ..obs import pagecheck
+
+        return pagecheck.CheckedShardedPageAllocator(
+            pages_per_shard, n_shards, page_size, max_seq, batch,
+            label=label)
+    return ShardedPageAllocator(pages_per_shard, n_shards, page_size,
+                                max_seq, batch)
+
+
+#: canary pattern stamped into freed pages' K/V under the sanitizer —
+#: exactly representable in bf16/f32 (2^14), never produced by a real
+#: forward pass at sane scales
+CANARY_VALUE = -16384.0
+
+
+def canary_fill(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                page_ids: Sequence[int],
+                value: float = CANARY_VALUE
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Poison freed pages' device K/V with the canary (sanitizer-only
+    path — an eager scatter per reclaim batch; the flag-off path never
+    calls this)."""
+    ids = jnp.asarray(np.asarray(page_ids, np.int32))
+    k_pages = k_pages.at[:, ids].set(value)
+    v_pages = v_pages.at[:, ids].set(value)
+    return k_pages, v_pages
+
+
+def canary_check(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                 page_ids: Sequence[int],
+                 value: float = CANARY_VALUE) -> List[int]:
+    """Page ids whose canary was OVERWRITTEN between free and
+    re-allocation (a write-after-free landed in the pool). One host
+    sync per verified allocation — sanitizer-only path."""
+    ids = np.asarray(page_ids, np.int32)
+    if ids.size == 0:
+        return []
+    kc = np.asarray(jax.device_get(k_pages[:, ids]))
+    vc = np.asarray(jax.device_get(v_pages[:, ids]))
+    bad: List[int] = []
+    for i, p in enumerate(ids):
+        if not (np.all(kc[:, i] == value) and np.all(vc[:, i] == value)):
+            bad.append(int(p))
+    return bad
 
 
 def pages_per_slot(max_seq: int, page_size: int) -> int:
@@ -265,6 +338,11 @@ class PageAllocator:
         self._pending_free: List[int] = []  # slot ids retired, not yet flushed
         self._lock = make_lock("ops.paged_kv.PageAllocator._lock")
         self.batch = batch
+        # cumulative churn (page-grant / page-return counts): two int
+        # adds under the lock the public methods already hold — the
+        # /metrics per-lane churn counters read these off stats()
+        self.pages_allocated_total = 0
+        self.pages_freed_total = 0
         # pool generation: bumped by every reset(). Page ids held OUTSIDE
         # the allocator (the serving layer's rolling-KV registry) are only
         # valid within the generation they were handed out in — a reset
@@ -318,11 +396,13 @@ class PageAllocator:
             pages = self._take(slot_id, n)
             if pages is None:
                 return None
+            self.pages_allocated_total += len(pages)
             self._by_slot[slot_id] = _SlotPages(pages)
             row = np.zeros(self.maxp, np.int32)
             row[: len(pages)] = pages
             return row
 
+    # swarmlint: borrows[page]: prefix_pages
     def allocate_with_prefix(self, slot_id: int, prefix_pages: List[int],
                              n_fresh: int) -> Optional[np.ndarray]:
         """Row = ``prefix_pages`` (cache-custody pages the slot only
@@ -337,6 +417,7 @@ class PageAllocator:
             fresh = self._take(slot_id, n_fresh)
             if fresh is None:
                 return None
+            self.pages_allocated_total += len(fresh)
             self._by_slot[slot_id] = _SlotPages(fresh)
             row = np.zeros(self.maxp, np.int32)
             pages = list(prefix_pages) + fresh
@@ -356,6 +437,7 @@ class PageAllocator:
         """Return cache-evicted pages to the pool (prefix-cache eviction
         path; the caller guarantees no live slot references them)."""
         with self._lock:
+            self.pages_freed_total += len(page_ids)
             self._give(page_ids)
 
     def reserve(self, n: int) -> List[int]:
@@ -408,7 +490,18 @@ class PageAllocator:
             for slot_id in pending:
                 sp = self._by_slot.pop(slot_id, None)
                 if sp is not None:
+                    self.pages_freed_total += len(sp.pages)
                     self._give(list(reversed(sp.pages)))
+
+    def requeue_pending(self, pending: List[int]) -> None:
+        """Put a drained retirement batch BACK on the pending queue: the
+        caller's table-row zeroing dispatch failed, so the pages must
+        not be freed (their rows may still reference them) but must not
+        be forgotten either — the next admission round retries. Found
+        by swarmlint SWL801: a drained batch held across a raising
+        dispatch with no requeue leaked its pages forever."""
+        with self._lock:
+            self._pending_free[:0] = pending
 
     def flush_frees(self, page_table: jnp.ndarray) -> jnp.ndarray:
         """Zero retired slots' table rows on device, then free their pages.
@@ -418,7 +511,14 @@ class PageAllocator:
             return page_table
         rows = np.asarray(pending, np.int32)
         zeros = np.zeros((len(pending), self.maxp), np.int32)
-        page_table = set_page_table_rows(page_table, rows, zeros)
+        try:
+            page_table = set_page_table_rows(page_table, rows, zeros)
+        except Exception:
+            # the rows were never zeroed: freeing now would reopen the
+            # stale-table/reused-page race, dropping the batch would
+            # leak it (SWL801) — requeue for the next round
+            self.requeue_pending(pending)
+            raise
         self.release_taken(pending)
         return page_table
 
@@ -454,6 +554,8 @@ class PageAllocator:
                 "free_pages": len(self._free),
                 "live_slots": len(self._by_slot),
                 "page_size": self.page_size,
+                "pages_allocated_total": self.pages_allocated_total,
+                "pages_freed_total": self.pages_freed_total,
             }
 
     def reset(self) -> None:
@@ -583,4 +685,6 @@ class ShardedPageAllocator(PageAllocator):
                 "live_slots": len(self._by_slot),
                 "page_size": self.page_size,
                 "n_shards": self.n_shards,
+                "pages_allocated_total": self.pages_allocated_total,
+                "pages_freed_total": self.pages_freed_total,
             }

@@ -31,12 +31,32 @@ SURVEY §5.7); the automatic-prefix-caching pattern is noted in PAPERS.md.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 from collections import OrderedDict
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 from ..utils.sync import make_lock
+
+
+def make_prefix_lru(num_pages: int, page_size: int,
+                    manage_free: bool = True, pool: Any = None,
+                    label: Optional[str] = None) -> "PrefixLRU":
+    """Prefix-cache factory (the PrefixLRU half of the page sanitizer,
+    ISSUE 13). Flag off: the plain :class:`PrefixLRU`, exactly as
+    before (type identity pinned by tests/test_pagecheck.py).
+    ``SWARMDB_PAGECHECK=1``: the checked subclass whose pin/unpin/
+    register/evict events feed the shadow page registry — ``pool``
+    (the engine's checked PageAllocator) shares its pool shadow in
+    paged mode; dense mode registers its own."""
+    if os.environ.get("SWARMDB_PAGECHECK", "0") not in ("", "0"):
+        from ..obs import pagecheck
+
+        return pagecheck.CheckedPrefixLRU(
+            num_pages, page_size, manage_free=manage_free, pool=pool,
+            label=label)
+    return PrefixLRU(num_pages, page_size, manage_free=manage_free)
 
 
 def page_chains(tokens: Sequence[int], page_size: int,

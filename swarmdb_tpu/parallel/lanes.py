@@ -85,6 +85,14 @@ class _LaneAllocatorView:
             "page_size": per[0]["page_size"],
             "free_pages": sum(s.get("free_pages", 0) for s in per),
             "lanes": len(per),
+            "pages_allocated_total": sum(
+                s.get("pages_allocated_total", 0) for s in per),
+            "pages_freed_total": sum(
+                s.get("pages_freed_total", 0) for s in per),
+            # per-lane churn for the /metrics counters (ISSUE 13)
+            "churn_by_lane": [
+                (s.get("pages_allocated_total", 0),
+                 s.get("pages_freed_total", 0)) for s in per],
         }
 
 
@@ -437,6 +445,11 @@ def build_lane_group(
                 flight_dir=flight_dir,
             )
         eng._home_device = dev
+        # page sanitizer (SWARMDB_PAGECHECK=1): label the lane's pool so
+        # aliasing reports and the per-lane churn counters name lanes
+        pagecheck = getattr(eng.paged.allocator, "pagecheck", None)
+        if pagecheck is not None:
+            pagecheck.set_lane(f"lane{d}")
         if n > 1:
             # distinct per-lane slot PRNG rows: lanes replicate PARAMS
             # (same seed), but reusing the same slot keys would make

@@ -241,7 +241,8 @@ def build_sharded_paged(
         from jax.experimental.shard_map import shard_map
 
     from ..ops.layers import pallas_disabled
-    from ..ops.paged_kv import (ShardedPageAllocator, init_paged_kv_cache,
+    from ..ops.paged_kv import (init_paged_kv_cache,
+                                make_sharded_page_allocator,
                                 pages_per_slot)
 
     cfg, mesh, fam = sm.cfg, sm.mesh, _family(sm.cfg)
@@ -270,8 +271,8 @@ def build_sharded_paged(
     # per-shard pool block: local trash page + this shard's share
     per_shard = 1 + -(-kv_pool_tokens // (page_size * dp))
     num_pages = per_shard * dp
-    allocator = ShardedPageAllocator(per_shard, dp, page_size, max_seq,
-                                     max_batch)
+    allocator = make_sharded_page_allocator(per_shard, dp, page_size,
+                                            max_seq, max_batch)
 
     params_specs = jax.tree.map(lambda _: P(), sm.params)
 

@@ -37,27 +37,44 @@ def tmp_swarm(tmp_path):
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """With the lock sanitizer on (SWARMDB_LOCKCHECK=1 — the CI
-    `lockcheck` job runs the chaos/HA/partition suites this way), a
-    green suite that exercised an inversion cycle is still a FAILURE:
-    the chaos harnesses generate the hostile interleavings, this hook
-    makes them assert lock ordering, not just liveness. Tests that
-    provoke cycles deliberately (tests/test_lockcheck.py) reset the
-    registry in their fixture teardown, so anything left here was
-    exercised by production code paths."""
-    if os.environ.get("SWARMDB_LOCKCHECK", "0") in ("", "0"):
-        return
-    try:
-        from swarmdb_tpu.obs import lockcheck
-    except Exception:
-        return
-    cycles = lockcheck.registry().cycles()
-    if not cycles:
+    """With a runtime sanitizer on (SWARMDB_LOCKCHECK=1 /
+    SWARMDB_PAGECHECK=1 — the CI `lockcheck` and `pagecheck` jobs run
+    the chaos/HA/partition/ragged suites this way), a green suite that
+    exercised a violation is still a FAILURE: the chaos harnesses
+    generate the hostile interleavings, these hooks make them assert
+    lock ordering and page safety, not just liveness. Tests that
+    provoke violations deliberately (tests/test_lockcheck.py,
+    tests/test_pagecheck.py) reset the registries in their fixture
+    teardown, so anything left here was exercised by production code
+    paths."""
+    lines = []
+    if os.environ.get("SWARMDB_LOCKCHECK", "0") not in ("", "0"):
+        try:
+            from swarmdb_tpu.obs import lockcheck
+
+            cycles = lockcheck.registry().cycles()
+        except Exception:
+            cycles = []
+        if cycles:
+            lines.append("lock sanitizer detected inversion cycle(s):")
+            for c in cycles:
+                lines.append(
+                    "  " + " -> ".join(c["sites"] + [c["sites"][0]]))
+    if os.environ.get("SWARMDB_PAGECHECK", "0") not in ("", "0"):
+        try:
+            from swarmdb_tpu.obs import pagecheck
+
+            violations = pagecheck.registry().violations()
+        except Exception:
+            violations = []
+        if violations:
+            lines.append("page sanitizer detected violation(s):")
+            for v in violations:
+                lines.append(f"  [{v['kind']}] pool={v['pool']} "
+                             f"pages={v['pages']}: {v['message']}")
+    if not lines:
         return
     tr = session.config.pluginmanager.get_plugin("terminalreporter")
-    lines = ["lock sanitizer detected inversion cycle(s):"]
-    for c in cycles:
-        lines.append("  " + " -> ".join(c["sites"] + [c["sites"][0]]))
     if tr is not None:
         tr.write_line("")
         for line in lines:

@@ -1,0 +1,36 @@
+"""Seeded SWL803 double-free (+ SWL805 write-before-alloc) violations.
+
+Freeing the same handle twice puts its pages on the free list twice:
+two future allocations receive the same ids and alias each other's KV.
+SWL805 is the dual of use-after-free: a table row blessed with ids the
+pool has not granted yet.
+"""
+
+
+def plain_double_free(alloc):
+    pages = alloc.reserve(2)
+    alloc.add_free(pages)
+    alloc.add_free(pages)                     # EXPECT: SWL803
+
+
+def double_free_via_alias(alloc):
+    pages = alloc.reserve(2)
+    copy = list(pages)
+    alloc.add_free(pages)
+    alloc.add_free(copy)                      # EXPECT: SWL803
+
+
+def table_write_before_alloc(alloc, table, slot, rows):
+    set_page_table_rows(table, [slot], rows)  # EXPECT: SWL805
+    rows = alloc.allocate(slot, 4)
+    if rows is not None:
+        alloc.add_free(rows)
+
+
+def single_free_ok(alloc):
+    pages = alloc.reserve(2)
+    alloc.add_free(pages)
+
+
+def set_page_table_rows(table, rows, values):
+    return table

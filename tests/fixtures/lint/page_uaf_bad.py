@@ -1,0 +1,40 @@
+"""Seeded SWL802 use-after-free violations (pagelife family).
+
+Once a handle reaches a free sink it is dead: flowing it into a page-
+table write or any later call blesses pages another conversation may
+already own.
+"""
+
+
+def table_write_after_free(alloc, table, slot):
+    row = alloc.allocate(slot, 4)
+    if row is None:
+        return
+    alloc.add_free(row)
+    set_page_table_rows(table, [slot], row)   # EXPECT: SWL802
+
+
+def pass_on_after_free(alloc, engine):
+    pages = alloc.reserve(2)
+    alloc.add_free(list(pages))
+    engine.submit_resume(pages)               # EXPECT: SWL802
+
+
+def store_after_free(alloc, registry, slot):
+    pages = alloc.reserve(2)
+    alloc.add_free(pages)
+    registry[slot] = pages                    # EXPECT: SWL802
+
+
+def free_after_write_ok(alloc, table, slot):
+    row = alloc.allocate(slot, 4)
+    if row is None:
+        return
+    try:
+        set_page_table_rows(table, [slot], row)
+    finally:
+        alloc.add_free(row)
+
+
+def set_page_table_rows(table, rows, values):
+    return table

@@ -1,0 +1,66 @@
+"""Seeded SWL801 page-leak violations (pagelife family).
+
+A handle produced by the allocator/prefix-cache API must reach a free
+sink, registration, custody transfer, or heap escape on every path out
+— including exception paths across raising calls.
+"""
+
+
+def drop_on_floor(alloc):
+    alloc.reserve(4)                       # EXPECT: SWL801
+    return True
+
+
+def leak_via_observer(alloc):
+    pages = alloc.reserve(4)
+    return len(pages)                      # EXPECT: SWL801
+
+
+def leak_on_early_return(alloc, flag):
+    pages = alloc.evict_lru(2)
+    if flag:
+        return 0                           # EXPECT: SWL801
+    alloc.add_free(pages)
+    return 1
+
+
+def leak_on_raise(alloc, flag):
+    pending = alloc.take_pending_frees()
+    if flag:
+        raise RuntimeError("boom")         # EXPECT: SWL801
+    alloc.release_taken(pending)
+
+
+def leak_on_exception_path(alloc, table):
+    pending = alloc.take_pending_frees()   # EXPECT: SWL801
+    dispatch_zero_rows(table, pending)
+    alloc.release_taken(pending)
+
+
+def protected_exception_path_ok(alloc, table):
+    pending = alloc.take_pending_frees()
+    try:
+        dispatch_zero_rows(table, pending)
+    except Exception:
+        alloc.requeue_pending(pending)
+        raise
+    alloc.release_taken(pending)
+
+
+def none_guard_ok(alloc, slot):
+    row = alloc.allocate(slot, 4)
+    if row is None:
+        return None
+    alloc.add_free(row)
+    return slot
+
+
+def escape_ok(alloc, registry, slot):
+    pages = alloc.reserve(4)
+    registry[slot] = pages                 # heap escape: custody moves
+    return slot
+
+
+# swarmlint: borrows[page]: rows
+def dispatch_zero_rows(table, rows):
+    table.zero(rows)

@@ -1,0 +1,50 @@
+"""Pre-fix snapshots of the two REAL leak-on-exception findings the
+pagelife pass (SWL801) surfaced on this tree — kept verbatim-shaped so
+the checker re-detects what review missed for eleven PRs.
+
+1. ``Engine._admit``'s reclaim drained the retirement queue, then ran
+   the table-zeroing device dispatch, then freed the pages. A dispatch
+   failure (XLA error, chaos fault) left the drained batch in a local
+   that died with the exception: the pages were owned by nobody and
+   leaked from the pool forever. Fixed by requeueing the batch on the
+   allocator before re-raising (``PageAllocator.requeue_pending``).
+
+2. ``PageAllocator.flush_frees`` had the identical shape around
+   ``set_page_table_rows``.
+"""
+
+import numpy as np
+
+
+class _AdmitReclaimSnapshot:
+    """Shape of Engine._admit's reclaim before the fix."""
+
+    # swarmlint: borrows[page]: args
+    def _mirrored(self, call_id, *args):
+        raise NotImplementedError
+
+    def admit_reclaim(self, maxp):
+        pending = self.allocator.take_pending_frees()  # EXPECT: SWL801
+        if pending:
+            self._mirrored(
+                3,
+                np.asarray(pending, np.int32),
+                np.zeros((len(pending), maxp), np.int32),
+            )
+            self.allocator.release_taken(pending)
+
+
+def flush_frees_snapshot(alloc, page_table):
+    """Shape of PageAllocator.flush_frees before the fix."""
+    pending = alloc.take_pending_frees()               # EXPECT: SWL801
+    if not pending:
+        return page_table
+    rows = np.asarray(pending, np.int32)
+    zeros = np.zeros((len(pending), alloc.maxp), np.int32)
+    page_table = set_page_table_rows(page_table, rows, zeros)
+    alloc.release_taken(pending)
+    return page_table
+
+
+def set_page_table_rows(page_table, rows, values):
+    return page_table

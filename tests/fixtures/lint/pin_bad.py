@@ -1,0 +1,44 @@
+"""Seeded SWL804 pin-discipline violations (pagelife family).
+
+Every PrefixLRU.pin/match_and_pin needs an unpin/release or custody
+handoff on all paths out: a leaked pin permanently inflates
+evictable_count, which the pool backpressure gate trusts.
+"""
+
+
+def pin_leak_on_early_return(prefix, chains, prompt, flag):
+    hits = prefix.match_and_pin(chains, prompt)
+    if flag:
+        return []                          # EXPECT: SWL804
+    prefix.unpin(hits)
+    return hits
+
+
+def pin_dropped_on_floor(prefix, chains, prompt):
+    prefix.match_and_pin(chains, prompt)   # EXPECT: SWL804
+    return True
+
+
+def pin_leak_on_raise(prefix, pages, flag):
+    prefix.pin(pages)
+    if flag:
+        raise ValueError("bad wave")       # EXPECT: SWL804
+    prefix.unpin(pages)
+
+
+def pin_handoff_ok(prefix, chains, prompt, slot_pins, slot):
+    hits = prefix.match_and_pin(chains, prompt)
+    slot_pins[slot] = hits                 # retirement unpins later
+    return slot
+
+
+def pin_unpin_ok(prefix, pages):
+    prefix.pin(pages)
+    try:
+        use(pages)
+    finally:
+        prefix.unpin(pages)
+
+
+def use(pages):
+    return pages

@@ -630,6 +630,51 @@ def test_admin_lockcheck_endpoint(tmp_path, monkeypatch):
         lockcheck.registry().reset()
 
 
+def test_admin_pagecheck_endpoint(tmp_path, monkeypatch):
+    """GET /admin/pagecheck: 503 with the page sanitizer off (an empty
+    report would read as "no page bugs" when nothing watched); with
+    SWARMDB_PAGECHECK=1 it returns the per-pool shadow states +
+    violations, and /metrics grows the page-sanitizer lines
+    (ISSUE 13)."""
+    async def drive_off(client, db):
+        headers = await get_token(client, "admin", "pw")
+        r = await client.get("/admin/pagecheck", headers=headers)
+        assert r.status == 503
+
+    api_drive(drive_off, tmp_path)
+
+    monkeypatch.setenv("SWARMDB_PAGECHECK", "1")
+    from swarmdb_tpu.obs import pagecheck
+    from swarmdb_tpu.ops.paged_kv import make_page_allocator
+
+    pagecheck.registry().reset()
+    try:
+        alloc = make_page_allocator(9, 4, 16, 2, label="api-test")
+        alloc.pagecheck.set_lane("lane0")
+        assert alloc.allocate(0, 2) is not None
+
+        async def drive_on(client, db):
+            headers = await get_token(client, "admin", "pw")
+            r = await client.get("/admin/pagecheck", headers=headers)
+            assert r.status == 200
+            report = await r.json()
+            assert report["enabled"] is True
+            pool = next(p for p in report["pools"]
+                        if p["pool"] == "api-test")
+            assert pool["states"]["owned"] == 2
+            assert report["violations"] == []
+            m = await client.get("/metrics")
+            body = await m.text()
+            assert "swarmdb_page_violations_total 0" in body
+            assert 'swarmdb_page_state{state="owned"} 2' in body
+            assert ('swarmdb_page_churn_allocated_total{lane="lane0"} 2'
+                    in body)
+
+        api_drive(drive_on, tmp_path)
+    finally:
+        pagecheck.registry().reset()
+
+
 def test_worker_recycling_hook(tmp_path):
     """cfg.max_requests fires the recycle hook exactly once after the
     threshold (gunicorn max_requests counterpart)."""

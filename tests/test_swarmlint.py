@@ -51,6 +51,11 @@ def expected_findings(path: Path):
     "guarded_bad.py",           # inferred guarded-by (SWL303)
     "callback_lock_bad.py",     # callback-under-lock (SWL305)
     "lockwait_snapshot.py",     # wait-not-in-while (SWL304)
+    "pageleak_bad.py",          # page-leak incl. exception paths (SWL801)
+    "page_uaf_bad.py",          # page use-after-free (SWL802)
+    "page_doublefree_bad.py",   # double-free + write-before-alloc (SWL803/805)
+    "pin_bad.py",               # pin-discipline (SWL804)
+    "pagelife_snapshot.py",     # pre-fix engine/allocator leaks (SWL801)
 ])
 def test_each_family_detects_seeded_violations(name):
     path = FIXTURES / name
@@ -98,6 +103,63 @@ def test_lockwait_snapshot_reproduces_prefix_finding():
     assert "while" in findings[0].message
     fixed = analyze_file(str(REPO / "swarmdb_tpu" / "broker" / "local.py"))
     assert [f for f in fixed if f.rule == "SWL304"] == []
+
+
+def test_pagelife_snapshot_reproduces_real_findings():
+    """The pre-fix shapes of the two REAL SWL801 findings this pass
+    surfaced — Engine._admit's reclaim and PageAllocator.flush_frees
+    both freeing a drained retirement batch across an unprotected
+    raising dispatch — must be re-detected, and the FIXED in-tree code
+    (requeue_pending on the exception path) must stay clean."""
+    path = FIXTURES / "pagelife_snapshot.py"
+    findings = analyze_file(str(path))
+    assert {(f.rule, f.line) for f in findings} == {
+        ("SWL801", ln) for ln, _ in expected_findings(path)}
+    assert all("exception path" in f.message for f in findings)
+    for fixed in ("swarmdb_tpu/backend/engine.py",
+                  "swarmdb_tpu/ops/paged_kv.py"):
+        clean = analyze_file(str(REPO / fixed))
+        assert [f for f in clean if f.rule.startswith("SWL80")] == []
+
+
+def test_owns_borrows_directives_shape_ownership(tmp_path):
+    """owns[page] transfers ownership INTO the callee (caller reuse is
+    use-after-transfer); borrows[page] keeps the caller responsible
+    (an unannotated escape would silently discharge)."""
+    target = tmp_path / "owns_mod.py"
+    target.write_text(
+        "# swarmlint: owns[page]: pages\n"
+        "def consume(pages):\n"
+        "    free_all(pages)\n"
+        "\n"
+        "\n"
+        "def free_all(pages):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "def caller(alloc):\n"
+        "    pages = alloc.reserve(2)\n"
+        "    consume(pages)\n"
+        "    return pages          # use-after-transfer\n")
+    findings = analyze_file(str(target))
+    assert [f.rule for f in findings] == ["SWL802"]
+    assert "freed" in findings[0].message
+
+
+def test_parsed_ast_cache_reuses_source_objects(tmp_path):
+    """The shared parse cache (tooling-perf satellite): two analyses
+    of an unchanged file reuse one SourceFile; rewriting the file
+    invalidates the entry."""
+    from swarmdb_tpu.analysis.core import _parse_source
+
+    target = tmp_path / "cached.py"
+    target.write_text("x = 1\n")
+    first = _parse_source(str(target))
+    assert _parse_source(str(target)) is first
+    target.write_text("x = 2  # rewritten\n")
+    again = _parse_source(str(target))
+    assert again is not first
+    assert "rewritten" in again.text
 
 
 def test_swl302_cycle_joined_only_across_files(tmp_path):
@@ -261,5 +323,6 @@ def test_cli_module_smoke():
     for rule in ("SWL101", "SWL203", "SWL301", "SWL302", "SWL303",
                  "SWL304", "SWL305", "SWL401", "SWL501",
                  "SWL502", "SWL503", "SWL504", "SWL601", "SWL602",
-                 "SWL603"):
+                 "SWL603", "SWL801", "SWL802", "SWL803", "SWL804",
+                 "SWL805"):
         assert rule in proc.stdout
