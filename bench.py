@@ -1273,6 +1273,9 @@ def _bench_ha_partition(seconds: float) -> dict:
             "detector_budget_s": round(dead_s + 2 * suspect_s, 3),
             "producers": n_producers,
             "blast_radius": blast_radius,
+            # rebalance convergence as a first-class number (ISSUE 14):
+            # kill -> every orphaned partition re-seated
+            "rebalance_convergence_s": round(t_reseated - t_kill, 3),
             "partition_leadership": {
                 "partitions": parts,
                 "cluster_size": len(node_ids),
@@ -1530,6 +1533,410 @@ def bench_chaos_serve(seconds: float) -> dict:
     return result
 
 
+def bench_chaos_cluster_serve(seconds: float) -> dict:
+    """The converged drill (ISSUE 14): serving rides partition
+    leadership, at scale. A 5+-node partition-leadership cluster with a
+    hundreds-of-partitions topic, a supervised lane group serving
+    conversations whose lane pins are DERIVED from partition leadership
+    (backend/locality.py), mixed-priority closed-loop clients doing
+    acked produce + streamed decode per turn — then a kill of the
+    most-loaded non-controller node under full load. Records the
+    numbers neither PR 8 nor PR 10 could measure alone:
+
+    - ``acked_loss`` — acked-durable records missing after failover
+      (MUST be 0);
+    - ``blast_radius`` — fraction of trafficked partitions whose ack
+      stream stalled, bounded by the victim's share + one partition;
+    - ``rebalance_convergence_s`` — kill -> every orphaned partition
+      re-seated (plus the survivors' own converged-episode gauges);
+    - non-victim p95 TTFT inside the fault window vs steady state,
+      bounded by ``SWARMDB_BENCH_CCS_TTFT_FACTOR`` — conversations the
+      victim did NOT own must keep serving at steady-state latency;
+    - ``locality_consistent`` — after convergence every trafficked
+      conversation's shard hint, lane pin, and partition leader agree;
+      ``repins`` counts the deterministic re-pins of the victim's
+      conversations.
+
+    Runs clean under SWARMDB_LOCKCHECK=1 / SWARMDB_PAGECHECK=1 (the CI
+    ha-chaos job does both): any sanitizer violation fails the drill.
+    CPU wall-clock by design, like chaos_serve."""
+    n_lanes = _env("SWARMDB_BENCH_CHAOS_LANES", 2, int)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{n_lanes}".strip())
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from swarmdb_tpu.backend.engine import GenRequest, is_retryable_reason
+    from swarmdb_tpu.backend.locality import ConversationLocality
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.broker.base import LeaderChangedError
+    from swarmdb_tpu.models.configs import get_config
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+    from swarmdb_tpu.utils.hashing import stable_partition
+    from swarmdb_tpu.ha import build_local_cluster, tp_key, wait_until
+    from swarmdb_tpu.utils.xla_cache import enable_compile_cache
+
+    enable_compile_cache(os.environ.get(
+        "SWARMDB_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache")))
+    nodes_n = max(3, _env("SWARMDB_BENCH_CCS_NODES", 5, int))
+    parts = max(8, _env("SWARMDB_BENCH_CCS_PARTITIONS", 128, int))
+    conv_n = _env("SWARMDB_BENCH_CCS_CONVS", 32, int)
+    n_clients = _env("SWARMDB_BENCH_CCS_CLIENTS", 6, int)
+    ttft_factor = _env("SWARMDB_BENCH_CCS_TTFT_FACTOR", 4.0, float)
+    converge_budget = _env("SWARMDB_BENCH_CCS_CONVERGE_BUDGET_S", 10.0,
+                           float)
+    suspect_s = _env("SWARMDB_HA_SUSPECT_S", 0.3, float)
+    dead_s = _env("SWARMDB_HA_DEAD_S", 2 * suspect_s, float)
+    os.environ.setdefault("SWARMDB_HA_HEARTBEAT_S", "0.05")
+    new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16, int)
+    TOPIC = "conv"
+
+    group, _info = build_serving_engine(
+        get_config("tiny-debug"),
+        make_mesh(n_lanes, data=n_lanes, model=1, expert=1),
+        max_batch=2 * n_lanes, max_seq=128, paged=True, page_size=8,
+        decode_chunk=4)
+    if _env("SWARMDB_BENCH_PREWARM", 1, int) == 1:
+        group.warmup()
+    group.start()
+    sup = group.attach_supervisor(
+        suspect_s=2.0, quarantine_s=4.0, poll_s=0.1,
+        probe_timeout_s=60.0, deadline_s=120.0, retries=3)
+
+    node_ids = [f"cs-{i}" for i in range(nodes_n)]
+    harness, cluster, client = build_local_cluster(
+        node_ids, suspect_s=suspect_s, dead_s=dead_s,
+        partition_leadership=True)
+
+    convs = [f"conv-{i}" for i in range(conv_n)]
+    part_of = {c: stable_partition(c, parts) for c in convs}
+    trafficked = sorted(set(part_of.values()))
+
+    # conversation locality bound to the CONTROLLER's leadership index
+    # (cs-0 is never the kill victim); every node's observed rebalances
+    # feed the re-pin stream — duplicates are idempotent
+    controller = harness.nodes["cs-0"]
+    locality = ConversationLocality(
+        topic=TOPIC, n_lanes=n_lanes,
+        leadership=controller.assignment_of,
+        num_partitions=lambda: parts,
+        metrics=group.metrics, flight=group.flight)
+    for node in harness.nodes.values():
+        node.add_rebalance_listener(locality.on_rebalance)
+
+    acked: dict = {p: [] for p in trafficked}
+    acked_lock = threading.Lock()
+    stop = threading.Event()
+    stats = {"completed": 0, "acked_loss": 0, "client_retries": 0,
+             "retryable_raises": 0, "reasons": {}}
+    # (t_mono, partition, ttft_s) samples — classified into steady /
+    # fault windows after the fact, split victim vs non-victim
+    ttfts: list = []
+    ttft_lock = threading.Lock()
+
+    def client_worker(w: int) -> None:
+        mine = convs[w::n_clients]
+        if not mine:
+            return
+        i = 0
+        while not stop.is_set():
+            conv = mine[i % len(mine)]
+            p = part_of[conv]
+            payload = f"{conv}-m{i}-w{w}"
+            # acked produce: the conversation's log turn (retryable
+            # failures re-send the SAME payload — zero-loss contract)
+            produce_deadline = time.monotonic() + 20.0
+            while not stop.is_set():
+                try:
+                    off = client.append(TOPIC, p, payload.encode())
+                    if client.wait_durable(TOPIC, p, off, 2.0):
+                        with acked_lock:
+                            acked[p].append((time.monotonic(), payload))
+                        break
+                except LeaderChangedError:
+                    stats["retryable_raises"] += 1
+                    stop.wait(0.02)
+                if time.monotonic() > produce_deadline:
+                    break  # failover outlier: next turn retries
+            if stop.is_set():
+                return
+            # leadership-pinned serve: the lane hint follows the
+            # partition's CURRENT leader
+            retry_deadline = time.time() + 60.0
+            while True:
+                pin = locality.pin("user", conv)
+                done = threading.Event()
+                out: dict = {}
+                t_submit = time.monotonic()
+                first = [0.0]
+
+                def on_tok(rid, tok):
+                    if not first[0]:
+                        first[0] = time.monotonic() - t_submit
+
+                def on_done(rid, toks, reason):
+                    out["reason"] = reason
+                    done.set()
+
+                group.submit(GenRequest(
+                    prompt=[1 + (w % 7), 5, 9, 13 + (i % 7)],
+                    sampling=SamplingParams(max_new_tokens=new_tokens),
+                    priority=0 if w < n_clients // 2 else 3,
+                    shard_hint=pin.lane,
+                    on_token=on_tok, on_done=on_done))
+                if not done.wait(90):
+                    with ttft_lock:
+                        stats["acked_loss"] += 1  # hung stream = loss
+                    break
+                reason = out["reason"]
+                with ttft_lock:
+                    stats["reasons"][reason] = (
+                        stats["reasons"].get(reason, 0) + 1)
+                if reason in ("length", "eos"):
+                    with ttft_lock:
+                        stats["completed"] += 1
+                        ttfts.append((t_submit, p, first[0]))
+                    break
+                if is_retryable_reason(reason) and time.time() < retry_deadline:
+                    with ttft_lock:
+                        stats["client_retries"] += 1
+                    continue
+                with ttft_lock:
+                    stats["acked_loss"] += 1
+                break
+            i += 1
+
+    def probe_producer(p: int) -> None:
+        """Closed-loop acked-write probe on ONE trafficked partition:
+        the per-partition ack cadence the blast-radius gap detector
+        reads (serving turns alone are too sparse per partition to
+        distinguish a failover stall from an idle gap). Probe payloads
+        ride the same zero-loss audit as conversation turns."""
+        i = 0
+        while not stop.is_set():
+            payload = f"probe-p{p}-{i}"
+            try:
+                off = client.append(TOPIC, p, payload.encode())
+                if client.wait_durable(TOPIC, p, off, 2.0):
+                    with acked_lock:
+                        acked[p].append((time.monotonic(), payload))
+                    i += 1
+            except LeaderChangedError:
+                stats["retryable_raises"] += 1
+                stop.wait(0.02)
+            stop.wait(0.03)
+
+    window = max(6.0, min(seconds, 30.0))
+    threads = [threading.Thread(target=client_worker, args=(w,),
+                                daemon=True) for w in range(n_clients)]
+    threads += [threading.Thread(target=probe_producer, args=(p,),
+                                 daemon=True) for p in trafficked]
+    victim = None
+    victim_parts: set = set()
+    try:
+        wait_until(lambda: cluster.read()["leader"] == "cs-0", 5.0,
+                   what="bootstrap leader")
+        client.create_topic(TOPIC, parts)
+        wait_until(
+            lambda: len(cluster.read()["assignments"]) >= parts, 15.0,
+            what="partition assignment at scale")
+        for t in threads:
+            t.start()
+        time.sleep(window / 3)  # steady state under full serving load
+        counts: dict = {}
+        assigns = cluster.read()["assignments"]
+        for a in assigns.values():
+            counts[a["leader"]] = counts.get(a["leader"], 0) + 1
+        victim = max((n for n in node_ids if n != "cs-0"),
+                     key=lambda n: counts.get(n, 0))
+        victim_parts = {
+            int(k.rpartition(":")[2]) for k, a in assigns.items()
+            if a["leader"] == victim}
+        t_kill = time.monotonic()
+        harness.kill(victim)
+        wait_until(
+            lambda: all(
+                cluster.read()["assignments"][tp_key(TOPIC, p)]
+                ["leader"] != victim for p in victim_parts),
+            30.0, what="every orphaned partition re-seated")
+        t_reseated = time.monotonic()
+        reseat_s = t_reseated - t_kill
+        time.sleep(max(window / 3, 3.0))  # post-failover steady state
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+
+        # zero-loss audit, per trafficked partition, through the client
+        lost_total = 0
+        for p in trafficked:
+            survived = {r.value.decode()
+                        for r in client.fetch(TOPIC, p, 0, 1_000_000)}
+            with acked_lock:
+                lost_total += sum(1 for _, pay in acked[p]
+                                  if pay not in survived)
+        stats["acked_loss"] += lost_total
+
+        # blast radius over TRAFFICKED partitions (ack-stream stalls
+        # beyond the detector's dead threshold inside the fault window)
+        stalled = []
+        for p in trafficked:
+            with acked_lock:
+                times = [t for t, _ in acked[p]
+                         if t_kill - 0.5 <= t <= t_reseated + 2.5]
+            gaps = [b - a for a, b in zip(times, times[1:])]
+            if not times or (gaps and max(gaps) > dead_s):
+                stalled.append(p)
+        victim_trafficked = sorted(victim_parts & set(trafficked))
+        blast_radius = round(len(stalled) / len(trafficked), 4)
+        blast_bound = round(
+            (len(victim_trafficked) + 1) / len(trafficked), 4)
+
+        # TTFT classification: steady vs fault, victim- vs non-victim-
+        # owned conversations (ownership snapshot at kill time)
+        def pct(vals, q):
+            if not vals:
+                return None
+            vals = sorted(vals)
+            return round(
+                vals[min(len(vals) - 1, int(q / 100 * (len(vals) - 1)))],
+                4)
+
+        with ttft_lock:
+            samples = list(ttfts)
+        steady = [v for t, _, v in samples if t < t_kill]
+        fault_nonvictim = [v for t, p, v in samples
+                           if t_kill <= t <= t_reseated + 1.0
+                           and p not in victim_parts]
+        fault_victim = [v for t, p, v in samples
+                        if t_kill <= t <= t_reseated + 1.0
+                        and p in victim_parts]
+        steady_p95 = pct(steady, 95)
+        nonvictim_p95 = pct(fault_nonvictim, 95)
+        ttft_ok = None
+        if steady_p95 is not None and nonvictim_p95 is not None:
+            ttft_ok = bool(
+                nonvictim_p95 <= max(ttft_factor * steady_p95, 0.25))
+
+        # post-convergence locality agreement: every trafficked
+        # conversation's pin names the CURRENT leader and the lane
+        # derived from it
+        assigns = cluster.read()["assignments"]
+        mismatches = []
+        for conv in convs:
+            p = part_of[conv]
+            pin = locality.pin("user", conv)
+            a = assigns.get(tp_key(TOPIC, p), {})
+            want_lane = stable_partition(f"{p}@{a.get('leader')}",
+                                         n_lanes)
+            if pin.leader != a.get("leader") or pin.lane != want_lane:
+                mismatches.append(conv)
+        loc_stats = locality.stats()
+
+        # survivors' own converged-episode observations (the /metrics
+        # gauge): max over nodes that saw the episode close
+        node_convergences = [
+            n.last_convergence_s for nid, n in harness.nodes.items()
+            if nid != victim and n.last_convergence_s is not None]
+    finally:
+        stop.set()
+        sup.stop()
+        group.stop()
+        harness.stop()
+        client.close()
+
+    result = {
+        "metric": "chaos_cluster_serve_acked_loss",
+        "value": stats["acked_loss"],
+        "unit": "requests",
+        "mode": "chaos_cluster_serve",
+        "nodes": nodes_n,
+        "partitions": parts,
+        "lanes": n_lanes,
+        "clients": n_clients,
+        "conversations": conv_n,
+        "trafficked_partitions": len(trafficked),
+        "completed": stats["completed"],
+        "acked_loss": stats["acked_loss"],
+        "acked_total": sum(len(v) for v in acked.values()),
+        "retryable_raises": stats["retryable_raises"],
+        "client_retries": stats["client_retries"],
+        "finish_reasons": stats["reasons"],
+        "victim": victim,
+        "victim_partitions": len(victim_parts),
+        "victim_trafficked": len(victim_trafficked),
+        "blast_radius": blast_radius,
+        "blast_radius_bound": blast_bound,
+        "stalled_partitions": stalled,
+        "rebalance_convergence_s": round(reseat_s, 3),
+        "rebalance_convergence_bound_s": converge_budget,
+        "node_convergence_s": (round(max(node_convergences), 3)
+                               if node_convergences else None),
+        "p95_ttft_steady_s": steady_p95,
+        "p95_ttft_fault_nonvictim_s": nonvictim_p95,
+        "p95_ttft_fault_victim_s": pct(fault_victim, 95),
+        "ttft_factor_bound": ttft_factor,
+        "ttft_ok": ttft_ok,
+        "repins": loc_stats.get("repins", 0),
+        "locality_consistent": not mismatches,
+        "locality_mismatches": mismatches[:8],
+        "detector_suspect_s": suspect_s,
+        "detector_dead_s": dead_s,
+    }
+    # sanitizer harvest (satellite: the drill must run clean under both)
+    try:
+        from swarmdb_tpu.obs import lockcheck as _lc
+
+        if _lc.enabled():
+            result["lock_cycles"] = len(_lc.registry().cycles())
+    except Exception:
+        pass
+    try:
+        from swarmdb_tpu.obs import pagecheck as _pc
+
+        if _pc.enabled():
+            result["page_violations"] = len(_pc.registry().violations())
+    except Exception:
+        pass
+    problems = []
+    if stats["acked_loss"]:
+        problems.append(f"ACKED LOSS {stats['acked_loss']}")
+    if blast_radius > blast_bound + 1e-9:
+        problems.append(
+            f"blast radius {blast_radius} > bound {blast_bound}")
+    if ttft_ok is False:
+        problems.append(
+            f"non-victim p95 TTFT {nonvictim_p95}s > "
+            f"{ttft_factor}x steady {steady_p95}s")
+    sanitized = ("lock_cycles" in result or "page_violations" in result)
+    if ttft_ok is None and not sanitized:
+        # sanitizer runs decode ~10x slower: turns are too sparse to
+        # land samples inside a sub-second fault window, and the
+        # sanitizer pass's contract is loss==0 + violations==0 anyway
+        problems.append("no non-victim TTFT samples in the fault window")
+    if reseat_s > converge_budget:
+        problems.append(
+            f"rebalance convergence {reseat_s:.2f}s > budget "
+            f"{converge_budget}s")
+    if mismatches:
+        problems.append(f"{len(mismatches)} conversations' locality "
+                        "disagrees with partition leadership")
+    if result.get("lock_cycles"):
+        problems.append(f"{result['lock_cycles']} lock-inversion cycles")
+    if result.get("page_violations"):
+        problems.append(
+            f"{result['page_violations']} page-safety violations")
+    if problems:
+        result["error"] = "; ".join(problems)
+    return result
+
+
 _MODES = {
     "echo": bench_echo,
     "serve": bench_serve,
@@ -1540,6 +1947,7 @@ _MODES = {
     "longctx": bench_longctx,
     "ha": bench_ha,
     "chaos_serve": bench_chaos_serve,
+    "chaos_cluster_serve": bench_chaos_cluster_serve,
 }
 
 # dpserve is NOT here: it is a virtual-CPU-device measurement by design
@@ -1551,8 +1959,8 @@ _NEEDS_BACKEND = {"serve", "group", "tooluse", "swarm100", "longctx"}
 # (CPU-only, seconds of wall time, no TPU backend); longctx runs LAST:
 # it is the slowest warmup, so a cold-container budget squeeze sheds the
 # long-context line rather than the headline serve/tooluse records
-_ALL_MODES = ("echo", "ha", "chaos_serve", "serve", "group", "tooluse",
-              "swarm100", "dpserve", "longctx")
+_ALL_MODES = ("echo", "ha", "chaos_serve", "chaos_cluster_serve", "serve",
+              "group", "tooluse", "swarm100", "dpserve", "longctx")
 
 
 def _force_cpu() -> None:
@@ -1628,6 +2036,10 @@ _SUMMARY_KEYS = (
     ("loss", "acked_loss"),
     ("blast", "blast_radius"),
     ("wsx", "write_scaling_x"),
+    # converged drill (ISSUE 14): rebalance convergence is a first-class
+    # number next to blast_radius, and the non-victim TTFT bound verdict
+    ("conv", "rebalance_convergence_s"),
+    ("ttftok", "ttft_ok"),
 )
 
 

@@ -559,6 +559,15 @@ def create_app(
         if ha_node is None:
             raise _error(503, "this process runs without an HA node")
         out = await _run_sync(ha_node.status)
+        # partition_serving (ISSUE 14): the serving tier's conversation-
+        # locality view — conversations pinned per leader, leaderless
+        # count, local/remote split, and the re-pin total
+        loc = getattr(serving, "_locality", None)
+        if loc is not None:
+            try:
+                out["partition_serving"] = await _run_sync(loc.stats)
+            except Exception:
+                logger.exception("locality stats read failed")
         try:
             out["events"] = [
                 ev for ev in await _run_sync(ha_node.flight.events)
@@ -814,6 +823,40 @@ def create_app(
                     lines.append(
                         f"swarmdb_partition_leaderless "
                         f"{pl.get('leaderless', 0)}")
+                    # rebalance convergence (ISSUE 14): how long the
+                    # last orphan episode (kill -> every orphan
+                    # re-seated) took, as observed by this node — the
+                    # first-class number the scaled drills bound
+                    conv = pl.get("rebalance_convergence_s")
+                    if conv is not None:
+                        lines.append(
+                            "# TYPE swarmdb_rebalance_convergence_"
+                            "seconds gauge")
+                        lines.append(
+                            f"swarmdb_rebalance_convergence_seconds "
+                            f"{conv}")
+        # conversation locality (ISSUE 14): how many served
+        # conversations are pinned to a partition this node leads
+        # (local) vs a peer (remote) vs a leaderless partition
+        # mid-failover — remote > 0 on a converged cluster means the
+        # serving tier and the log ownership have drifted apart
+        loc = getattr(serving, "_locality", None)
+        if loc is not None:
+            try:
+                ls = await _run_sync(loc.stats)
+            except Exception:
+                logger.exception("locality stats read failed")
+                ls = None
+            if ls is not None:
+                lines.append("# TYPE swarmdb_conversation_locality gauge")
+                for state in ("local", "remote", "leaderless"):
+                    lines.append(
+                        f'swarmdb_conversation_locality'
+                        f'{{state="{state}"}} {ls.get(state, 0)}')
+                lines.append("# TYPE swarmdb_conversation_repins_total "
+                             "counter")
+                lines.append(f"swarmdb_conversation_repins_total "
+                             f"{ls.get('repins', 0)}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

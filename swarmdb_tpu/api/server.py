@@ -81,6 +81,9 @@ def build_ha_node():
         data_port=None if dport == "off" else int(dport),
         advertise_host=os.environ.get("SWARMDB_HA_ADVERTISE_HOST"),
         log_dir=log_dir,
+        # deployment entry point = cluster mode: partition leadership
+        # defaults ON here (SWARMDB_HA_PARTITION_LEADERSHIP overrides)
+        cluster_mode=True,
     )
     node.start(role=os.environ.get("SWARMDB_HA_ROLE", "follower"))
     return node
@@ -96,11 +99,13 @@ def build_db(ha_node=None) -> SwarmDB:
     )
     broker = None
     if ha_node is not None:
-        # the runtime writes through the node's CURRENT role facade:
-        # acks=all + fencing while leading, read-only mirror as follower
-        from ..ha.node import NodeBroker
-
-        broker = NodeBroker(ha_node)
+        # node-level mode: the per-call role facade (acks=all + fencing
+        # while leading, read-only mirror as follower). Partition mode
+        # (ISSUE 14): a per-partition-routing ClusterBroker whose opener
+        # short-circuits THIS node — every produce reaches the owning
+        # partition leader instead of fencing on the local facade, which
+        # is what lets partition leadership default ON for cluster nodes
+        broker = ha_node.client_broker()
     return SwarmDB(
         config=cfg,
         topic_name=os.environ.get("KAFKA_TOPIC", "swarm_messages"),
@@ -137,7 +142,7 @@ def _build_pod_engine(model_name: str):
     return engine, tokenizer
 
 
-def build_serving(db: SwarmDB, distributed: bool = False):
+def build_serving(db: SwarmDB, distributed: bool = False, ha_node=None):
     model_name = os.environ.get("SERVE_MODEL")
     if not model_name:
         return None
@@ -154,6 +159,9 @@ def build_serving(db: SwarmDB, distributed: bool = False):
         serving = ServingService(db, engine, tokenizer)
     else:
         serving = ServingService.from_model_name(db, model_name)
+    # conversation locality rides partition leadership (ISSUE 14): lane
+    # pins follow partition leaders and re-pin on rebalance events
+    serving.bind_partition_leadership(ha_node)
     if db.token_counter is None:
         # explicit wiring (not a constructor side effect): the deployment's
         # single backend tokenizer fills Message.token_count — the counter
@@ -218,7 +226,7 @@ def main() -> None:
         return
     ha_node = build_ha_node()
     db = build_db(ha_node=ha_node)
-    serving = build_serving(db, distributed=distributed)
+    serving = build_serving(db, distributed=distributed, ha_node=ha_node)
     cfg = ApiConfig.from_env()
     def _recycle() -> None:
         # worker recycling: SIGTERM ourselves; aiohttp drains in-flight

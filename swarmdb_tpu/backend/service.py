@@ -26,6 +26,7 @@ import json
 import logging
 import os
 import queue
+import random
 import threading
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
@@ -397,6 +398,11 @@ class ServingService:
         # fixed elision marker between head and tail — constant tokens, so
         # it can never destabilize the prefix
         self._anchor_sep = self.tokenizer.encode("\n[…]\n", add_bos=False)
+        # leadership-pinned conversation locality (ISSUE 14): attached by
+        # bind_partition_leadership when this process embeds an HA node
+        # running partition leadership — shard hints then come from the
+        # conversation's partition LEADER, not the bare pair hash
+        self._locality = None
         rolling_wanted = os.environ.get("SWARMDB_ROLLING_KV") == "1"
         if (rolling_wanted and self.engine.paged is not None
                 and getattr(self.engine.paged.allocator,
@@ -419,6 +425,32 @@ class ServingService:
             # conversations' kept pages instead of stalling/not rolling —
             # non-rolling traffic must never starve behind parked KV
             self.engine.on_pool_pressure = self._on_pool_pressure
+
+    def bind_partition_leadership(self, ha_node) -> None:
+        """Ride partition leadership (ISSUE 14): every conversation's
+        ``shard_hint`` is derived from its log partition's CURRENT
+        leader (``ConversationLocality``), and the lane group is
+        subscribed to the node's rebalance stream so a leadership move
+        (drain handover, failover promotion) deterministically re-pins
+        the conversation's lane — its anchor head and prefix pages
+        re-register on the new lane at the next turn, and ``ha.repin``
+        instants let the analyzer attribute TTFT spikes to leadership
+        churn. No-op unless the node runs partition leadership; without
+        a bind the PR 8 pair-hash hint is used, bit-identical."""
+        if ha_node is None or not getattr(ha_node, "partition_leadership",
+                                          False):
+            return
+        from .locality import ConversationLocality
+
+        n_lanes = (getattr(self.engine.paged.allocator, "n_shards", 1)
+                   if self.engine.paged is not None else 1)
+        self._locality = ConversationLocality(
+            topic=self.db.topic_name, n_lanes=n_lanes,
+            leadership=ha_node.assignment_of,
+            num_partitions=self.db.num_partitions,
+            local_node=ha_node.node_id,
+            metrics=self.db.metrics, flight=self.engine.flight)
+        ha_node.add_rebalance_listener(self._locality.on_rebalance)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1053,17 +1085,24 @@ class ServingService:
                 on_token=_tok, on_done=_done,
                 metadata={"message_id": msg.id},
             )
-            if (self.engine.paged is not None
-                    and getattr(self.engine.paged.allocator, "n_shards", 1)
-                    > 1):
+            n_shards = (getattr(self.engine.paged.allocator, "n_shards", 1)
+                        if self.engine.paged is not None else 1)
+            if self._locality is not None and msg.receiver_id:
+                # leadership-pinned locality (ISSUE 14): the lane pin
+                # follows the conversation's partition LEADER, so log
+                # ownership and serving compute coincide — and a
+                # leadership move re-pins deterministically (ha.repin)
+                lpin = self._locality.pin(msg.sender_id, msg.receiver_id)
+                if n_shards > 1:
+                    req.shard_hint = lpin.lane
+            elif n_shards > 1:
                 # DP-sharded pool: pin the conversation to one shard so
                 # its prefix-cache pages (same-shard-only reuse) stay
                 # hittable across turns — the order-insensitive pair key
                 # matches get_conversation's identity
                 pair = "|".join(sorted((msg.sender_id,
                                         msg.receiver_id or "")))
-                req.shard_hint = stable_partition(
-                    pair, self.engine.paged.allocator.n_shards)
+                req.shard_hint = stable_partition(pair, n_shards)
             if rolling_key is not None:
                 req.keep_pages = True
                 req.on_pages = (lambda rid, pages, written, tail,
@@ -1210,18 +1249,38 @@ class ServingService:
             self.engine.cancel(r)
 
     def _reply_loop(self) -> None:
-        """Drain completed generations into reply messages (worker thread)."""
+        """Drain completed generations into reply messages (worker thread).
+
+        Retryable produce failures (``LeaderChangedError`` from a
+        partition-routed broker mid-failover) get the PR 8 retry
+        treatment: bounded attempts (``SWARMDB_REPLY_RETRIES``) with
+        jittered exponential backoff off ``SWARMDB_RETRY_BACKOFF_S`` —
+        the failover re-seats the partition within the detector budget,
+        so the generated reply lands on the new leader instead of being
+        stranded as a FAILED message awaiting an admin resend."""
         emit_us = self.db.metrics.counters["phase_us_reply_emit"]
+        retries = _env_int("SWARMDB_REPLY_RETRIES", 3)
+        backoff = _env_float("SWARMDB_RETRY_BACKOFF_S", 0.05)
         while True:
             item = self._reply_queue.get()
             if item is None:
                 return
             msg, rid, tokens, reason, stop, lps, alts, on_done = item
             t0 = time.perf_counter()
-            try:
-                self._emit_reply(msg, tokens, reason, stop, lps, alts)
-            except Exception:
-                logger.exception("failed to emit reply for %s", msg.id)
+            for attempt in range(retries + 1):
+                try:
+                    self._emit_reply(msg, tokens, reason, stop, lps, alts)
+                    break
+                except Exception as exc:
+                    if (getattr(exc, "retryable", False)
+                            and attempt < retries
+                            and not self._stop.is_set()):
+                        self.db.metrics.counters["reply_retries"].inc()
+                        time.sleep(backoff * (2 ** attempt)
+                                   * (1.0 + random.random()))
+                        continue
+                    logger.exception("failed to emit reply for %s", msg.id)
+                    break
             # reply-emit phase accumulator (same family as the engine's
             # phase_us_*): decode + send_message + persistence hooks per
             # completion — the tooluse decomposition needs this visible

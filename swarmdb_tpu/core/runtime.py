@@ -175,6 +175,9 @@ class SwarmDB:
         self._last_save_time = time.time()
         self._sends_since_save = 0
         self._prescale_ends: Dict[int, int] = {}
+        # (count, monotonic expiry) — see num_partitions(); benign-racy
+        # tuple store, invalidated on partition growth
+        self._nparts_cache: Tuple[int, float] = (0, 0.0)
         self._closed = False
 
         # incremental stats (replaces full scans at ` main.py:973-1024`);
@@ -223,11 +226,29 @@ class SwarmDB:
     def _ensure_topics_exist(self) -> None:
         """Create base + error topics (reference ` main.py:239-293`:
         base topic with N partitions & 7-day retention, `{base}_errors` with
-        1 partition & 2x retention)."""
-        self.broker.create_topic(
-            self.topic_name, self.config.num_partitions, self.config.retention_ms
-        )
-        self.broker.create_topic(self.error_topic, 1, self.config.retention_ms * 2)
+        1 partition & 2x retention).
+
+        Cluster bring-up (ISSUE 14): with a partition-routed broker the
+        create is an admin op against the CONTROLLER, and a runtime
+        booting alongside its cluster can race the first promotion —
+        retryable failures (LeaderChangedError) are retried with backoff
+        for a bounded window (``SWARMDB_TOPIC_WAIT_S``) instead of
+        failing the whole runtime on a leaderless instant."""
+        deadline = time.monotonic() + float(
+            os.environ.get("SWARMDB_TOPIC_WAIT_S", "10"))
+        while True:
+            try:
+                self.broker.create_topic(
+                    self.topic_name, self.config.num_partitions,
+                    self.config.retention_ms)
+                self.broker.create_topic(self.error_topic, 1,
+                                         self.config.retention_ms * 2)
+                return
+            except Exception as exc:
+                if (not getattr(exc, "retryable", False)
+                        or time.monotonic() >= deadline):
+                    raise
+                time.sleep(0.1)
 
     def _count_tokens(self, content: MessageContent) -> Optional[int]:
         """Pluggable token counting (reference ` main.py:295-307`):
@@ -246,11 +267,29 @@ class SwarmDB:
         """Canonical key for the unicast conversation index."""
         return (a, b) if a <= b else (b, a)
 
+    def num_partitions(self) -> int:
+        """Partition count of the base topic, TTL-cached (~1 s).
+
+        With a cluster-routed broker (ISSUE 14) ``list_topics`` is a
+        control-plane round trip — paying it on EVERY send (partition
+        routing + broadcast fan-out both need the count) would put the
+        controller on the produce hot path. Partition count only ever
+        grows, and growth through this runtime invalidates the cache
+        immediately (``auto_scale_partitions``); cross-process growth is
+        picked up within the TTL — the same bounded-staleness window
+        concurrent processes already have between create and re-pin."""
+        num, expires = self._nparts_cache
+        now = time.monotonic()
+        if num and now < expires:
+            return num
+        num = self.broker.list_topics()[self.topic_name].num_partitions
+        self._nparts_cache = (num, now + 1.0)
+        return num
+
     def _get_partition(self, agent_id: str) -> int:
         """Stable agent → partition mapping (fixes defect D6;
         reference ` main.py:309-312`)."""
-        num = self.broker.list_topics()[self.topic_name].num_partitions
-        return stable_partition(agent_id, num)
+        return stable_partition(agent_id, self.num_partitions())
 
     # --------------------------------------------------------------- registry
 
@@ -446,8 +485,7 @@ class SwarmDB:
                         on_delivery=self._delivery_callback,
                     )
                 else:
-                    num = self.broker.list_topics()[
-                        self.topic_name].num_partitions
+                    num = self.num_partitions()
                     for p in range(num):
                         self.producer.produce(
                             self.topic_name, payload, key=key, partition=p,
@@ -1123,6 +1161,7 @@ class SwarmDB:
             }
             self._prescale_ends.update({p: 0 for p in range(current, recommended)})
             self.broker.create_partitions(self.topic_name, recommended)
+            self._nparts_cache = (0, 0.0)  # growth visible to next send
             self._reassign_consumers()
             logger.info("scaled partitions %d -> %d", current, recommended)
             return recommended

@@ -20,8 +20,13 @@ automatically recovering cluster (ISSUE 4). Pieces:
   the tests and ``bench.py``'s HA mode.
 - ``partition`` — partition-level leadership (ISSUE 10): leases,
   partition-scoped fencing + replication, quorum durability, spread
-  policy. Enabled per node via ``partition_leadership=True`` /
-  ``SWARMDB_HA_PARTITION_LEADERSHIP=1``.
+  policy. Enabled per node via ``partition_leadership=True``; since
+  ISSUE 14 the DEFAULT for cluster-mode entry points (node CLI,
+  api/server.py), with ``SWARMDB_HA_PARTITION_LEADERSHIP`` overriding.
+- ``lindex``   — LeadershipIndex (ISSUE 14): incrementally-maintained
+  leadership/orphan views off the cluster map's mutation journal, so
+  the spread/shed/orphan policies and the serving tier's conversation
+  locality pay O(moved partitions) per decision, not O(all).
 """
 
 from .chaos import ChaosHarness, build_local_cluster, wait_until
@@ -32,6 +37,7 @@ from .cluster import (ClusterMap, FileClusterMap, InMemoryClusterMap,
 from .dataplane import DataPlaneServer, RemoteBroker
 from .detector import (DetectorState, FailureDetector, LivenessServer,
                        probe_ends, probe_liveness)
+from .lindex import LeadershipIndex
 from .node import ClusterUnreachableError, HANode, NodeBroker
 from .partition import (PartitionLeases, PartitionReplicatedBroker,
                         spread_score)
@@ -45,5 +51,6 @@ __all__ = [
     "DetectorState", "FailureDetector", "LivenessServer", "probe_liveness",
     "probe_ends",
     "ClusterUnreachableError", "HANode", "NodeBroker",
+    "LeadershipIndex",
     "PartitionLeases", "PartitionReplicatedBroker", "spread_score",
 ]

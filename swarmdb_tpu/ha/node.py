@@ -33,12 +33,19 @@ Promotion ("highest epoch wins", single winner):
    (``F`` frames / :class:`~swarmdb_tpu.broker.base.FencedError`) until
    re-seeded and restarted as a follower (see the README runbook).
 
-Partition-level leadership (ISSUE 10, ``partition_leadership=True`` /
-``SWARMDB_HA_PARTITION_LEADERSHIP=1``) layers a second, finer role
-machine on top: the node-level leader stays on as the CONTROLLER (admin
-ops, assignment of new topics), while every ``(topic, partition)`` gets
-its own leader from the cluster map's epoch-versioned ``assignments``
-table. Each node then runs:
+Partition-level leadership (ISSUE 10, ``partition_leadership=True``;
+since ISSUE 14 the DEFAULT for cluster-mode entry points — this CLI and
+``api/server.py`` — with ``SWARMDB_HA_PARTITION_LEADERSHIP`` overriding
+either way) layers a second, finer role machine on top: the node-level
+leader stays on as the CONTROLLER (admin ops, assignment of new
+topics), while every ``(topic, partition)`` gets its own leader from
+the cluster map's epoch-versioned ``assignments`` table. The node's
+policy loops (assignment spread, anti-entropy shed, orphan sweep) run
+off an incrementally-maintained :class:`~swarmdb_tpu.ha.lindex
+.LeadershipIndex` — O(moved partitions) per decision, which is what
+lets the drills scale to 5-9 nodes and hundreds of partitions — and an
+embedded runtime writes through :meth:`HANode.client_broker`, which
+routes each produce to that partition's leader. Each node then runs:
 
 - a :class:`~swarmdb_tpu.ha.partition.PartitionReplicatedBroker` facade
   — per-partition fencing on appends, partition-filtered replication to
@@ -90,6 +97,7 @@ from .detector import (DetectorState, FailureDetector, LivenessServer,
                        dead_s_default, probe_ends, probe_liveness,
                        suspect_s_default)
 from ..utils.sync import make_lock, make_rlock
+from .lindex import LeadershipIndex
 from .partition import (PartitionReplicatedBroker, is_internal_topic,
                         partition_leadership_default, spread_moves_default,
                         spread_score)
@@ -118,14 +126,18 @@ class HANode:
                  dead_s: Optional[float] = None,
                  promotion: Optional[str] = None,
                  partition_leadership: Optional[bool] = None,
+                 cluster_mode: bool = False,
                  flight: Optional[FlightRecorder] = None,
                  log_dir: str = "") -> None:
         self.node_id = node_id
         self.broker = broker
         self.cluster = cluster
+        # cluster_mode: set by the deployment entry points (the node CLI
+        # and api/server.py) — partition leadership defaults ON there
+        # (ISSUE 14); in-process harnesses keep the node-level default
         self.partition_leadership = (
             partition_leadership if partition_leadership is not None
-            else partition_leadership_default())
+            else partition_leadership_default(cluster_mode))
         self._listen_host = listen_host
         self._replica_port = replica_port
         self._liveness_port = liveness_port
@@ -144,7 +156,7 @@ class HANode:
         self.log_dir = log_dir
 
         self._lock = make_rlock("ha.node.HANode._lock")
-        # swarmlint: guarded-by[self._lock]: _role, _epoch, _leader_broker
+        # swarmlint: guarded-by[self._lock]: _role, _epoch, _leader_broker, _orphan_since, _orphan_peak
         self._role = "follower"
         self._epoch = read_log_epoch(broker)
         self._leader_broker: Optional[ReplicatedBroker] = None
@@ -171,6 +183,28 @@ class HANode:
         self._sweeping = threading.Event()  # one orphan sweep at a time
         self._shed_tick = 0
         self.spread_moves = spread_moves_default()
+
+        # incrementally-maintained leadership views (ISSUE 14): the
+        # spread/shed/orphan policies decide off this index instead of
+        # re-scanning the full assignment table; per-assignment
+        # reconciliation (leases, fencing floors, rebalance fan-out)
+        # rides its change listener, so a tick with nothing moved is
+        # O(cluster size + own leaderships)
+        self._index = LeadershipIndex()
+        self._index.add_listener(self._on_assignment_change)
+        # controller worklist: never-assigned partitions, fed by
+        # _on_topic_created + a low-frequency topic-listing backstop
+        # swarmlint: guarded-by[self._unassigned_lock]: _unassigned
+        self._unassigned_lock = make_lock("ha.node.HANode._unassigned_lock")
+        self._unassigned: set = set()
+        self._assign_tick = 0
+        # serving-tier locality subscribers (backend/locality.py)
+        self._rebalance_listeners: List[Any] = []
+        # rebalance-convergence episode tracking (first orphan observed
+        # -> orphan set empty), the bench/metrics first-class number
+        self._orphan_since: Optional[float] = None
+        self._orphan_peak = 0
+        self.last_convergence_s: Optional[float] = None
 
     # ------------------------------------------------------------ chaos hooks
 
@@ -265,9 +299,12 @@ class HANode:
         if self.partition_leadership:
             # seed replication targets / quorum size / peer detectors
             # from the map NOW — the first appends must not race the
-            # first watch tick into single-copy quorums
+            # first watch tick into single-copy quorums. The initial
+            # index sync is a full resync: the change listener replays
+            # every assignment (leases + fencing floors seeded).
             try:
-                self._reconcile_partitions(self._read_map())
+                self._sync_index()
+                self._reconcile_partitions()
             except Exception:
                 logger.exception("initial partition reconcile failed")
         t = threading.Thread(target=self._watch_loop, daemon=True,
@@ -339,6 +376,96 @@ class HANode:
                 return self._pbroker
             return self._leader_broker or self.broker
 
+    def client_broker(self) -> Broker:
+        """What an EMBEDDED runtime should write through (ISSUE 14).
+
+        Node-level mode: the per-call role-facade proxy (NodeBroker) —
+        unchanged, bit-identical to PR 4. Partition mode: a
+        per-partition-routing :class:`~swarmdb_tpu.ha.client
+        .ClusterBroker` whose opener short-circuits THIS node to its own
+        facade (local writes for partitions we lead cost one dict
+        lookup) and dials peers' data planes for the rest. This is the
+        wiring that lets partition leadership default ON for cluster
+        nodes: every produce reaches the partition's owning leader
+        instead of fencing on the local facade, and a mid-failover write
+        surfaces as the retryable ``LeaderChangedError`` the runtime's
+        resend path already understands."""
+        if not self.partition_leadership:
+            return NodeBroker(self)
+        from .client import ClusterBroker, data_plane_opener
+
+        remote = data_plane_opener()
+
+        def _open(node_id: str, info: Dict[str, Any]) -> Broker:
+            if node_id == self.node_id:
+                return NodeBroker(self)
+            return remote(node_id, info)
+
+        return ClusterBroker(self.cluster, _open, owns_inner=True)
+
+    # ---------------------------------------------- leadership index views
+
+    def assignment_of(self, key: str) -> Optional[Dict[str, Any]]:
+        """Current assignment entry ``{"leader", "epoch"}`` for a
+        ``"topic:partition"`` key, from the incrementally-synced index
+        (O(1)); None while unassigned/unknown. The serving tier's
+        conversation locality derives lane pins from this."""
+        return self._index.entry(key)
+
+    def add_rebalance_listener(self, cb) -> None:
+        """``cb(key, entry_or_None)`` fires on every assignment change
+        this node OBSERVES (assign/failover/shed/deposal — regardless of
+        which node acted): the serving tier re-pins conversation
+        locality off this stream. Listeners must be fast and must not
+        raise (exceptions are swallowed and logged)."""
+        self._rebalance_listeners.append(cb)
+
+    def _notify_rebalance(self, key: str,
+                          entry: Optional[Dict[str, Any]]) -> None:
+        for cb in self._rebalance_listeners:
+            try:
+                cb(key, entry)
+            except Exception:
+                logger.exception("rebalance listener failed for %s", key)
+
+    def _sync_index(self):
+        """Pull map changes into the leadership index (isolation-gated
+        like every other map access) and track orphan-episode
+        convergence. Assignment-change side effects (lease grants/
+        revocations, fencing floors, rebalance fan-out) fire from the
+        index listener on this thread."""
+        if self._isolated:
+            raise ClusterUnreachableError(self.node_id)
+        res = self._index.sync(self.cluster)
+        self._track_convergence()
+        return res
+
+    def _track_convergence(self) -> None:
+        """Rebalance convergence as a first-class number (ISSUE 14): an
+        episode opens when this node first observes orphaned partitions
+        and closes when the orphan set drains — the elapsed time is what
+        the scaled drills bound and /metrics exports."""
+        n = self._index.orphan_count()
+        with self._lock:
+            if n:
+                if self._orphan_since is None:
+                    self._orphan_since = time.monotonic()
+                    self._orphan_peak = n
+                else:
+                    self._orphan_peak = max(self._orphan_peak, n)
+                return
+            if self._orphan_since is None:
+                return
+            elapsed = time.monotonic() - self._orphan_since
+            peak = self._orphan_peak
+            self._orphan_since = None
+            self.last_convergence_s = round(elapsed, 4)
+        self._record("rebalance_converged", {
+            "elapsed_s": round(elapsed, 4), "orphans_peak": peak})
+        TRACER.instant("ha.rebalance", cat="ha", args={
+            "action": "converged", "node": self.node_id,
+            "elapsed_s": round(elapsed, 4), "orphans_peak": peak})
+
     def status(self) -> Dict[str, Any]:
         """Control-plane status (the /admin/ha + /metrics surface)."""
         with self._lock:
@@ -397,6 +524,9 @@ class HANode:
                 row["replica_lag"] = lag[key]["replica_lag"]
                 row["end"] = lag[key]["end"]
             partitions[key] = row
+        with self._lock:
+            converging = self._orphan_since is not None
+            convergence = self.last_convergence_s
         return {
             "enabled": True,
             "leases": pb.leases.count(),
@@ -404,6 +534,11 @@ class HANode:
             "leaderless": leaderless,
             "partitions": partitions,
             "replication": pb.replication_stats(),
+            # rebalance-convergence episode view (ISSUE 14): the gauge
+            # /metrics exports and the scaled drills bound
+            "rebalancing": converging,
+            "rebalance_convergence_s": convergence,
+            "orphans": self._index.orphan_count(),
         }
 
     def _catchup_total(self) -> int:
@@ -454,54 +589,88 @@ class HANode:
 
     def _on_topic_created(self, name: str, parts: int) -> None:
         """Controller hook: assign a freshly created topic's partitions
-        across live nodes right away (the watch-loop pass is the
-        backstop for topics created elsewhere)."""
+        across live nodes right away (the low-frequency topic-listing
+        backstop in :meth:`_assign_unassigned` covers topics created
+        elsewhere)."""
         if not self.partition_leadership or self.role != "leader":
             return
         try:
-            state = self._read_map()
+            self._sync_index()
         except ClusterUnreachableError:
             return
-        self._assign_unassigned(state)
+        adds = [tp_key(name, p) for p in range(parts)
+                if self._index.entry(tp_key(name, p)) is None]
+        with self._unassigned_lock:
+            self._unassigned.update(adds)
+        self._assign_unassigned()
 
-    def _assign_unassigned(self, state: Dict[str, Any]) -> None:
-        """Controller: give every never-assigned partition (epoch 0) a
-        leader, least-loaded live node first with deterministic spread
-        tie-breaks. Orphans (epoch > 0, leader gone) are NOT handled
-        here — they need catch-up ranking, the orphan sweep's job."""
-        nodes = sorted(state.get("nodes", {}))
-        if not nodes:
-            return
-        assigns = state.get("assignments", {})
-        counts = {nid: 0 for nid in nodes}
-        for a in assigns.values():
-            if a.get("leader") in counts:
-                counts[a["leader"]] += 1
+    def _refresh_unassigned(self) -> None:
+        """Authoritative recompute of the controller's never-assigned
+        worklist from the local topic table — the backstop for topics
+        whose creation replicated in via T frames (no _on_topic_created
+        fires here). Amortized: called every ~16 controller ticks, not
+        per decision."""
         try:
             topics = self.broker.list_topics()
         except Exception:
             return
-        for name, meta in sorted(topics.items()):
+        fresh = set()
+        for name, meta in topics.items():
             if is_internal_topic(name):
                 continue
             for p in range(meta.num_partitions):
                 key = tp_key(name, p)
-                if int(assigns.get(key, {}).get("epoch", 0)) > 0:
-                    continue
-                target = min(nodes, key=lambda n: (
-                    counts[n], -spread_score(name, p, n)))
-                if self.cluster.try_promote_partition(
-                        name, p, target, 1, expect_epoch=0):
-                    counts[target] += 1
-                    assigns[key] = {"leader": target, "epoch": 1}
-                    if target == self.node_id and self._pbroker is not None:
-                        self._pbroker.leases.grant(name, p, 1)
-                    self._record("rebalance", {
-                        "action": "assign", "partition": key,
-                        "leader": target, "epoch": 1})
-                    TRACER.instant("ha.rebalance", cat="ha", args={
-                        "action": "assign", "partition": key,
-                        "leader": target, "epoch": 1})
+                if self._index.entry(key) is None:
+                    fresh.add(key)
+        with self._unassigned_lock:
+            self._unassigned = fresh
+
+    def _assign_unassigned(self) -> None:
+        """Controller: give every never-assigned partition a leader,
+        least-loaded live node first with deterministic spread
+        tie-breaks. The worklist is the incrementally-fed
+        ``_unassigned`` set and the load view is the index's
+        leadership counts — O(unassigned + cluster size) per pass, not
+        a full assignment-table scan (ISSUE 14). Orphans (epoch > 0,
+        leader gone) are NOT handled here — they need catch-up ranking,
+        the orphan sweep's job."""
+        self._assign_tick += 1
+        if self._assign_tick % 16 == 1:
+            self._refresh_unassigned()
+        with self._unassigned_lock:
+            todo = sorted(self._unassigned)
+        if not todo:
+            return
+        counts = self._index.leadership_counts()
+        nodes = sorted(counts)
+        if not nodes:
+            return
+        for key in todo:
+            if self._index.entry(key) is not None:
+                with self._unassigned_lock:
+                    self._unassigned.discard(key)
+                continue
+            name, p = parse_tp_key(key)
+            target = min(nodes, key=lambda n: (
+                counts[n], -spread_score(name, p, n)))
+            won = False
+            try:
+                won = self.cluster.try_promote_partition(
+                    name, p, target, 1, expect_epoch=0)
+            except Exception:
+                logger.exception("assignment CAS failed for %s", key)
+            if won:
+                counts[target] += 1
+                with self._unassigned_lock:
+                    self._unassigned.discard(key)
+                if target == self.node_id and self._pbroker is not None:
+                    self._pbroker.leases.grant(name, p, 1)
+                self._record("rebalance", {
+                    "action": "assign", "partition": key,
+                    "leader": target, "epoch": 1})
+                TRACER.instant("ha.rebalance", cat="ha", args={
+                    "action": "assign", "partition": key,
+                    "leader": target, "epoch": 1})
 
     def _on_peer_dead(self, peer: str) -> None:
         """A peer's detector confirmed DEAD (beats and probes both
@@ -545,16 +714,15 @@ class HANode:
                 if self._stop.is_set():
                     return
                 try:
-                    state = self._read_map()
+                    self._sync_index()
                 except ClusterUnreachableError:
                     self._stop.wait(self.suspect_s)
                     continue
-                nodes = state.get("nodes", {})
-                orphans = [
-                    (key, a) for key, a in
-                    sorted(state.get("assignments", {}).items())
-                    if a.get("leader") not in nodes
-                ]
+                # the index maintains the orphan set incrementally
+                # (O(victim's partitions) when a node deregisters) —
+                # the sweep's worklist is a copy of it, not a scan
+                nodes = self._index.nodes()
+                orphans = self._index.orphans()
                 if not orphans:
                     return
                 # candidate views: per-partition ends of every LIVE node
@@ -615,14 +783,67 @@ class HANode:
         finally:
             self._sweeping.clear()
 
-    def _reconcile_partitions(self, state: Dict[str, Any]) -> None:
-        """Watch-loop duty in partition mode: converge local state onto
-        the map — replication targets, per-peer detectors, lease
-        grants/revocations, and the replica server's fencing floors."""
+    def _on_assignment_change(self, key: str,
+                              entry: Optional[Dict[str, Any]]) -> None:
+        """Index change listener: fires exactly once per applied
+        assignment change (and for every key on a full resync), on
+        whichever thread synced — this is where per-assignment
+        reconciliation lives now, so a watch tick with nothing moved
+        does ZERO per-partition work (ISSUE 14)."""
+        with self._unassigned_lock:
+            self._unassigned.discard(key)
+        if self.partition_leadership:
+            try:
+                self._reconcile_assignment(key, entry)
+            except Exception:
+                logger.exception("assignment reconcile failed for %s", key)
+        self._notify_rebalance(key, entry)
+
+    def _reconcile_assignment(self, key: str,
+                              entry: Optional[Dict[str, Any]]) -> None:
+        """Converge local lease + fencing-floor state onto ONE
+        assignment entry (None = dropped from the table)."""
         pb = self._pbroker
         if pb is None:
             return
-        nodes = state.get("nodes", {})
+        topic, part = parse_tp_key(key)
+        if entry is None:
+            # leased but no longer in the table at all (topic dropped)
+            pb.leases.revoke(topic, part)
+            return
+        epoch = int(entry.get("epoch", 0))
+        if self._replica_server is not None:
+            self._replica_server.note_partition_epoch(topic, part, epoch)
+        held = pb.leases.epoch_of(topic, part)
+        if entry.get("leader") == self.node_id:
+            if held != epoch:
+                # the lease implies the topic: a T frame may not have
+                # arrived yet (assignment raced replication), and a
+                # leader without the topic would refuse its appends
+                self._ensure_local_partition(topic, part)
+                pb.leases.grant(topic, part, epoch)
+        elif held is not None:
+            # deposed (failover or a rebalance move): fence ONLY this
+            # lease; our other partitions keep writing
+            pb.leases.revoke(topic, part, fenced_epoch=epoch)
+            self._record("partition_deposed", {
+                "topic": topic, "partition": part,
+                "new_leader": entry.get("leader"), "epoch": epoch})
+            TRACER.instant("ha.rebalance", cat="ha", args={
+                "action": "deposed", "node": self.node_id,
+                "partition": key, "new_leader": entry.get("leader"),
+                "epoch": epoch})
+
+    def _reconcile_partitions(self) -> None:
+        """Watch-loop duty in partition mode, index-driven (ISSUE 14):
+        replication targets, per-peer detectors, self-heal registration,
+        and the own-lease backstop — O(cluster size + own leaderships)
+        per tick. Per-assignment lease/floor reconciliation happens in
+        :meth:`_on_assignment_change` for exactly the CHANGED entries."""
+        pb = self._pbroker
+        if pb is None:
+            return
+        nodes = self._index.nodes()
         # replication streams + ack quorum follow the registered peers
         pb.sync_targets(
             info.get("replica_addr") for nid, info in nodes.items()
@@ -645,40 +866,24 @@ class HANode:
         # out of the quorum instead of freezing it
         if self.node_id not in nodes:
             self.cluster.register(self._my_info())
-        # leases and fencing floors follow the assignment table
-        mine = pb.leases.snapshot()
-        for key, a in state.get("assignments", {}).items():
+        # own-lease backstop, O(own): an aborted drain handover re-grant
+        # or a lease dropped out-of-band has no map change to ride the
+        # listener, so our holdings are reconciled against the index
+        # every tick
+        led = self._index.keys_led_by(self.node_id)
+        for (topic, part), held in pb.leases.snapshot().items():
+            key = tp_key(topic, part)
+            if key not in led:
+                self._reconcile_assignment(key, self._index.entry(key))
+        for key in led:
             topic, part = parse_tp_key(key)
-            epoch = int(a.get("epoch", 0))
-            if self._replica_server is not None:
-                self._replica_server.note_partition_epoch(topic, part,
-                                                          epoch)
-            held = mine.pop((topic, part), None)
-            if a.get("leader") == self.node_id:
-                if held != epoch:
-                    # the lease implies the topic: a T frame may not have
-                    # arrived yet (assignment raced replication), and a
-                    # leader without the topic would refuse its appends
-                    self._ensure_local_partition(topic, part)
-                    pb.leases.grant(topic, part, epoch)
-            elif held is not None:
-                # deposed (failover or a rebalance move): fence ONLY this
-                # lease; our other partitions keep writing
-                pb.leases.revoke(topic, part, fenced_epoch=epoch)
-                self._record("partition_deposed", {
-                    "topic": topic, "partition": part,
-                    "new_leader": a.get("leader"), "epoch": epoch})
-                TRACER.instant("ha.rebalance", cat="ha", args={
-                    "action": "deposed", "node": self.node_id,
-                    "partition": key, "new_leader": a.get("leader"),
-                    "epoch": epoch})
-        for (topic, part) in mine:
-            # leased but no longer in the table at all (topic dropped)
-            pb.leases.revoke(topic, part)
+            a = self._index.entry(key)
+            if a is not None and pb.leases.epoch_of(topic, part) != a["epoch"]:
+                self._ensure_local_partition(topic, part)
+                pb.leases.grant(topic, part, a["epoch"])
         # orphan backstop: a sweep can be lost to a crash — any node
         # noticing orphans restarts one
-        if any(a.get("leader") not in nodes
-               for a in state.get("assignments", {}).values()):
+        if self._index.orphan_count():
             self._start_orphan_sweep()
 
     def _ensure_local_partition(self, topic: str, part: int) -> None:
@@ -726,36 +931,35 @@ class HANode:
             log_dir=self.log_dir,
         )
 
-    def _shed_pass(self, state: Dict[str, Any]) -> None:
+    def _shed_pass(self) -> None:
         """Anti-entropy: when a healed node re-joins under-loaded, an
         over-loaded node hands it leaderships — bounded to
         ``spread_moves`` per pass (the SWARMDB_HA_SPREAD knob), each via
-        the drain handover so the move never races the log."""
+        the drain handover so the move never races the log. Index-driven
+        (ISSUE 14): load comes from the leadership counts (O(cluster
+        size)) and candidates from our OWN lease snapshot (O(own)) — no
+        assignment-table scan."""
         pb = self._pbroker
         if pb is None:
             return
-        nodes = sorted(state.get("nodes", {}))
-        if len(nodes) < 2 or self.node_id not in nodes:
+        counts = self._index.leadership_counts()
+        nodes = sorted(counts)
+        if len(nodes) < 2 or self.node_id not in counts:
             return
-        assigns = state.get("assignments", {})
-        counts = {nid: 0 for nid in nodes}
-        for a in assigns.values():
-            if a.get("leader") in counts:
-                counts[a["leader"]] += 1
         for _ in range(self.spread_moves):
             under = min(nodes, key=lambda n: (counts[n], n))
             if under == self.node_id:
                 return
             if counts[self.node_id] - counts[under] < 2:
                 return  # within one leadership of balanced: done
-            info = state["nodes"].get(under, {})
+            info = self._index.node_info(under) or {}
             if probe_liveness(info.get("liveness_addr", ""),
                               max(0.05, self.suspect_s / 2)) is None:
                 return  # never shed onto a corpse
             moved = False
             for (topic, part), epoch in sorted(pb.leases.snapshot().items()):
-                key = tp_key(topic, part)
-                if assigns.get(key, {}).get("leader") != self.node_id:
+                a = self._index.entry(tp_key(topic, part))
+                if a is None or a.get("leader") != self.node_id:
                     continue
                 if self._handover(topic, part, epoch, under,
                                   info.get("replica_addr", "")):
@@ -1011,40 +1215,44 @@ class HANode:
             if self._stop.is_set():
                 return
             try:
-                state = self._read_map()
+                # one incremental pull per tick: O(1) when the map did
+                # not move; assignment side effects fire from the index
+                # listener for exactly the changed entries (ISSUE 14)
+                self._sync_index()
             except ClusterUnreachableError:
                 continue
             except Exception:
                 logger.exception("cluster map read failed")
                 continue
-            leader = state.get("leader")
+            leader = self._index.leader()
+            cluster_epoch = self._index.epoch()
             with self._lock:
                 role, epoch, lb = self._role, self._epoch, self._leader_broker
             if self.partition_leadership and role != "dead":
                 try:
-                    self._reconcile_partitions(state)
+                    self._reconcile_partitions()
                     if role == "leader":
                         # controller duties: new topics get leaders
-                        self._assign_unassigned(state)
+                        self._assign_unassigned()
                     self._shed_tick += 1
                     if self._shed_tick % 4 == 0:
                         # anti-entropy: re-spread onto healed peers (every
                         # few ticks — a shed is a drain handover and may
                         # block this loop for up to ~4x suspect_s)
-                        self._shed_pass(state)
+                        self._shed_pass()
                 except Exception:
                     logger.exception("partition reconcile failed")
             if role == "leader":
-                if (state.get("epoch", 0) > epoch
+                if (cluster_epoch > epoch
                         or (leader is not None and leader != self.node_id)):
-                    self._step_down(state.get("epoch", 0), leader)
+                    self._step_down(cluster_epoch, leader)
                     continue
                 if lb is not None:
                     if lb.fenced_by is not None:
                         self._step_down(lb.fenced_by, leader)
                         continue
                     # adopt newly registered followers
-                    for nid, info in state.get("nodes", {}).items():
+                    for nid, info in self._index.nodes().items():
                         if nid == self.node_id or not info.get("replica_addr"):
                             continue
                         lb.add_target(info["replica_addr"])
@@ -1058,7 +1266,7 @@ class HANode:
                 if self._replica_server is not None:
                     # learn the cluster epoch as a fencing floor even
                     # before the new leader's first mirror connect
-                    self._replica_server.note_epoch(state.get("epoch", 0))
+                    self._replica_server.note_epoch(cluster_epoch)
 
     # ------------------------------------------------------------------- obs
 
@@ -1218,6 +1426,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         listen_host=host or "0.0.0.0", replica_port=int(port),
         liveness_port=int(lport), data_port=data_port,
         advertise_host=advertise, log_dir=args.log_dir,
+        # deployment entry point = cluster mode: partition leadership
+        # defaults ON here (SWARMDB_HA_PARTITION_LEADERSHIP overrides)
+        cluster_mode=True,
     ).start(role=args.role)
     data = (f"{node._data_plane.host}:{node._data_plane.port}"
             if node._data_plane is not None else "off")

@@ -60,9 +60,19 @@ def is_internal_topic(name: str) -> bool:
     return name.startswith(INTERNAL_PREFIX)
 
 
-def partition_leadership_default() -> bool:
-    return os.environ.get("SWARMDB_HA_PARTITION_LEADERSHIP",
-                          "0").strip() not in ("0", "", "false", "no")
+def partition_leadership_default(cluster_mode: bool = False) -> bool:
+    """Partition mode's default (ISSUE 14): ON for cluster-mode nodes —
+    the deployment entry points (``python -m swarmdb_tpu.ha.node``,
+    ``api/server.py`` with SWARMDB_HA_NODE_ID) pass ``cluster_mode=True``
+    now that the embedded runtime routes produces through partition
+    leaders (``HANode.client_broker``). Explicitly setting
+    ``SWARMDB_HA_PARTITION_LEADERSHIP`` wins either way; in-process
+    harnesses that pass nothing keep the node-level default (off), so
+    embedded single-node behavior stays bit-identical."""
+    raw = os.environ.get("SWARMDB_HA_PARTITION_LEADERSHIP")
+    if raw is None or not raw.strip():
+        return bool(cluster_mode)
+    return raw.strip() not in ("0", "false", "no")
 
 
 def spread_moves_default() -> int:

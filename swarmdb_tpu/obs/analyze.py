@@ -214,6 +214,14 @@ def summarize_flight(dump: Dict[str, Any]) -> Dict[str, Any]:
                       if s.get("decode_kernel")})
     wave_kinds = sorted({s["wave_kind"] for s in steps
                          if s.get("wave_kind")})
+    # leadership churn (ISSUE 14): ha.repin instants in the event ring
+    # tie a TTFT spike to conversations whose lane pin moved with a
+    # leadership change (drain handover / failover) — a dump whose
+    # p50_ttft regressed WITH repins in-window is churn, not engine drift
+    events = dump.get("events") or []
+    repins = sum(1 for e in events if e.get("kind") == "ha.repin")
+    promotions = sum(1 for e in events
+                     if e.get("kind") == "ha.partition_promoted")
     return {
         "steps": len(steps),
         "requests": len(reqs),
@@ -230,6 +238,8 @@ def summarize_flight(dump: Dict[str, Any]) -> Dict[str, Any]:
             delta("host_syncs") / max(1, len(steps) - 1), 3),
         "p50_queue_wait_s": round(med(queue), 4),
         "p50_ttft_s": round(med(ttft), 4),
+        "leadership_repins": repins,
+        "partition_promotions": promotions,
         "meta": dump.get("meta", {}),
     }
 

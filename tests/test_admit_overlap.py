@@ -203,6 +203,57 @@ def test_group_restart_revives_only_dead_lanes(group):
     assert done.wait(60), "restarted lane does not serve"
 
 
+def test_leadership_repin_replay_bit_identical(group):
+    """ISSUE 14 satellite: a leadership move re-pins a conversation to a
+    different lane (backend/locality.py derives the lane from
+    (partition, leader)), and greedy decode replayed on the new lane is
+    BIT-IDENTICAL to the old one — the lane-group half of the PR 8
+    migration proof, applied to leadership-driven re-pinning."""
+    from swarmdb_tpu.backend.locality import ConversationLocality
+    from swarmdb_tpu.ha import tp_key
+
+    leadership = {"t:0": {"leader": "node-a", "epoch": 1}}
+    locality = ConversationLocality(
+        topic="t", n_lanes=len(group.lanes),
+        leadership=lambda key: leadership.get(key),
+        num_partitions=lambda: 1)
+
+    def serve(pin):
+        done = threading.Event()
+        res = {}
+
+        def on_done(rid, toks, reason, _r=res, _d=done):
+            _r["toks"], _r["reason"] = toks, reason
+            _d.set()
+
+        group.submit(GenRequest(
+            prompt=[2, 7, 11, 3], sampling=SamplingParams(max_new_tokens=8),
+            on_done=on_done, shard_hint=pin.lane))
+        assert done.wait(120)
+        assert res["reason"] in ("length", "eos")
+        return res["toks"]
+
+    pin_before = locality.pin("user", "agent-x")
+    assert pin_before.leader == "node-a"
+    toks_before = serve(pin_before)
+
+    # failover: a new leader seats at a higher epoch; the re-pin is
+    # deterministic and (for some leader) lands on a DIFFERENT lane
+    new_leader = next(
+        f"node-{i}" for i in range(64)
+        if locality._lane_for(0, f"node-{i}") != pin_before.lane)
+    leadership["t:0"] = {"leader": new_leader, "epoch": 2}
+    locality.on_rebalance(tp_key("t", 0), leadership["t:0"])
+    pin_after = locality.pin("user", "agent-x")
+    assert pin_after.leader == new_leader
+    assert pin_after.lane != pin_before.lane
+    assert locality.stats()["repins"] == 1
+
+    toks_after = serve(pin_after)
+    assert toks_after == toks_before, (
+        "greedy replay across a leadership re-pin must be bit-identical")
+
+
 def test_gspmd_path_still_available():
     """SWARMDB_ADMIT_OVERLAP=0 semantics: admit_overlap=False returns
     the single-program GSPMD engine (the packed-prefill path the

@@ -220,6 +220,24 @@ def test_partition_kill_bounded_blast_radius_zero_loss(cluster3p):
         tp_key(TOPIC, p) for p in victim_parts}
     assert (t_reseated - t_kill) < PROMOTE_BUDGET_S
 
+    # rebalance convergence is a first-class number (ISSUE 14): some
+    # survivor observed the orphan episode open and close, recorded it
+    # (flight + status block) within the same promotion budget
+    survivors = [harness.nodes[n] for n in ("n0", "n2")]
+    wait_until(
+        lambda: any(n.last_convergence_s is not None for n in survivors),
+        PROMOTE_BUDGET_S, what="a survivor recorded convergence")
+    observer = next(n for n in survivors
+                    if n.last_convergence_s is not None)
+    assert 0 < observer.last_convergence_s < 4 * PROMOTE_BUDGET_S
+    pl_block = observer.status()["partition_leadership"]
+    assert pl_block["rebalance_convergence_s"] == \
+        observer.last_convergence_s
+    assert pl_block["orphans"] == 0 and pl_block["rebalancing"] is False
+    converged = [ev for ev in harness.flight.events()
+                 if ev.get("kind") == "ha.rebalance_converged"]
+    assert converged and converged[-1]["orphans_peak"] >= 1
+
 
 def test_dueling_partition_promotion_exactly_one_winner(cluster3p):
     """Dueling-promotion injection: every live node races the CAS for
@@ -353,12 +371,33 @@ def test_partition_metrics_and_admin_ha_contract(tmp_path):
         wait_until(lambda: len(cluster.read()["assignments"]) == 4, 5.0,
                    what="assignment")
 
+        # serving-locality surfaces (ISSUE 14): a stub serving object
+        # carrying a real ConversationLocality — the app reads it via
+        # getattr, exactly like a full ServingService
+        from types import SimpleNamespace
+
+        from swarmdb_tpu.backend.locality import ConversationLocality
+
+        wait_until(
+            lambda: leader.assignment_of("mt:0") is not None, 5.0,
+            what="leader index caught up")
+        locality = ConversationLocality(
+            topic="mt", n_lanes=2, leadership=leader.assignment_of,
+            num_partitions=lambda: 4, local_node="pl-leader")
+        locality.pin("u", "agent-0")
+        locality.pin("u", "agent-1")
+        serving_stub = SimpleNamespace(engine=None, supervisor=None,
+                                       _locality=locality)
+        # a closed convergence episode so the gauge renders
+        leader.last_convergence_s = 0.42
+
         async def drive():
             db = SwarmDB(broker=LocalBroker(),
                          save_dir=str(tmp_path / "hist"))
             cfg = ApiConfig(jwt_secret_key="t",
                             rate_limit_per_minute=10_000)
-            app = create_app(db, cfg, ha_node=leader)
+            app = create_app(db, cfg, ha_node=leader,
+                             serving=serving_stub)
             client = TestClient(TestServer(app))
             await client.start_server()
             try:
@@ -370,6 +409,15 @@ def test_partition_metrics_and_admin_ha_contract(tmp_path):
                 assert 'swarmdb_partition_leaderships{node="pl-follower"}' \
                     in body
                 assert "swarmdb_partition_leaderless 0" in body
+                # ISSUE 14 gauges: rebalance convergence + the
+                # conversation-locality local/remote split
+                assert ("swarmdb_rebalance_convergence_seconds 0.42"
+                        in body)
+                assert ('swarmdb_conversation_locality{state="local"}'
+                        in body)
+                assert ('swarmdb_conversation_locality{state="remote"}'
+                        in body)
+                assert "swarmdb_conversation_repins_total 0" in body
 
                 r = await client.post("/auth/token", json={
                     "username": "admin", "password": "x"})
@@ -389,6 +437,14 @@ def test_partition_metrics_and_admin_ha_contract(tmp_path):
                             if row["leader"] == "pl-leader"]
                 assert led_here and all("replica_lag" in row
                                         for row in led_here)
+                assert pl["rebalance_convergence_s"] == 0.42
+                # partition_serving block (ISSUE 14): conversations
+                # pinned per leader + leaderless count
+                ps = status["partition_serving"]
+                assert ps["conversations"] == 2
+                assert ps["leaderless"] == 0
+                assert sum(ps["by_leader"].values()) == 2
+                assert ps["local"] + ps["remote"] == 2
             finally:
                 await client.close()
             db.close()

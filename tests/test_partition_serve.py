@@ -1,0 +1,307 @@
+"""Serving on partition leadership (ISSUE 14 tentpole).
+
+Two halves meet here: PR 10's partition-level broker leadership and the
+serving/runtime tier. Pinned contracts:
+
+- the stale-facade class of bug, once and for all: an embedded runtime
+  writes through ``HANode.client_broker()`` (per-call leader lookup) —
+  deposing a partition's leader MID-STREAM means the very next produce
+  lands on the NEW leader, the deposed node's direct append is fenced,
+  and nothing requires a rebind;
+- conversation-locality convergence (property test): 50 conversations
+  driven through a leadership MOVE (drain-handover-shaped CAS) and a
+  FAILOVER promotion (node kill) end with every conversation's shard
+  hint, lane pin, and partition leader in agreement, with ``ha.repin``
+  flight instants recorded for exactly the affected partitions;
+- cluster-mode defaults: ``partition_leadership_default`` flips ON for
+  cluster-mode entry points only — harness/embedded construction is
+  bit-identical to PR 10.
+"""
+
+import threading
+import time
+
+import pytest
+
+from swarmdb_tpu.broker.base import FencedError, LeaderChangedError
+from swarmdb_tpu.core.messages import BrokerConfig
+from swarmdb_tpu.core.runtime import SwarmDB
+from swarmdb_tpu.ha import build_local_cluster, tp_key, wait_until
+from swarmdb_tpu.ha.partition import partition_leadership_default
+from swarmdb_tpu.backend.locality import ConversationLocality
+from swarmdb_tpu.obs.flight import FlightRecorder
+from swarmdb_tpu.utils.hashing import stable_partition
+from swarmdb_tpu.utils.metrics import MetricsRegistry
+
+SUSPECT_S = 0.3
+DEAD_S = 0.6
+PROMOTE_BUDGET_S = DEAD_S + 6 * SUSPECT_S
+
+
+@pytest.fixture(autouse=True)
+def _fast_heartbeat(monkeypatch):
+    monkeypatch.setenv("SWARMDB_HA_HEARTBEAT_S", "0.05")
+
+
+@pytest.fixture
+def cluster3(request):
+    harness, cluster, client = build_local_cluster(
+        ["n0", "n1", "n2"], suspect_s=SUSPECT_S, dead_s=DEAD_S,
+        partition_leadership=True)
+    try:
+        wait_until(lambda: cluster.read()["leader"] == "n0", 5.0,
+                   what="bootstrap leader")
+        yield harness, cluster, client
+    finally:
+        failed = getattr(request.node, "rep_call", None)
+        if failed is not None and failed.failed:
+            harness.flight.auto_dump(f"pserve_test_{request.node.name}")
+        harness.stop()
+        client.close()
+
+
+def test_cluster_mode_defaults(monkeypatch):
+    """Default matrix: cluster-mode entry points get partition
+    leadership ON, everything else keeps the node-level default; the
+    env knob overrides both ways."""
+    monkeypatch.delenv("SWARMDB_HA_PARTITION_LEADERSHIP", raising=False)
+    assert partition_leadership_default() is False
+    assert partition_leadership_default(cluster_mode=True) is True
+    monkeypatch.setenv("SWARMDB_HA_PARTITION_LEADERSHIP", "0")
+    assert partition_leadership_default(cluster_mode=True) is False
+    monkeypatch.setenv("SWARMDB_HA_PARTITION_LEADERSHIP", "1")
+    assert partition_leadership_default() is True
+
+
+def _send_retry(db, sender, receiver, body, deadline_s=10.0):
+    """The runtime client contract: retryable failures re-send."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return db.send_message(sender, receiver, body)
+        except LeaderChangedError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def test_runtime_rides_partition_leaders_through_deposal(
+        cluster3, tmp_path):
+    """The stale-facade regression: an embedded runtime produced
+    through n1's client_broker keeps landing writes on each partition's
+    CURRENT leader across a mid-stream deposal — the deposed node's
+    direct append is fenced, and no handle rebind is needed."""
+    harness, cluster, _client = cluster3
+    node = harness.nodes["n1"]
+    db = SwarmDB(config=BrokerConfig(num_partitions=6),
+                 topic_name="t_serve", save_dir=str(tmp_path / "hist"),
+                 broker=node.client_broker())
+    try:
+        wait_until(
+            lambda: sum(1 for k in cluster.read()["assignments"]
+                        if k.startswith("t_serve:")) == 6,
+            5.0, what="t_serve assignment")
+        receiver = "agent-a"
+        part = stable_partition(receiver, 6)
+        key = tp_key("t_serve", part)
+
+        mid1 = _send_retry(db, "user", receiver, "before-deposal")
+        wait_until(
+            lambda: db.get_message(mid1).status.value in
+            ("delivered", "read"),
+            10.0, what="first message delivered")
+
+        a = cluster.read()["assignments"][key]
+        old_leader = a["leader"]
+        target = next(n for n in ("n0", "n1", "n2") if n != old_leader)
+        assert cluster.try_promote_partition(
+            "t_serve", part, target, a["epoch"] + 1,
+            expect_epoch=a["epoch"])
+        old_node = harness.nodes[old_leader]
+        wait_until(
+            lambda: old_node._pbroker.leases.epoch_of("t_serve", part)
+            is None,
+            PROMOTE_BUDGET_S, what="old leader fenced")
+        wait_until(
+            lambda: harness.nodes[target]._pbroker.leases.epoch_of(
+                "t_serve", part) == a["epoch"] + 1,
+            PROMOTE_BUDGET_S, what="new leader leased")
+
+        # the fenced node refuses direct writes on exactly that
+        # partition — nothing can silently land in its log
+        with pytest.raises(FencedError):
+            old_node._pbroker.append("t_serve", part, b"stale-write")
+
+        # ...while the runtime's next produce resolves the NEW leader
+        # (per-call lookup; at worst one retryable raise mid-window)
+        mid2 = _send_retry(db, "user", receiver, "after-deposal")
+        wait_until(
+            lambda: db.get_message(mid2).status.value in
+            ("delivered", "read"),
+            10.0, what="post-deposal message delivered")
+
+        # both turns durable and readable through the cluster, exactly
+        # once each, served by the new leader
+        import json as _json
+
+        recs = harness.nodes[target].broker.fetch("t_serve", part, 0,
+                                                  100000)
+        ids = [_json.loads(r.value.decode()).get("id") for r in recs]
+        assert ids.count(mid1) == 1 and ids.count(mid2) == 1
+    finally:
+        db.close()
+
+
+N_CONVS = 50
+N_LANES = 4
+TOPIC = "t"
+PARTS = 12
+
+
+def _expected_lane(part, leader):
+    return stable_partition(f"{part}@{leader}", N_LANES)
+
+
+def _pins_agree(cluster, locality, convs):
+    assigns = cluster.read()["assignments"]
+    for conv in convs:
+        part = stable_partition(conv, PARTS)
+        a = assigns.get(tp_key(TOPIC, part))
+        if a is None:
+            return False
+        pin = locality.pin("u", conv)
+        if pin.leader != a["leader"] or pin.epoch != a["epoch"]:
+            return False
+        if pin.lane != _expected_lane(part, a["leader"]):
+            return False
+    return True
+
+
+def test_locality_convergence_across_move_and_failover(cluster3):
+    """Property test (ISSUE 14 satellite): 50 conversations through a
+    leadership move and a failover promotion — afterwards every
+    conversation's shard hint, lane pin, and partition leader agree,
+    and the re-pins were deterministic and scoped to the affected
+    partitions (ha.repin instants name them)."""
+    harness, cluster, client = cluster3
+    client.create_topic(TOPIC, PARTS)
+    wait_until(
+        lambda: sum(1 for k in cluster.read()["assignments"]
+                    if k.startswith(f"{TOPIC}:")) == PARTS,
+        5.0, what="assignment")
+
+    flight = FlightRecorder()
+    metrics = MetricsRegistry()
+    controller = harness.nodes["n0"]
+    locality = ConversationLocality(
+        topic=TOPIC, n_lanes=N_LANES,
+        leadership=controller.assignment_of,
+        num_partitions=lambda: PARTS, local_node="n0",
+        metrics=metrics, flight=flight)
+    for node in harness.nodes.values():
+        node.add_rebalance_listener(locality.on_rebalance)
+
+    # the leadership view is the controller's index — synced per watch
+    # tick; let it catch up before pinning so the baseline is repin-free
+    wait_until(
+        lambda: all(controller.assignment_of(tp_key(TOPIC, p)) is not None
+                    for p in range(PARTS)),
+        5.0, what="controller index caught up")
+    convs = [f"c{i}" for i in range(N_CONVS)]
+    for conv in convs:
+        locality.pin("u", conv)
+    assert _pins_agree(cluster, locality, convs)
+    assert locality.stats()["repins"] == 0
+    assert locality.stats()["conversations"] == N_CONVS
+
+    # --- leadership MOVE (the drain-handover CAS shape) -------------
+    assigns = cluster.read()["assignments"]
+    moved_part = next(
+        stable_partition(c, PARTS) for c in convs
+        if assigns[tp_key(TOPIC, stable_partition(c, PARTS))]["leader"]
+        == "n1")
+    a = assigns[tp_key(TOPIC, moved_part)]
+    assert cluster.try_promote_partition(
+        TOPIC, moved_part, "n2", a["epoch"] + 1, expect_epoch=a["epoch"])
+    wait_until(lambda: _pins_agree(cluster, locality, convs),
+               PROMOTE_BUDGET_S, what="pins agree after the move")
+    moved_convs = [c for c in convs
+                   if stable_partition(c, PARTS) == moved_part]
+    assert locality.stats()["repins"] >= len(moved_convs)
+
+    # --- FAILOVER promotion (node kill) -----------------------------
+    victim = "n1"
+    victim_parts = {
+        int(k.rpartition(":")[2])
+        for k, a in cluster.read()["assignments"].items()
+        if a["leader"] == victim and k.startswith(f"{TOPIC}:")}
+    assert victim_parts
+    harness.kill(victim)
+    wait_until(
+        lambda: all(
+            cluster.read()["assignments"][tp_key(TOPIC, p)]["leader"]
+            != victim for p in victim_parts),
+        4 * PROMOTE_BUDGET_S, what="failover re-seating")
+    wait_until(lambda: _pins_agree(cluster, locality, convs),
+               4 * PROMOTE_BUDGET_S, what="pins agree after failover")
+
+    # determinism: recomputing every pin yields the same lanes again
+    lanes1 = {c: locality.pin("u", c).lane for c in convs}
+    lanes2 = {c: locality.pin("u", c).lane for c in convs}
+    assert lanes1 == lanes2
+
+    # ha.repin instants were recorded, scoped to affected partitions
+    repins = [ev for ev in flight.events()
+              if ev.get("kind") == "ha.repin"]
+    assert repins, "no ha.repin flight instants recorded"
+    affected = {tp_key(TOPIC, p) for p in victim_parts} | {
+        tp_key(TOPIC, moved_part)}
+    assert {ev["partition"] for ev in repins} <= affected
+    assert metrics.counters["conversation_repins"].value \
+        == locality.stats()["repins"]
+    # every surviving leader now also serves its conversations' stats
+    st = locality.stats()
+    assert st["leaderless"] == 0
+    assert victim not in st["by_leader"]
+    assert sum(st["by_leader"].values()) == N_CONVS
+
+
+def test_locality_concurrent_pins_and_rebalances():
+    """Thread-safety smoke: pin() from serving threads racing
+    on_rebalance() from HA threads must neither deadlock nor corrupt
+    the registry."""
+    leadership = {"leader": "a", "epoch": 1}
+    locality = ConversationLocality(
+        topic=TOPIC, n_lanes=4,
+        leadership=lambda key: dict(leadership),
+        num_partitions=lambda: 8)
+    stop = threading.Event()
+
+    def pinner(w):
+        i = 0
+        while not stop.is_set():
+            locality.pin("u", f"c{(w * 37 + i) % 64}")
+            i += 1
+
+    def rebalancer():
+        i = 0
+        while not stop.is_set():
+            leadership["leader"] = f"n{i % 3}"
+            leadership["epoch"] = i + 2
+            for p in range(8):
+                locality.on_rebalance(tp_key(TOPIC, p),
+                                      dict(leadership))
+            i += 1
+
+    threads = [threading.Thread(target=pinner, args=(w,), daemon=True)
+               for w in range(3)]
+    threads.append(threading.Thread(target=rebalancer, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    st = locality.stats()
+    assert 0 < st["conversations"] <= 64
+    assert st["repins"] > 0
