@@ -184,6 +184,7 @@ def bench_echo(seconds: float) -> dict:
     # observability cost of all four.
     try:
         from swarmdb_tpu.obs import HISTOGRAMS, TRACER
+        from swarmdb_tpu.obs.profiler import profiler as _kprof
 
         was_enabled = TRACER.enabled
         if was_enabled:
@@ -195,17 +196,22 @@ def bench_echo(seconds: float) -> dict:
                                  autosave_interval=1e9)
                     # several sentinel windows per segment, so the tick
                     # AND the close path are inside the measurement
+                    # (the sentinel's window close now also snapshots
+                    # the swarmprof counters, so the profiler toggle
+                    # rides the same segments — ISSUE 15)
                     db.sentinel.config.window_s = max(0.25, seg / 4)
                     for _ in range(2):
                         TRACER.set_enabled(True)
                         HISTOGRAMS.set_enabled(True)
                         HISTOGRAMS.set_exemplars_enabled(True)
                         db.sentinel.set_enabled(True)
+                        _kprof().set_enabled(True)
                         on_rate += _echo_loop(db, seg)
                         TRACER.set_enabled(False)
                         HISTOGRAMS.set_enabled(False)
                         HISTOGRAMS.set_exemplars_enabled(False)
                         db.sentinel.set_enabled(False)
+                        _kprof().set_enabled(False)
                         off_rate += _echo_loop(db, seg)
                     db.close()
             finally:
@@ -213,6 +219,7 @@ def bench_echo(seconds: float) -> dict:
                 HISTOGRAMS.set_enabled(True)
                 HISTOGRAMS.set_exemplars_enabled(
                     os.environ.get("SWARMDB_EXEMPLARS", "1") != "0")
+                _kprof().set_enabled(True)
             on_rate /= 2
             off_rate /= 2
             result["echo_tracer_on_msgs_per_sec"] = round(on_rate, 2)
@@ -363,6 +370,25 @@ def _device_extras(service, model: str) -> dict:
             "evictions": c["rolling_evictions"].value,
             "conversations": len(service._rolling),
         }
+    # swarmprof (ISSUE 15): the per-mode kernel_profile block — per-
+    # variant invocations / device seconds / harvested FLOPs / MFU /
+    # roofline class — plus per-lane duty cycles, so every bench record
+    # carries the kernel-level device-time picture its headline number
+    # summarizes. min_lane_duty_cycle rides the compact summary ("duty")
+    # and is trend-guarded like mfu.
+    try:
+        from swarmdb_tpu.obs.profiler import profile_enabled, profiler
+
+        if profile_enabled():
+            prof = profiler()
+            extras["kernel_profile"] = prof.kernel_profile()
+            duties = [l["duty_cycle"]
+                      for l in extras["kernel_profile"]["lanes"]]
+            if duties:
+                extras["lane_duty_cycles"] = duties
+                extras["min_lane_duty_cycle"] = round(min(duties), 4)
+    except Exception as exc:  # noqa: BLE001 — extras must not kill a bench
+        extras["kernel_profile_error"] = repr(exc)[-200:]
     return extras
 
 
@@ -486,8 +512,21 @@ def _deposit_obs_artifacts(service, mode: str) -> dict:
 
         os.makedirs(logs, exist_ok=True)
         tpath = os.path.join(logs, f"{mode}_trace.json")
+        trace = TRACER.to_chrome_trace()
+        try:
+            from swarmdb_tpu.obs.profiler import profile_enabled, profiler
+
+            if profile_enabled():
+                # device-time tracks next to the host spans, and the
+                # full swarmprof dump as its own artifact (analyze.py
+                # --roofline consumes it; tpu_poller indexes it)
+                trace = profiler().merge_chrome_trace(trace)
+                out["profile_artifact"] = profiler().dump_to(
+                    logs, reason=f"bench_{mode}")
+        except Exception as exc:  # noqa: BLE001
+            out["profile_artifact_error"] = repr(exc)[-200:]
         with open(tpath, "w") as f:
-            json.dump(TRACER.to_chrome_trace(), f)
+            json.dump(trace, f)
         out["trace_artifact"] = tpath
         out["flight_artifact"] = service.engine.flight.dump_to(
             logs, reason=f"bench_{mode}")
@@ -880,10 +919,13 @@ def bench_dpserve(seconds: float) -> dict:
     def run(ndev: int) -> dict:
         # both sub-runs share this process's tracer: without a reset the
         # second deposit would export the FIRST run's spans too and
-        # poison the dp1-vs-dpN diagnosis
+        # poison the dp1-vs-dpN diagnosis (and the profiler's variant /
+        # duty accounting would mix the dp1 and dpN sub-runs)
         from swarmdb_tpu.obs import TRACER
+        from swarmdb_tpu.obs.profiler import profiler as _kp
 
         TRACER.reset()
+        _kp().reset()
         mesh = make_mesh(ndev, data=ndev, model=1, expert=1)
         with tempfile.TemporaryDirectory() as tmp:
             db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
@@ -2029,6 +2071,7 @@ _SUMMARY_KEYS = (
     ("hit", "prefix_hit_rate"),
     ("pad", "prefill_padding_ratio"),
     ("kern", "kernel"),
+    ("duty", "min_lane_duty_cycle"),
     ("pl", "platform"),
     ("native", "native_broker_msgs_per_sec"),
     ("dpx", "dp_scaling_x"),

@@ -205,6 +205,23 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
             if tdx / bdx < (1.0 - threshold):
                 entry["regressed"] = True
                 entry["dpx_regressed"] = True
+        # swarmprof efficiency numbers guarded first-class (ISSUE 15):
+        # MFU and the worst lane's duty cycle can collapse while
+        # throughput holds (e.g. padding growth absorbed by bigger
+        # batches, one starved lane masked by siblings). Like-for-like
+        # is already enforced above (platform class + decode kernel),
+        # so an mfu/duty drop beyond the threshold is a real efficiency
+        # regression, not a CPU-vs-TPU artifact.
+        for short, tag in (("mfu", "mfu"), ("duty", "duty_cycle")):
+            bm, tm = b.get(short), t.get(short)
+            if isinstance(bm, (int, float)) and \
+                    isinstance(tm, (int, float)) and bm > 0:
+                entry[f"base_{short}"] = bm
+                entry[f"test_{short}"] = tm
+                entry[f"{short}_ratio"] = round(tm / bm, 3)
+                if tm / bm < (1.0 - threshold):
+                    entry["regressed"] = True
+                    entry[f"{tag}_regressed"] = True
         if entry["regressed"]:
             bs, ts = _phase_summary(b), _phase_summary(t)
             if bs is not None and ts is not None:
@@ -243,6 +260,10 @@ def build_report(base_path: str, test_path: str,
                 f"({v['ratio']}x)"
                 + (f", dp_scaling_x {v['base_dpx']} -> {v['test_dpx']}"
                    if v.get("dpx_regressed") else "")
+                + (f", mfu {v['base_mfu']} -> {v['test_mfu']}"
+                   if v.get("mfu_regressed") else "")
+                + (f", min_lane_duty {v['base_duty']} -> {v['test_duty']}"
+                   if v.get("duty_cycle_regressed") else "")
                 + (f", dominant {v['dominant']} "
                    f"({v['attribution']['shares'][v['dominant']]:.0%})"
                    if v.get("dominant") else ", unattributed")

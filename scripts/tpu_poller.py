@@ -30,6 +30,7 @@ loop; the lock file keeps overlapping cron fires out.)
 """
 
 import argparse
+import glob
 import json
 import os
 import subprocess
@@ -123,6 +124,12 @@ def fire(session: str, steps: str = "") -> int:
         # SWARMDB_TPU_STEPS — e.g. --steps 6 fires only the
         # ragged-vs-gather prefill A/B)
         env["SWARMDB_TPU_STEPS"] = steps
+    # swarmprof stays ON for the whole session (ISSUE 15): every bench
+    # mode deposits a profile_*.json next to its trace/flight artifacts,
+    # so the first real-TPU window lands per-kernel MFU/roofline numbers
+    # (analyze --roofline), not just mode headlines
+    env["SWARMDB_PROFILE"] = "1"
+    before = set(glob.glob(os.path.join(LOGS, "profile_*.json")))
     log(f"tunnel is UP — firing {session}"
         f"{f' steps={steps}' if steps else ''} (tee: {tee_path})")
     with open(tee_path, "a") as tee:
@@ -130,7 +137,11 @@ def fire(session: str, steps: str = "") -> int:
             ["bash", session], cwd=REPO, stdout=tee, stderr=tee, env=env,
         )
         rc = proc.wait()
-    log(f"session finished rc={rc}")
+    fresh = sorted(set(glob.glob(os.path.join(LOGS, "profile_*.json")))
+                   - before)
+    log(f"session finished rc={rc}; {len(fresh)} profile artifact(s)"
+        + (": " + ", ".join(os.path.basename(p) for p in fresh)
+           if fresh else ""))
     return rc
 
 

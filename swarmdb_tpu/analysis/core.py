@@ -165,6 +165,10 @@ RULES: Dict[str, Rule] = {
              "construction, comprehension, f-string) in hot exemplar/"
              "sentinel record-path code — exemplar retention must be an "
              "in-place slot write"),
+        Rule("SWL506", "span-discipline",
+             "compile-time introspection (cost_analysis()/argful "
+             "lower()) inside a hot-path function — the swarmprof cost "
+             "harvest belongs in warmup, never on a dispatch path"),
         Rule("SWL601", "heartbeat-safety",
              "blocking call inside `# swarmlint: heartbeat` code — a "
              "stalled failure-detector evaluation reads as a dead peer "

@@ -222,6 +222,20 @@ EXPLAIN: Dict[str, Dict[str, str]] = {
                 "    self._ex_rids[bucket] = rid\n"
                 "    self._ex_vals[bucket] = v",
     },
+    "SWL506": {
+        "doc": "Compile-time introspection (cost_analysis() or an "
+               "argful lower(...)) inside hot code: lowering re-traces "
+               "the function and the cost model runs at compile speed; "
+               "the swarmprof harvest belongs in warmup.",
+        "bad": "# swarmlint: hot\n"
+               "def _dispatch(self, fn, args):\n"
+               "    ca = fn.lower(*specs).cost_analysis()  # per call!",
+        "good": "def warmup(self):\n"
+                "    self.profile_harvest()  # lower+cost_analysis once\n"
+                "# swarmlint: hot\n"
+                "def _dispatch(self, fn, args):\n"
+                "    prof.dispatch(key, t0, dur)  # counters only",
+    },
     "SWL601": {
         "doc": "A blocking call inside `# swarmlint: heartbeat` code: a "
                "stalled failure-detector evaluation reads as a dead "

@@ -35,6 +35,14 @@ contract the type system cannot enforce:
   dict per STEP, so the rule is scoped to the per-observation exemplar
   and sentinel paths rather than every hot function.
 
+- swarmprof's cost harvest (ISSUE 15) is a compile-time activity with a
+  compile-time cost: ``fn.lower(*specs)`` re-traces the function and
+  ``cost_analysis()`` runs the XLA cost model — tens of milliseconds to
+  seconds per variant. Inside ``# swarmlint: hot`` code either call is
+  SWL506: harvest belongs in warmup (``Engine.profile_harvest``), never
+  on a dispatch path. ``.lower()`` with NO arguments is the string
+  method and exempt; the jax lowering always takes the arg specs.
+
 ``__enter__``/``__exit__`` pairs are exempt from SWL501 — the context-
 manager protocol balances them across two methods by design.
 """
@@ -171,6 +179,30 @@ def check(src: SourceFile) -> List[Finding]:
                         f"`{fn.name}` — a registry/dict lookup (or a "
                         f"defaultdict allocation) per observation; "
                         f"bind the histogram once"))
+        if src.is_hot(fn):
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                leaf = name.split(".")[-1] if name else ""
+                if leaf == "cost_analysis":
+                    findings.append(make_finding(
+                        src, "SWL506", node,
+                        f"cost_analysis() inside hot-path function "
+                        f"`{fn.name}` — the XLA cost model runs at "
+                        f"compile speed; harvest belongs in warmup "
+                        f"(Engine.profile_harvest)"))
+                elif (leaf == "lower"
+                        and isinstance(node.func, ast.Attribute)
+                        and (node.args or node.keywords)):
+                    # str.lower() takes no args; jax lowering takes the
+                    # arg specs — only the argful form is a re-trace
+                    findings.append(make_finding(
+                        src, "SWL506", node,
+                        f"lower(...) inside hot-path function "
+                        f"`{fn.name}` — lowering re-traces the jitted "
+                        f"function per call; compile-time introspection "
+                        f"belongs in warmup/precompile"))
         if src.is_hot(fn) and _exemplar_scope(src, fn):
             for node in _own_nodes(fn):
                 desc = _alloc_desc(node)

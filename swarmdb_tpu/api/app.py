@@ -37,6 +37,7 @@ from ..core.messages import MessageStatus
 from ..core.runtime import SwarmDB
 from ..obs import HISTOGRAMS, TRACER, propagate
 from ..obs.pagecheck import enabled as pagecheck_enabled
+from ..obs.profiler import profile_enabled, profiler as kernel_profiler
 from ..utils import jwt as jwt_util
 from ..utils.sync import lockcheck_enabled
 from . import schemas
@@ -742,6 +743,14 @@ def create_app(
 
             lines.extend(await _run_sync(
                 pagecheck.registry().prometheus_lines))
+        # swarmprof (ISSUE 15, SWARMDB_PROFILE — default on): aggregate
+        # MFU, per-lane duty cycles, per-variant device seconds /
+        # invocations. The pager line is swarmdb_mfu (or a lane's duty)
+        # falling while throughput holds — the sentinel attributes it,
+        # /admin/profile carries the full roofline table.
+        if profile_enabled():
+            lines.extend(await _run_sync(
+                kernel_profiler().prometheus_lines))
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -887,6 +896,12 @@ def create_app(
         last_n, trace_id = _trace_query(request)
         trace = await _run_sync(
             lambda: TRACER.to_chrome_trace(last_n=last_n, rid=trace_id))
+        if profile_enabled():
+            # device-time tracks (swarmprof dispatch rings) merged next
+            # to the host spans they explain: one "device:<lane>" track
+            # per lane, variant-named complete events
+            trace = await _run_sync(
+                lambda: kernel_profiler().merge_chrome_trace(trace))
         return web.json_response(trace)
 
     async def cluster_trace(request: web.Request) -> web.Response:
@@ -1005,6 +1020,21 @@ def create_app(
 
         return web.json_response(
             await _run_sync(pagecheck.registry().report))
+
+    async def admin_profile(request: web.Request) -> web.Response:
+        """GET /admin/profile — the swarmprof report (ISSUE 15): the
+        platform peak table, every compiled variant's invocations /
+        device seconds / harvested FLOPs+bytes / achieved-FLOPs MFU /
+        arithmetic intensity / roofline class, per-lane duty cycles,
+        and the dispatch-shape profile (wave kind x width, tiny ragged
+        flush waves named). 503 with SWARMDB_PROFILE=0 — an empty
+        report would read as "no device time spent" when nothing was
+        watching."""
+        require_admin(current_agent(request))
+        if not profile_enabled():
+            raise _error(503, "profiler off — unset SWARMDB_PROFILE=0")
+        return web.json_response(
+            await _run_sync(kernel_profiler().report))
 
     async def admin_lanes(request: web.Request) -> web.Response:
         """GET /admin/lanes — the lane supervisor's full status: per-lane
@@ -1190,6 +1220,7 @@ def create_app(
         web.get("/admin/lanes", admin_lanes),
         web.get("/admin/lockcheck", admin_lockcheck),
         web.get("/admin/pagecheck", admin_pagecheck),
+        web.get("/admin/profile", admin_profile),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

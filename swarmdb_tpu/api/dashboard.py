@@ -74,6 +74,11 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     download pagecheck report</button>
   (page sanitizer: per-pool shadow states + violations; 503 unless
   SWARMDB_PAGECHECK=1)
+  &middot;
+  <button onclick="download('/admin/profile', 'profile.json')">
+    download swarmprof report</button>
+  (per-variant device time / MFU / roofline, lane duty cycles,
+  dispatch-shape profile; 503 if SWARMDB_PROFILE=0)
   &middot; admin token required
 </p>
 <script>

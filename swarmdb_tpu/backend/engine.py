@@ -58,6 +58,7 @@ from jax.experimental import io_callback
 
 from ..obs import TRACER, FlightRecorder
 from ..obs.metrics import (HIST_DECODE_CHUNK, HIST_QUEUE_WAIT, HIST_TTFT)
+from ..obs.profiler import NullLane, profiler as kernel_profiler
 from ..utils.metrics import MetricsRegistry
 from ..utils.sync import make_condition
 from .sampling import (SamplingParams, make_slot_keys,
@@ -83,6 +84,28 @@ def is_retryable_reason(reason: str) -> bool:
     """True when a finish reason is safe to requeue (see
     :data:`RETRYABLE_REASONS`)."""
     return reason in RETRYABLE_REASONS
+
+
+# ---- swarmprof variant naming (obs/profiler.py, ISSUE 15) ----------------
+# One compiled program = one profiler key. The decode/resident families
+# have a single shape each; prefill families key on the shapes that pick
+# the compiled variant (rows x token bucket, + the prefix-gather width
+# where it is a compile axis). The SAME helper names warmup-harvest
+# entries and runtime dispatches, so cost-model facts and device-time
+# accounting join by construction.
+
+PROF_DECODE_KEYS = ("decode.full", "decode.fast", "decode.greedy")
+PROF_RESIDENT_KEYS = ("resident.full", "resident.fast", "resident.greedy")
+
+
+def prof_key(family: str, tok_shape, ppb: Optional[int] = None) -> str:
+    """Profiler variant key for a prefill family + its shape axes."""
+    if len(tok_shape) == 1:
+        return f"{family}[w{tok_shape[0]}]"
+    r, b = tok_shape
+    if ppb is None:
+        return f"{family}[r{r}xb{b}]"
+    return f"{family}[r{r}xb{b}xp{ppb}]"
 
 
 @dataclass
@@ -257,6 +280,13 @@ class Engine:
         self.flight = FlightRecorder()
         self._flight_dir = flight_dir
         self._flight_last_had_work = False
+        # swarmprof lane handle (obs/profiler.py): per-variant device-
+        # time attribution + this lane's duty cycle. SWARMDB_PROFILE=0
+        # hands back the shared NullLane — dispatch sites then pay one
+        # attribute read (enabled == False), nothing else (type identity
+        # pinned by test). ShardLaneGroup relabels lanes "lane<i>".
+        self._prof = kernel_profiler().lane()
+        self._prof_resident_key = PROF_RESIDENT_KEYS[0]
         # ShardLaneGroup sets this to the lane index: lanes share ONE
         # flight recorder, and step records carry which lane wrote them
         self.flight_shard: Optional[int] = None
@@ -1304,10 +1334,20 @@ class Engine:
         """Publish (pod mode) then execute one mirrored device call.
         Publish FIRST, matching the decode/prefill pattern: if the local
         execution raises, the pod is already failing loudly through the
-        decode loop's fatal-stop path."""
+        decode loop's fatal-stop path. Under swarmprof the execution is
+        wall-timed around the dispatch (the CPU-fallback device-time
+        approximation; one key build + two clock reads per admission
+        wave, never per token)."""
         if self._mh is not None:
             self._mh.publish_call(call_id, args)
-        self._MH_CALLS[call_id](self, *args)
+        prof = self._prof
+        if prof.enabled:
+            t0 = time.monotonic_ns()
+            self._MH_CALLS[call_id](self, *args)
+            prof.dispatch(self._PROF_MIRRORED[call_id](args), t0,
+                          time.monotonic_ns() - t0)
+        else:
+            self._MH_CALLS[call_id](self, *args)
 
     # swarmlint: hot
     def _call_paged_prefill(self, tokens, lengths, target, scatter, keys,
@@ -1396,6 +1436,28 @@ class Engine:
         CALL_DENSE_PREFIX_PREFILL: _call_dense_prefix_prefill,
         CALL_PAGED_PREFILL_PACKED: _call_paged_prefill_packed,
         CALL_PAGED_PREFILL_RAGGED: _call_paged_ragged_prefill,
+    }
+
+    # swarmprof key per mirrored call (args exclude the call id): the
+    # SAME shapes the harvest reads off warmup_call_plan specs, so the
+    # runtime key always lands on a harvested variant
+    _PROF_MIRRORED = {
+        CALL_PAGED_PREFILL:
+            lambda a: prof_key("prefill.paged", a[0].shape),
+        CALL_PAGED_PREFIX_PREFILL:
+            lambda a: prof_key("prefill.paged_prefix", a[0].shape,
+                               a[3].shape[1]),
+        CALL_PAGED_RESUME_PREFILL:
+            lambda a: prof_key("prefill.resume", a[0].shape,
+                               a[3].shape[1]),
+        CALL_SET_PT_ROWS: lambda a: "table.set_rows",
+        CALL_DENSE_PREFIX_PREFILL:
+            lambda a: prof_key("prefill.dense_prefix", a[0].shape,
+                               a[3].shape[1]),
+        CALL_PAGED_PREFILL_PACKED:
+            lambda a: prof_key("prefill.packed", a[0].shape),
+        CALL_PAGED_PREFILL_RAGGED:
+            lambda a: prof_key("prefill.ragged", a[0].shape),
     }
 
     def restart(self) -> None:
@@ -1506,6 +1568,103 @@ class Engine:
             return self._prefill_cache_fn(self.max_batch, self.max_seq)
 
     def warmup(self) -> float:
+        """Pre-compile every jitted variant the serving loop can hit and
+        return seconds spent (see ``_warmup_impl``). Wraps the compile
+        work in a swarmprof suspend/resume bracket: compile stalls must
+        not be billed as device time (a 30 s XLA compile would dwarf the
+        first MFU window), and the cost-model HARVEST — the one place
+        ``lower()``/``cost_analysis()`` may run (swarmlint SWL506) —
+        happens here, before serving traffic exists."""
+        assert not self._any_active(), "warmup requires an idle engine"
+        self._prof.suspend()
+        try:
+            if not isinstance(self._prof, NullLane):
+                try:
+                    self.profile_harvest()
+                except Exception:
+                    logger.exception("swarmprof cost harvest failed")
+            return self._warmup_impl()
+        finally:
+            # resume re-anchors the lane's duty-cycle clock at serving
+            # start, so duty = busy / time-since-warmed
+            self._prof.resume()
+
+    def profile_harvest(self) -> int:
+        """Harvest XLA cost-model facts (FLOPs, bytes accessed) for every
+        warmup-plan variant into the process profiler — warmup/compile
+        time ONLY (the zero-harvest-post-warmup contract is asserted by
+        test and policed by SWL506). ``Lowered.cost_analysis()`` runs the
+        cost model on the traced module without compiling or executing,
+        so a harvest costs one trace per variant. Lane groups share the
+        process registry: the first lane to harvest a variant covers its
+        siblings. Returns the number of variants harvested."""
+        prof = kernel_profiler()
+        try:
+            leaf = jax.tree_util.tree_leaves(self.params)[0]
+            dev = next(iter(leaf.devices()))
+            prof.set_platform(dev.platform,
+                              getattr(dev, "device_kind", ""))
+        except Exception:  # identity is best-effort (mocked params etc.)
+            pass
+        fam = self._prof_families()
+        harvested = 0
+        for fn, specs in self.warmup_call_plan():
+            family, tbl = fam.get(id(fn), ("unknown", None))
+            if family.startswith(("decode", "resident")):
+                key = family
+            else:
+                ppb = specs[tbl].shape[1] if tbl is not None else None
+                key = prof_key(family, specs[1].shape, ppb)
+            if prof.harvested(key):
+                continue
+            ca = None
+            try:
+                ca = fn.lower(*specs).cost_analysis()
+            except Exception:
+                logger.debug("cost harvest failed for %s", key,
+                             exc_info=True)
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            ca = ca or {}
+            meta: Dict[str, Any] = {}
+            if (family.startswith(("decode", "resident"))
+                    and self._decode_kernel is not None):
+                # which attention path this program lowers to — the
+                # flight-step tag, joined onto the variant row
+                meta["kernel"] = self._decode_kernel
+            elif family == "prefill.ragged":
+                from ..ops.layers import prefill_kernel_choice
+
+                meta["kernel"] = prefill_kernel_choice()
+            prof.record_variant(key, ca.get("flops"),
+                                ca.get("bytes accessed"), meta or None)
+            harvested += 1
+        return harvested
+
+    def _prof_families(self) -> Dict[int, Tuple[str, Optional[int]]]:
+        """id(jitted fn) -> (profiler family, prefix-table spec index)
+        for naming warmup-plan entries; the table index names the spec
+        whose trailing dim is the prefix-gather width (a compile axis)."""
+        fam: Dict[int, Tuple[str, Optional[int]]] = {}
+        for i, fn in enumerate(self._decode_variants):
+            fam[id(fn)] = (PROF_DECODE_KEYS[i], None)
+        if self._resident_variants is not None:
+            for i, fn in enumerate(self._resident_variants):
+                fam[id(fn)] = (PROF_RESIDENT_KEYS[i], None)
+        for name, family, tbl in (
+                ("_prefill_fused", "prefill.dense", None),
+                ("_prefill_paged_fused", "prefill.paged", None),
+                ("_prefill_paged_packed", "prefill.packed", None),
+                ("_prefill_ragged_fused", "prefill.ragged", None),
+                ("_prefill_paged_prefix_fused", "prefill.paged_prefix", 4),
+                ("_prefill_paged_resume_fused", "prefill.resume", 4),
+                ("_prefill_prefix_fused", "prefill.dense_prefix", 4)):
+            fn = getattr(self, name, None)
+            if fn is not None:
+                fam[id(fn)] = (family, tbl)
+        return fam
+
+    def _warmup_impl(self) -> float:
         """Pre-compile every jitted variant the serving loop can hit — the
         decode chunk plus one prefill per bucket — and return seconds spent.
 
@@ -2071,9 +2230,10 @@ class Engine:
     # ------------------------------------------------------------- the loop
 
     def _run(self) -> None:  # swarmlint: hot
-        # (token block, logprob block, snapshot, dispatch stamp) per chunk
+        # (token block, logprob block, snapshot, dispatch stamp, decode
+        # variant) per chunk
         in_flight: List[Tuple[Any, Any, List[Tuple[int, GenRequest, int]],
-                              int]] = []
+                              int, int]] = []
         while True:
             self._in_step = False
             self._beat()
@@ -3034,6 +3194,10 @@ class Engine:
         self.metrics.counters["prefill_packed_tokens"].inc(
             int(lengths[:len(batch)].sum()))
         self._last_wave_kind = "bucketed"
+        self._prof.wave("bucketed", bucket,
+                        int(lengths[:len(batch)].sum()),
+                        int(padded.size) - int(lengths[:len(batch)].sum()),
+                        prof_key("prefill.paged_prefix", padded.shape, ppb))
         pins: Dict[int, List[int]] = {}
         for slot_id, chain, toks, page_id in reg_records:
             if self._prefix.register(chain, toks, page_id):
@@ -3089,6 +3253,10 @@ class Engine:
         self.metrics.counters["prefill_packed_tokens"].inc(
             int(lengths[:len(batch)].sum()))
         self._last_wave_kind = "bucketed"
+        self._prof.wave("bucketed", bucket,
+                        int(lengths[:len(batch)].sum()),
+                        int(padded.size) - int(lengths[:len(batch)].sum()),
+                        prof_key("prefill.resume", padded.shape, ppb))
         self.metrics.counters["prefix_reused_tokens"].inc(int(rlens.sum()))
         self._activate([(s, r) for s, r, _ in batch], t0)
 
@@ -3142,6 +3310,10 @@ class Engine:
         self.metrics.counters["prefill_packed_tokens"].inc(
             int(lengths[:len(rows)].sum()))
         self._last_wave_kind = "bucketed"
+        self._prof.wave("bucketed", bucket,
+                        int(lengths[:len(rows)].sum()),
+                        int(padded.size) - int(lengths[:len(rows)].sum()),
+                        prof_key("prefill.dense_prefix", padded.shape, ppb))
         self._activate([(r[0], r[1]) for r in rows], t0)
 
     # swarmlint: hot
@@ -3279,6 +3451,10 @@ class Engine:
                 self._base_keys_np[gather], self._temp[gather],
                 self._topk[gather], self._topp[gather],
             )
+            # dispatch-shape profile: the tiny flush waves ROADMAP item 2
+            # wants sized show up here as named (ragged, small-width) rows
+            self._prof.wave("ragged", wd, filled, wd - filled,
+                            prof_key("prefill.ragged", tokens.shape))
             packed_n += filled
             padding_n += wd - filled
             pend = [it for it in pend if it[3] < len(it[1])]
@@ -3352,10 +3528,10 @@ class Engine:
             self._set_slot_key(slot_id, s.seed)
         # padding waste: grid tokens dispatched minus real prompt tokens
         # (bucket rounding + padding rows) — flight-recorder occupancy
-        self.metrics.counters["prefill_padding_tokens"].inc(
-            int(padded.size) - int(lengths[:n].sum()))
-        self.metrics.counters["prefill_packed_tokens"].inc(
-            int(lengths[:n].sum()))
+        packed_n = int(lengths[:n].sum())
+        padding_n = int(padded.size) - packed_n
+        self.metrics.counters["prefill_padding_tokens"].inc(padding_n)
+        self.metrics.counters["prefill_packed_tokens"].inc(packed_n)
         self._last_wave_kind = "bucketed"
 
         if not self.paged:
@@ -3368,6 +3544,8 @@ class Engine:
                     padded, lengths, scatter, self._base_keys_np[gather],
                     self._temp[gather], self._topk[gather],
                     self._topp[gather])
+            prof = self._prof
+            t0_ns = time.monotonic_ns() if prof.enabled else 0
             self.cache, self._last_tokens, self._last_lps = \
                 self._prefill_fused(
                     self.params,
@@ -3382,6 +3560,10 @@ class Engine:
                     self._topk[gather],
                     self._topp[gather],
                 )
+            if t0_ns:
+                key = prof_key("prefill.dense", padded.shape)
+                prof.dispatch(key, t0_ns, time.monotonic_ns() - t0_ns)
+                prof.wave("bucketed", bucket, packed_n, padding_n, key)
             self._activate(batch, t0)
             return
 
@@ -3418,6 +3600,9 @@ class Engine:
                 self._temp[p_gather], self._topk[p_gather],
                 self._topp[p_gather],
             )
+            self._prof.wave("packed", bucket, packed_n,
+                            int(p_tokens.size) - packed_n,
+                            prof_key("prefill.packed", p_tokens.shape))
             self._activate(batch, t0)
             return
         target = np.zeros((Bp, chunks), np.int32)
@@ -3432,6 +3617,8 @@ class Engine:
             self._base_keys_np[gather], self._temp[gather],
             self._topk[gather], self._topp[gather],
         )
+        self._prof.wave("bucketed", bucket, packed_n, padding_n,
+                        prof_key("prefill.paged", padded.shape))
         self._activate(batch, t0)
 
     def _activate(self, batch: List[Tuple[int, GenRequest]], t0: float) -> None:  # swarmlint: hot
@@ -3537,6 +3724,13 @@ class Engine:
             K = self.decode_chunk
             snapshot = [(i, req, pos0 + n * K) for i, req, pos0 in snap]
             now_ns = time.monotonic_ns()
+            prev_ns = self._resident_prev_ns
+            if prev_ns:
+                # resident-path device time: the emission-ring chunk
+                # boundary deltas ARE the chunk wall times — no sync,
+                # no block_until_ready, the issue's design point
+                self._prof.dispatch(self._prof_resident_key, prev_ns,
+                                    now_ns - prev_ns)
             self._process_host_block(np.asarray(block), np.asarray(lps),
                                      snapshot, self._resident_prev_ns)
             self._resident_prev_ns = now_ns
@@ -3618,6 +3812,7 @@ class Engine:
         max_chunks = np.int32(-(-max(1, max_rem) // K) + 1)
         variant = (0 if needs_filters else 1 if needs_sampling else 2)
         fn = self._resident_variants[variant]
+        self._prof_resident_key = PROF_RESIDENT_KEYS[variant]
         self._resident_snap = snap
         self._resident_prev_ns = time.monotonic_ns()
         self._lane_busy = True
@@ -3690,12 +3885,14 @@ class Engine:
             )
         # dispatch stamp: _process_block closes each snapshot slot's
         # "engine.decode_chunk" span against it (monotonic, so a wall
-        # clock step can't produce a negative chunk)
-        return all_toks, all_lps, snapshot, time.monotonic_ns()
+        # clock step can't produce a negative chunk); the variant index
+        # rides along so the chunk's device time lands on the right
+        # swarmprof key
+        return all_toks, all_lps, snapshot, time.monotonic_ns(), variant
 
     # swarmlint: hot
     def _process_block(self, all_toks, all_lps, snapshot,
-                       t_dispatch_ns: int = 0) -> None:
+                       t_dispatch_ns: int = 0, variant: int = -1) -> None:
         """Fetch one dispatched chunk's [K+1, B] token block (+ matching
         raw-model logprobs) with the one host sync and emit its tokens.
 
@@ -3718,6 +3915,12 @@ class Engine:
         self._host_sync_n += 1
         self.metrics.counters["phase_us_host_sync"].inc(
             (t_sync1 - t_sync0) // 1000)
+        if t_dispatch_ns and variant >= 0:
+            # scan-path device time: dispatch -> drained (pipelined
+            # chunks overlap, so per-variant sums can exceed wall clock
+            # — same stance as phase_us_decode)
+            self._prof.dispatch(PROF_DECODE_KEYS[variant], t_dispatch_ns,
+                                t_sync1 - t_dispatch_ns)
         self._process_host_block(np.asarray(block), np.asarray(lps),
                                  snapshot, t_dispatch_ns)
 
@@ -3950,9 +4153,13 @@ class Engine:
         target = np.zeros(self.max_seq // ps, np.int32)
         target[: n] = pages
         pk, pv = self._prefix_pool
+        t0_ns = time.monotonic_ns() if self._prof.enabled else 0
         try:
             pk, pv = self._extract_lane_fused(
                 self.cache, pk, pv, np.int32(slot_id), target)
+            if t0_ns:
+                self._prof.dispatch("extract.lane", t0_ns,
+                                    time.monotonic_ns() - t0_ns)
         except Exception:
             # dispatch failed: nothing read `pages` on device — return
             # them. If the source pages were already self-reuse-released

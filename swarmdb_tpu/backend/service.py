@@ -505,6 +505,26 @@ class ServingService:
             warmup = os.environ.get("SWARMDB_PREWARM", "0") == "1"
         if warmup:
             self.engine.warmup()
+        else:
+            # swarmprof (ISSUE 15): an operator who skipped prewarm still
+            # gets harvested cost-model facts (pure lowering — no
+            # compiles, no execution) and a duty-cycle clock anchored at
+            # serving start instead of engine construction. First-traffic
+            # compile stalls DO ride the device-time ledger on this path
+            # — prewarm is the clean-numbers configuration (README
+            # "Profiling").
+            try:
+                from ..obs.profiler import NullLane
+
+                for eng in getattr(self.engine, "lanes", [self.engine]):
+                    if (hasattr(eng, "profile_harvest")
+                            and not isinstance(eng._prof, NullLane)):
+                        eng.profile_harvest()
+                    prof = getattr(eng, "_prof", None)
+                    if prof is not None:
+                        prof.resume()
+            except Exception:
+                logger.exception("swarmprof startup harvest failed")
         self.engine.start()
         if self._reply_thread is None:
             self._reply_thread = threading.Thread(

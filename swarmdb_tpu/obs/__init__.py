@@ -21,14 +21,21 @@ can import the package without the ML stack.
   rolling-window SLO monitor that learns a baseline, runs the analyzer's
   attributor in-process on breach, and auto-dumps flight + trace
   evidence tagged with the alert id.
+- :mod:`.profiler` — swarmprof (``GET /admin/profile``): always-on
+  device-time profiler — XLA cost-model harvest at warmup, per-variant
+  invocation/device-time accounting, MFU/roofline classification,
+  per-lane duty cycles, and the dispatch-shape (wave kind x width)
+  profile.
 """
 
 from . import propagate
 from .flight import FlightRecorder
 from .metrics import HISTOGRAMS, Histogram, HistogramRegistry
+from .profiler import KernelProfiler, profile_enabled, profiler
 from .sentinel import SLOConfig, SLOSentinel
 from .tracer import TRACER, SpanTracer
 
 __all__ = ["FlightRecorder", "SpanTracer", "TRACER", "propagate",
            "HISTOGRAMS", "Histogram", "HistogramRegistry",
-           "SLOConfig", "SLOSentinel"]
+           "SLOConfig", "SLOSentinel",
+           "KernelProfiler", "profile_enabled", "profiler"]

@@ -66,7 +66,7 @@ import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["analyze_files", "summarize_trace", "summarize_flight",
-           "diagnose", "self_check", "main"]
+           "diagnose", "roofline_report", "self_check", "main"]
 
 #: span name -> cost category (everything engine-side that serializes
 #: the loop; routing spans are microseconds and excluded by design)
@@ -90,7 +90,8 @@ _WAVE_GAP_US = 2000.0  # prefill starts closer than this = same wave
 
 def load_file(path: str) -> Tuple[str, Any]:
     """('trace', events) for Chrome trace JSON, ('flight', dump) for a
-    flight-recorder dump; raises ValueError for anything else."""
+    flight-recorder dump, ('profile', dump) for a swarmprof dump;
+    raises ValueError for anything else."""
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     if isinstance(data, dict) and "traceEvents" in data:
@@ -98,8 +99,11 @@ def load_file(path: str) -> Tuple[str, Any]:
                          if e.get("ph") == "X"]
     if isinstance(data, dict) and "steps" in data and "requests" in data:
         return "flight", data
-    raise ValueError(f"{path}: neither a Chrome trace export "
-                     "(traceEvents) nor a flight dump (steps/requests)")
+    if isinstance(data, dict) and data.get("kind") == "swarmdb.profile":
+        return "profile", data
+    raise ValueError(f"{path}: not a Chrome trace export (traceEvents), "
+                     "a flight dump (steps/requests), or a swarmprof "
+                     "profile dump (kind=swarmdb.profile)")
 
 
 # --------------------------------------------------------------- summaries
@@ -389,16 +393,20 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
     dumps pair with the traces in the order given."""
     traces: List[Tuple[str, Dict[str, Any]]] = []
     flights: List[Tuple[str, Dict[str, Any]]] = []
+    profiles: List[Tuple[str, Dict[str, Any]]] = []
     inputs = []
     for path in paths:
         kind, data = load_file(path)
         inputs.append({"path": path, "kind": kind})
         if kind == "trace":
             traces.append((path, summarize_trace(data)))
+        elif kind == "profile":
+            profiles.append((path, data))
         else:
             flights.append((path, summarize_flight(data)))
     if not traces:
-        raise ValueError("need at least one Chrome trace export")
+        raise ValueError("need at least one Chrome trace export "
+                         "(use --roofline for profile dumps alone)")
     report: Dict[str, Any] = {
         "kind": "swarmdb.obs.analyze",
         "version": 1,
@@ -410,6 +418,10 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
     pagechecks = _pagecheck_dumps(paths)
     if pagechecks:
         report["pagecheck_dumps"] = pagechecks
+    profile_list = ([_profile_summary(p, d) for p, d in profiles]
+                    + _profile_dumps(paths))
+    if profile_list:
+        report["profile_dumps"] = profile_list
     base_flight = flights[0][1] if flights else None
     test_flight = flights[-1][1] if flights else None
     if len(traces) >= 2:
@@ -491,6 +503,103 @@ def _pagecheck_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
                 "pools": len(dump.get("pools") or []),
             })
     return out
+
+
+def _profile_summary(path: str, dump: Dict[str, Any]) -> Dict[str, Any]:
+    """One line per swarmprof dump for the main report: enough to spot
+    "the decode kernel ate 80% of device time at MFU 0.004" without
+    opening the file (the --roofline mode prints the full table)."""
+    variants = dump.get("variants") or []
+    top = variants[0] if variants else {}
+    return {
+        "path": path,
+        "node": dump.get("node"),
+        "platform": dump.get("platform"),
+        "mfu": dump.get("mfu"),
+        "variants": len(variants),
+        "top_variant": top.get("variant"),
+        "top_device_s": top.get("device_s"),
+        "tiny_flush_waves": dump.get("tiny_flush_waves", 0),
+    }
+
+
+def _profile_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """swarmprof dumps (``profile_*.json``, ISSUE 15) sitting next to
+    the analyzed flight/trace files — the device-time sibling of the
+    lockcheck/pagecheck listings above: the flight dump says what the
+    node was doing, the profile dump says which compiled programs the
+    device spent that time in."""
+    given = {os.path.abspath(p) for p in paths}
+    seen: set = set()
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        if d in seen:
+            continue
+        seen.add(d)
+        for cand in sorted(glob.glob(os.path.join(d, "profile_*.json"))):
+            if os.path.abspath(cand) in given:
+                continue
+            try:
+                with open(cand, "r", encoding="utf-8") as f:
+                    dump = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if dump.get("kind") != "swarmdb.profile":
+                continue
+            out.append(_profile_summary(cand, dump))
+    return out
+
+
+# ----------------------------------------------------------------- roofline
+
+
+def roofline_report(paths: Sequence[str],
+                    top_n: int = 10) -> Dict[str, Any]:
+    """``--roofline``: the kernel-level device-time report over swarmprof
+    dumps. For each dump: the platform peak table, the top-N variants by
+    cumulative device seconds (invocations, device_s, per-call FLOPs and
+    bytes, achieved FLOP/s, MFU, arithmetic intensity, compute- vs
+    memory-bound), per-lane duty cycles, and the dispatch-shape profile
+    with tiny ragged flush waves called out — ROADMAP item 2's "should
+    SWARMDB_RAGGED_MIN_WIDTH go up" is answered by ``tiny_flush_waves``
+    plus those rows' cumulative device time."""
+    dumps: List[Dict[str, Any]] = []
+    for path in paths:
+        kind, data = load_file(path)
+        if kind != "profile":
+            raise ValueError(f"{path}: --roofline takes swarmprof "
+                             "profile dumps (kind=swarmdb.profile)")
+        variants = list(data.get("variants") or [])
+        variants.sort(key=lambda v: -(v.get("device_s") or 0.0))
+        total_dev = sum(v.get("device_s") or 0.0 for v in variants)
+        top = []
+        for v in variants[:top_n]:
+            row = dict(v)
+            if total_dev > 0:
+                row["device_share"] = round(
+                    (v.get("device_s") or 0.0) / total_dev, 4)
+            top.append(row)
+        tiny = [w for w in (data.get("dispatch_profile") or [])
+                if w.get("tiny_flush")]
+        dumps.append({
+            "path": path,
+            "node": data.get("node"),
+            "platform": data.get("platform"),
+            "device_kind": data.get("device_kind"),
+            "peaks": data.get("peaks"),
+            "mfu": data.get("mfu"),
+            "device_s_total": round(total_dev, 6),
+            "top_variants": top,
+            "lanes": data.get("lanes"),
+            "tiny_flush_waves": data.get("tiny_flush_waves", 0),
+            "tiny_flush_rows": tiny,
+        })
+    return {
+        "kind": "swarmdb.obs.roofline",
+        "version": 1,
+        "dumps": dumps,
+    }
 
 
 # --------------------------------------------------------------- self-check
@@ -598,6 +707,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--self-check", action="store_true",
                     help="run the pipeline on synthetic data and verify "
                          "its invariants (CI)")
+    ap.add_argument("--roofline", action="store_true",
+                    help="kernel-level roofline report over swarmprof "
+                         "profile dumps (profile_*.json): top device-"
+                         "time variants, MFU, compute- vs memory-bound, "
+                         "lane duty cycles, tiny ragged flush waves")
     args = ap.parse_args(argv)
 
     if args.self_check:
@@ -608,7 +722,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.paths:
         ap.error("no input files (or use --self-check)")
     try:
-        report = analyze_files(args.paths)
+        report = (roofline_report(args.paths) if args.roofline
+                  else analyze_files(args.paths))
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"analyze: {exc}", file=sys.stderr)
         return 2

@@ -199,6 +199,7 @@ class FlightRecorder:
             if directory:
                 path = self.dump_to(directory, reason)
                 logger.info("flight record dumped to %s (%s)", path, reason)
+                self._profile_dump(reason, directory)
                 return path
             self.last_dump = self.dump(reason)
             return None
@@ -209,3 +210,17 @@ class FlightRecorder:
             except Exception:
                 pass
             return None
+
+    @staticmethod
+    def _profile_dump(reason: str, directory: str) -> None:
+        """swarmprof dump riding every flight auto-dump (ISSUE 15): the
+        failure paths that ship flight evidence — watchdog restarts,
+        sentinel alerts, CI failure artifacts — ship the kernel-level
+        device-time picture too. Best-effort, never raises."""
+        try:
+            from .profiler import profile_enabled, profiler
+
+            if profile_enabled():
+                profiler().auto_dump(reason, directory)
+        except Exception:
+            logger.exception("profile dump failed (%s)", reason)

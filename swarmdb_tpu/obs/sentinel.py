@@ -81,7 +81,8 @@ class SLOConfig:
 
     __slots__ = ("window_s", "warmup_windows", "min_completions",
                  "ttft_p95_s", "queue_p95_s", "cost_growth_x",
-                 "retry_rate", "max_alerts", "enabled")
+                 "retry_rate", "mfu_drop_x", "duty_drop_x",
+                 "max_alerts", "enabled")
 
     def __init__(self,
                  window_s: Optional[float] = None,
@@ -91,6 +92,8 @@ class SLOConfig:
                  queue_p95_s: Optional[float] = None,
                  cost_growth_x: Optional[float] = None,
                  retry_rate: Optional[float] = None,
+                 mfu_drop_x: Optional[float] = None,
+                 duty_drop_x: Optional[float] = None,
                  max_alerts: Optional[int] = None,
                  enabled: Optional[bool] = None) -> None:
         self.window_s = window_s if window_s is not None else \
@@ -115,6 +118,14 @@ class SLOConfig:
         # enough to trip the cost SLO.
         self.retry_rate = retry_rate if retry_rate is not None else \
             _env_float("SWARMDB_SLO_RETRY_RATE", 0.5)
+        # swarmprof regression SLOs (ISSUE 15): a busy window whose MFU
+        # (or worst lane duty cycle) fell past baseline/<factor> breaches
+        # even while throughput holds — silicon efficiency is a
+        # first-class SLO, not a bench-time afterthought. <= 1 disables.
+        self.mfu_drop_x = mfu_drop_x if mfu_drop_x is not None else \
+            _env_float("SWARMDB_SLO_MFU_DROP_X", 3.0)
+        self.duty_drop_x = duty_drop_x if duty_drop_x is not None else \
+            _env_float("SWARMDB_SLO_DUTY_DROP_X", 3.0)
         self.max_alerts = max_alerts if max_alerts is not None else \
             _env_int("SWARMDB_SLO_ALERTS", 64)
         self.enabled = enabled if enabled is not None else \
@@ -157,6 +168,9 @@ class SLOSentinel:
         self._prev_counters: Optional[Dict[str, int]] = None
         self._prev_ttft: List[int] = list(HIST_TTFT.counts)
         self._prev_queue: List[int] = list(HIST_QUEUE_WAIT.counts)
+        # swarmprof cumulative snapshot of the previous close (window
+        # MFU / duty cycles are deltas, like every other window number)
+        self._prev_prof: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- wiring
 
@@ -179,6 +193,7 @@ class SLOSentinel:
             self._deadline = time.monotonic() + self.config.window_s
             self._window_opened = time.time()
             self._prev_counters = None  # re-anchor, don't bill the gap
+            self._prev_prof = None
 
     # -------------------------------------------------------- record path
 
@@ -301,7 +316,37 @@ class SLOSentinel:
                 (cur["phase_us_host_sync"] - prev["phase_us_host_sync"])
                 / 1e3 / chunks, 3),
         }
+        self._profile_window(window)
         self.ingest(window)
+
+    def _profile_window(self, window: Dict[str, Any]) -> None:
+        """Fold swarmprof deltas into the closing window: executed-FLOPs
+        MFU over the window's wall time and the minimum per-lane duty
+        cycle — the silicon-efficiency numbers the mfu_drop_x /
+        duty_drop_x SLOs watch. No-op with the profiler off."""
+        try:
+            from .profiler import profile_enabled, profiler
+        except Exception:  # pragma: no cover - import is stdlib-only
+            return
+        if not profile_enabled():
+            return
+        prof = profiler()
+        cur = prof.counters_snapshot()
+        prev, self._prev_prof = self._prev_prof, cur
+        if prev is None:
+            return
+        span_s = max(1e-6, (cur["mono_ns"] - prev["mono_ns"]) / 1e9)
+        peaks = prof.peaks()
+        dflops = cur["flops_total"] - prev["flops_total"]
+        if peaks.get("peak_flops") and dflops > 0:
+            window["mfu"] = round(
+                dflops / span_s / peaks["peak_flops"], 6)
+        duties = []
+        for lane, busy in cur["lane_busy_ns"].items():
+            dbusy = busy - prev["lane_busy_ns"].get(lane, 0)
+            duties.append(min(1.0, max(0.0, dbusy / (span_s * 1e9))))
+        if duties:
+            window["min_lane_duty"] = round(min(duties), 4)
 
     # ---------------------------------------------------------- detection
 
@@ -322,6 +367,8 @@ class SLOSentinel:
         w.setdefault("retried", 0)
         w.setdefault("retry_rate",
                      round(w["retried"] / max(1, w["completed"]), 3))
+        w.setdefault("mfu", None)
+        w.setdefault("min_lane_duty", None)
         return w
 
     def _baseline_from_warmup(self) -> Dict[str, Any]:
@@ -341,9 +388,10 @@ class SLOSentinel:
             "mean_wave_size": round(
                 sum(w["mean_wave_size"] for w in self._warmup) / n, 2),
         }
-        for key in ("p95_ttft_s", "p95_queue_wait_s"):
+        for key in ("p95_ttft_s", "p95_queue_wait_s", "mfu",
+                    "min_lane_duty"):
             vals = [w[key] for w in self._warmup if w.get(key) is not None]
-            base[key] = round(sum(vals) / len(vals), 4) if vals else None
+            base[key] = round(sum(vals) / len(vals), 6) if vals else None
         return base
 
     def ingest(self, window: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -395,6 +443,25 @@ class SLOSentinel:
             breaches.append({"slo": "cost_growth_x",
                              "limit": cfg.cost_growth_x,
                              "value": round(growth, 2)})
+        # swarmprof regression SLOs (ISSUE 15): a BUSY window (the idle
+        # guard already gated) whose MFU or worst lane duty collapsed
+        # past baseline/<drop factor> — silicon efficiency falling while
+        # throughput holds is a regression, not a curiosity. Baselines
+        # of None (profiler off during warmup) disable each check.
+        mfu, base_mfu = window.get("mfu"), self.baseline.get("mfu")
+        if (mfu is not None and base_mfu and cfg.mfu_drop_x > 1.0
+                and mfu < base_mfu / cfg.mfu_drop_x):
+            breaches.append({"slo": "mfu_drop_x",
+                             "limit": round(base_mfu / cfg.mfu_drop_x, 6),
+                             "value": mfu})
+        duty = window.get("min_lane_duty")
+        base_duty = self.baseline.get("min_lane_duty")
+        if (duty is not None and base_duty and cfg.duty_drop_x > 1.0
+                and duty < base_duty / cfg.duty_drop_x):
+            breaches.append({"slo": "duty_drop_x",
+                             "limit": round(base_duty / cfg.duty_drop_x,
+                                            4),
+                             "value": duty})
         return breaches
 
     def _fire_alert(self, window: Dict[str, Any],
@@ -532,6 +599,13 @@ class SLOSentinel:
         if w.get("retry_rate") is not None:
             lines.append("# TYPE swarmdb_slo_retry_rate gauge")
             lines.append(f"swarmdb_slo_retry_rate {w['retry_rate']}")
+        if w.get("mfu") is not None:
+            lines.append("# TYPE swarmdb_slo_window_mfu gauge")
+            lines.append(f"swarmdb_slo_window_mfu {w['mfu']}")
+        if w.get("min_lane_duty") is not None:
+            lines.append("# TYPE swarmdb_slo_min_lane_duty gauge")
+            lines.append(
+                f"swarmdb_slo_min_lane_duty {w['min_lane_duty']}")
         if w.get("per_completion_ms"):
             lines.append("# TYPE swarmdb_slo_per_completion_ms gauge")
             for cat in CATEGORIES:

@@ -92,6 +92,19 @@ def decode_kernel_choice(kv_span: Optional[int] = None) -> str:
     return "pallas" if _paged_pallas_enabled(kv_span) else "gather"
 
 
+def prefill_kernel_choice() -> str:
+    """Host-side view of the ragged-prefill dispatch (the prefill twin
+    of :func:`decode_kernel_choice`): ``"pallas-ragged"`` when
+    ``ragged_prefill_dispatch`` would run the Pallas kernel,
+    ``"xla-reference"`` for the dense fallback. swarmprof stamps this
+    onto the ragged prefill variants' metadata at harvest time, so a
+    profile dump says WHICH kernel its device seconds measured — the
+    same record-provenance rule the bench's ``kernel`` field enforces
+    for decode."""
+    return ("pallas-ragged" if _ragged_prefill_kernel_enabled()
+            else "xla-reference")
+
+
 def _ragged_prefill_kernel_enabled() -> bool:
     """Gate for the ragged paged PREFILL kernel: SWARMDB_PALLAS=0 forces
     the XLA reference fallback, =1 forces the kernel even off-TPU

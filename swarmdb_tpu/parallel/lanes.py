@@ -182,6 +182,10 @@ class ShardLaneGroup:
             eng.flight_shard = idx
             eng._flight_dir = self._flight_dir
             eng.overlap_probe = self._make_probe(idx)
+            # swarmprof duty cycles name lanes the way pagecheck does:
+            # lane d's busy fraction is the admission-overlap win made
+            # into a per-lane number (GET /admin/profile, /metrics)
+            eng._prof.set_label(f"lane{idx}")
 
     def _make_probe(self, idx: int) -> Callable[[], bool]:
         def probe() -> bool:

@@ -1,0 +1,33 @@
+"""swarmlint fixture: SWL506 — compile-time introspection in hot code.
+
+The swarmprof cost harvest (``lower()`` + ``cost_analysis()``) runs the
+tracer and the XLA cost model — compile-speed work. On a dispatch path
+it turns every admission wave into a re-trace. Expected findings are
+marked; the clean function shows the sanctioned shape (counters only on
+the hot path, harvest in warmup).
+"""
+
+
+class Dispatcher:
+    def warmup(self):
+        # clean: harvest at warmup is THE sanctioned site
+        for fn, specs in self.plan():
+            fn.lower(*specs).cost_analysis()
+
+    # swarmlint: hot
+    def dispatch_bad_cost(self, fn, specs, args):
+        ca = fn.lower(*specs).cost_analysis()  # EXPECT: SWL506
+        self.flops = ca.get("flops")
+        return fn(*args)
+
+    # swarmlint: hot
+    def dispatch_bad_lower(self, fn, specs, args):
+        self.lowered = fn.lower(*specs)  # EXPECT: SWL506
+        return fn(*args)
+
+    # swarmlint: hot
+    def dispatch_clean(self, fn, key, args):
+        # str.lower() is the string method, not a jax lowering — clean
+        name = key.lower()
+        self.prof.dispatch(name, 0, 0)
+        return fn(*args)

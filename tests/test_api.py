@@ -675,6 +675,63 @@ def test_admin_pagecheck_endpoint(tmp_path, monkeypatch):
         pagecheck.registry().reset()
 
 
+def test_admin_profile_endpoint(tmp_path, monkeypatch):
+    """GET /admin/profile: 503 with SWARMDB_PROFILE=0 (an empty report
+    would read as "no device time spent" when nothing watched); on by
+    default it returns the swarmprof report — peaks, variants, lanes,
+    dispatch profile — and /metrics grows the swarmdb_mfu /
+    swarmdb_lane_duty_cycle / swarmdb_kernel_* lines (ISSUE 15)."""
+    monkeypatch.setenv("SWARMDB_PROFILE", "0")
+
+    async def drive_off(client, db):
+        headers = await get_token(client, "admin", "pw")
+        r = await client.get("/admin/profile", headers=headers)
+        assert r.status == 503
+        # /metrics drops the profiler lines with the flag off
+        r = await client.get("/metrics")
+        assert "swarmdb_mfu" not in await r.text()
+
+    api_drive(drive_off, tmp_path)
+
+    monkeypatch.delenv("SWARMDB_PROFILE", raising=False)
+    from swarmdb_tpu.obs.profiler import profiler
+
+    prof = profiler()
+    prof.reset()
+    try:
+        prof.set_platform("cpu", "")
+        prof.record_variant("api.test.variant", 2.0e6, 4.0e6)
+        lane = prof.lane("api-test-lane")
+        lane.dispatch("api.test.variant", 0, 1_000_000)
+        lane.wave("ragged", 1, 1, 0, "api.test.variant")
+
+        async def drive_on(client, db):
+            headers = await get_token(client, "admin", "pw")
+            r = await client.get("/admin/profile", headers=headers)
+            assert r.status == 200
+            report = await r.json()
+            assert report["enabled"] is True
+            assert report["peaks"]["peak_flops"] > 0
+            row = next(v for v in report["variants"]
+                       if v["variant"] == "api.test.variant")
+            assert row["invocations"] == 1
+            assert row["roofline"] in ("compute-bound", "memory-bound")
+            assert any(l["lane"] == "api-test-lane"
+                       for l in report["lanes"])
+            assert report["tiny_flush_waves"] >= 1
+            r = await client.get("/metrics")
+            assert r.status == 200
+            body = await r.text()
+            assert "swarmdb_mfu" in body
+            assert 'swarmdb_lane_duty_cycle{lane="api-test-lane"}' in body
+            assert ('swarmdb_kernel_device_seconds_total'
+                    '{variant="api.test.variant"}') in body
+
+        api_drive(drive_on, tmp_path)
+    finally:
+        prof.reset()
+
+
 def test_worker_recycling_hook(tmp_path):
     """cfg.max_requests fires the recycle hook exactly once after the
     threshold (gunicorn max_requests counterpart)."""

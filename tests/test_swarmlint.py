@@ -44,6 +44,7 @@ def expected_findings(path: Path):
     "span_bad.py",              # span-discipline family (SWL501/502)
     "metrics_bad.py",           # histogram discipline (SWL503)
     "exemplar_bad.py",          # exemplar/sentinel allocation (SWL504)
+    "profile_bad.py",           # compile-time introspection in hot code (SWL506)
     "heartbeat_bad.py",         # heartbeat-safety family (SWL601/602)
     "fence_bad.py",             # fencing discipline (SWL603)
     "retry_bad.py",             # retry-discipline family (SWL701)
