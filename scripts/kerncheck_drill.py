@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Seeded kernel-crime drill — the kernel sanitizer's NEGATIVE test.
+
+The CI ``kerncheck`` job runs the kernel/ragged suites under
+``SWARMDB_KERNCHECK=1`` and fails on any violation; this script is the
+other direction: it deliberately commits every kernel crime the shadow
+interpreter hunts — an out-of-bounds page id in a wave's write
+descriptors (SWL901-class), a sabotaged kernel that skips one row's
+finalize so the canary survives (SWL905-class), and an unmasked
+finalize whose grid rows race on the shared output block
+(SWL902-class) — and exits non-zero unless the detector FIRED on each
+and dumped evidence to disk. A green kerncheck run only means
+something if this drill stays red-on-crime.
+
+Run: SWARMDB_KERNCHECK=1 python scripts/kerncheck_drill.py
+(the script forces the flag itself so a bare invocation also works).
+"""
+
+import functools
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("SWARMDB_KERNCHECK", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SWARMDB_NODE_ID", "kerncheck-drill")
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from swarmdb_tpu.obs import kerncheck
+    from swarmdb_tpu.ops import attention_pallas as ap
+
+    dump_dir = os.environ.get("SWARMDB_FLIGHT_DIR")
+    if not dump_dir:
+        dump_dir = tempfile.mkdtemp(prefix="kerncheck-drill-")
+        os.environ["SWARMDB_FLIGHT_DIR"] = dump_dir
+
+    if not kerncheck.enabled():
+        print("FAIL: SWARMDB_KERNCHECK=1 did not enable the sanitizer")
+        return 1
+
+    rng = np.random.default_rng(0)
+    (q, sk, sv, kp, vp, tables, starts, lens, plens,
+     _tok_row) = kerncheck._random_ragged_case(rng)
+    ps = np.asarray(kp).shape[1]
+    P = np.asarray(kp).shape[0]
+    maxp = np.asarray(tables).shape[1]
+    W = np.asarray(q).shape[0]
+    base = functools.partial(
+        ap._ragged_prefill_kernel, page_size=ps,
+        n_kv_heads=np.asarray(kp).shape[2], n_pages=maxp,
+        tile=min(128, W), window=None)
+
+    # -- crime 1: OOB page id in the wave's write descriptors ---------
+    bad_tables = np.array(np.asarray(tables), copy=True)
+    live_r = int(np.nonzero(np.asarray(lens) > 0)[0][0])
+    bad_tables[live_r, 0] = P + 7                 # points past the pool
+    kerncheck.check_wave_descriptors(
+        np.array([live_r], np.int32),
+        np.array([0], np.int32), bad_tables, P, ps)
+
+    # -- crime 2: short write (one live row's finalize skipped) -------
+    def short_write(*refs):
+        if (pl.program_id(0) == live_r
+                and pl.program_id(1) == pl.num_programs(1) - 1):
+            return
+        base(*refs)
+
+    kerncheck.shadow_ragged_prefill(
+        q, sk, sv, kp, vp, tables, starts, lens, plens,
+        kernel=short_write)
+
+    # -- crime 3: block race (unmasked finalize, varying values) ------
+    def unmasked(*refs):
+        base(*refs)
+        o_ref = refs[9]
+        o_ref[...] = (np.zeros(o_ref.shape, np.float32)
+                      + 1.5 * (pl.program_id(0) + 1)
+                      + 0.25 * pl.program_id(1))
+
+    kerncheck.shadow_ragged_prefill(
+        q, sk, sv, kp, vp, tables, starts, lens, plens,
+        kernel=unmasked)
+
+    kinds = {v["kind"] for v in kerncheck.registry().violations()}
+    want = {"oob-block", "short-write", "write-race"}
+    missing = want - kinds
+    dump = os.path.join(dump_dir, "kerncheck_kerncheck-drill.json")
+    print(f"violations recorded: {sorted(kinds)}")
+    print(f"dump: {dump} exists={os.path.exists(dump)}")
+    if missing:
+        print(f"FAIL: detector did not fire for: {sorted(missing)}")
+        return 1
+    if not os.path.exists(dump):
+        print("FAIL: violation dump never landed on disk")
+        return 1
+    print("OK: every seeded kernel crime was detected and dumped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

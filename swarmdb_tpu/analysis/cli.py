@@ -27,7 +27,10 @@ def _build_parser() -> argparse.ArgumentParser:
         description="swarmlint: JAX-aware static analysis (host-sync, "
                     "recompile, lock-discipline incl. interprocedural "
                     "lock-order/guarded-by inference, tracer-leak, "
-                    "span-discipline, heartbeat/fencing, retry)")
+                    "span-discipline, heartbeat/fencing, retry, "
+                    "page-lifetime, Pallas kernel-check: grid/index-map "
+                    "bounds, write races, VMEM budget, tiling, output "
+                    "coverage)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to scan "
                          "(default: swarmdb_tpu/)")

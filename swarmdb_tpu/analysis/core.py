@@ -72,6 +72,12 @@ Directive grammar (comments beginning ``# swarmlint:``):
     does NOT discharge the caller's ownership (the default for an
     unresolvable call is the conservative "escaped"), so the caller
     must still free/escape the handle on every path.
+``# swarmlint: revisit[<dim>[, <dim>]] [-- reason]``
+    Kernel-layer declaration (kernelcheck.py, SWL902): inside the
+    pallas_call wrapper it annotates, the output block index map is
+    ALLOWED to ignore the named grid dims (axis indices or index-map
+    parameter names) — the revisit is a deliberate accumulate/finalize
+    (e.g. the ragged prefill's masked finalize), not a write race.
 """
 
 from __future__ import annotations
@@ -210,6 +216,32 @@ RULES: Dict[str, Rule] = {
              "table write before the allocator call that produces it "
              "on this path — the row blesses page ids the pool has "
              "not granted"),
+        Rule("SWL901", "kernel-check",
+             "out-of-bounds block: a pallas_call index map times its "
+             "block shape can exceed the operand extent (or go "
+             "negative) on some grid coordinate — the kernel reads or "
+             "writes memory outside its operand"),
+        Rule("SWL902", "kernel-check",
+             "grid write race: the output block index map ignores a "
+             "non-innermost grid axis, so multiple grid coordinates "
+             "write the same output block — only the last step's "
+             "contribution survives unless the revisit is a declared "
+             "accumulate/finalize (`# swarmlint: revisit[<dim>]`)"),
+        Rule("SWL903", "kernel-check",
+             "VMEM budget: the per-grid-step block footprint (double-"
+             "buffered in/out blocks + VMEM scratch) nears (>=80%) or "
+             "exceeds the platform VMEM budget — the kernel will spill "
+             "or fail to lower on silicon"),
+        Rule("SWL904", "kernel-check",
+             "tiling misalignment: a block's minor dims are not "
+             "multiples of the dtype's sublane x lane tile (8x128 f32, "
+             "16x128 bf16, 32x128 int8) — partial tiles burn VPU/MXU "
+             "issue slots on dead lanes"),
+        Rule("SWL905", "kernel-check",
+             "unwritten output: no store to an output ref is reachable "
+             "(none exists, or every store sits under a provably "
+             "unsatisfiable @pl.when guard) — grid cells hand back "
+             "stale VMEM garbage as results"),
     )
 }
 
@@ -290,6 +322,9 @@ class Directives:
     # line -> parameter names (or "return") taking/borrowing ownership
     page_owns: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
     page_borrows: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
+    # sanctioned output-block revisits (kernelcheck SWL902): line ->
+    # grid dims (axis indices or index-map parameter names)
+    revisits: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
 
 
 def _parse_directive(body: str, line: int, out: Directives) -> None:
@@ -329,6 +364,12 @@ def _parse_directive(body: str, line: int, out: Directives) -> None:
     m = re.match(r"holds\[(?P<guard>[^\]]+)\]\s*$", body)
     if m:
         out.holds[line] = m.group("guard").strip()
+        return
+    m = re.match(r"revisit\[(?P<dims>[^\]]+)\]\s*(?:--.*)?$", body)
+    if m:
+        dims = tuple(d.strip() for d in m.group("dims").split(",")
+                     if d.strip())
+        out.revisits[line] = dims
         return
     m = re.match(r"(?P<kind>owns|borrows)\[page\]\s*:\s*(?P<names>.+)$",
                  body)
@@ -658,13 +699,13 @@ def _parse_source(path: str, text: Optional[str] = None) -> SourceFile:
 
 
 def _per_file_findings(src: SourceFile) -> List[Finding]:
-    from . import heartbeat, hostsync, locks, recompile, retry, spans, \
-        tracers
+    from . import heartbeat, hostsync, kernelcheck, locks, recompile, \
+        retry, spans, tracers
 
     findings: List[Finding] = []
     for checker in (hostsync.check, recompile.check, locks.check,
                     tracers.check, spans.check, heartbeat.check,
-                    retry.check):
+                    retry.check, kernelcheck.check):
         findings.extend(checker(src))
     return findings
 

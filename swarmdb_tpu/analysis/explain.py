@@ -355,4 +355,94 @@ EXPLAIN: Dict[str, Dict[str, str]] = {
                 "        if self._try(): return\n"
                 "        time.sleep(backoff * 2 ** i)",
     },
+    "SWL901": {
+        "doc": "A pallas_call index map returns BLOCK indices: the block "
+               "covers elements [idx*block, idx*block + block). When "
+               "that interval can leave the operand extent on some grid "
+               "coordinate, the kernel reads (or worse, writes) memory "
+               "outside its operand — silently wrong attention output, "
+               "not a crash. Axes whose index depends on scalar-prefetch "
+               "DATA (page tables, row descriptors) are skipped here; "
+               "the SWARMDB_KERNCHECK runtime bounds wrapper owns those.",
+        "bad": "pl.pallas_call(kernel,\n"
+               "    grid=(B,),\n"
+               "    in_specs=[pl.BlockSpec((2, H, D),\n"
+               "                           lambda b: (b, 0, 0))],\n"
+               "    # block b covers rows [2b, 2b+2) of a B-row operand\n"
+               "    out_shape=...)",
+        "good": "pl.pallas_call(kernel,\n"
+                "    grid=(B,),\n"
+                "    in_specs=[pl.BlockSpec((1, H, D),\n"
+                "                           lambda b: (b, 0, 0))],\n"
+                "    # rows [b, b+1): b <= B-1 keeps the block inside\n"
+                "    out_shape=...)",
+    },
+    "SWL902": {
+        "doc": "When the output block index map ignores a non-innermost "
+               "grid axis, every value of that coordinate maps to the "
+               "SAME output block — on TPU's sequential grid the last "
+               "step silently wins. A deliberate accumulate-then-"
+               "finalize revisit (the ragged prefill's masked finalize) "
+               "is legal: declare it with `# swarmlint: revisit[<dim>]` "
+               "inside the wrapper. Ignoring the innermost axis is the "
+               "standard sequential-accumulation idiom and never fires.",
+        "bad": "grid=(R, n_steps)\n"
+               "out_specs=pl.BlockSpec((W, H, D),\n"
+               "                       lambda r, j: (0, 0, 0))\n"
+               "# axis 0 ('r') ignored and undeclared: rows overwrite\n"
+               "# each other's output block",
+        "good": "# swarmlint: revisit[r] -- masked finalize writes each\n"
+                "# row's lanes exactly once on the last grid step\n"
+                "out_specs=pl.BlockSpec((W, H, D),\n"
+                "                       lambda r, j: (0, 0, 0))",
+    },
+    "SWL903": {
+        "doc": "Pallas double-buffers every pipelined in/out block, so "
+               "the per-grid-step VMEM footprint is 2x the block bytes "
+               "plus scratch. Past the platform budget (~16 MiB/core; "
+               "see kernelcheck.PLATFORM_VMEM_BYTES, override with "
+               "SWARMDB_VMEM_BYTES) the kernel spills or fails to "
+               "lower; the checker warns at 80% and errors past 100%. "
+               "Fires only on fully concrete footprints — symbolic ones "
+               "become /admin/profile estimates instead.",
+        "bad": "in_specs=[pl.BlockSpec((4096, 2048),\n"
+               "                       lambda i: (0, 0))]\n"
+               "# 4096*2048*4 B doubled = 64 MiB of VMEM for one block",
+        "good": "grid=(32,)\n"
+                "in_specs=[pl.BlockSpec((128, 2048),\n"
+                "                       lambda i: (i, 0))]\n"
+                "# 2 MiB per step: stream the rows through the grid",
+    },
+    "SWL904": {
+        "doc": "TPU vector memory is tiled (sublane x lane): 8x128 f32, "
+               "16x128 bf16, 32x128 int8. A block whose minor dims are "
+               "not tile multiples still lowers, but every partial tile "
+               "burns VPU/MXU issue slots on dead lanes — the int8 row "
+               "is exactly what the quantized-KV sprint needs policed.",
+        "bad": "# int8 pages need 32-row sublane groups, not 16\n"
+               "in_specs=[pl.BlockSpec((16, 128), lambda i: (i, 0))]\n"
+               "out_shape=jax.ShapeDtypeStruct((N, 128), jnp.int8)",
+        "good": "in_specs=[pl.BlockSpec((32, 128), lambda i: (i, 0))]\n"
+                "out_shape=jax.ShapeDtypeStruct((N, 128), jnp.int8)",
+    },
+    "SWL905": {
+        "doc": "An output block a kernel never stores to hands back "
+               "whatever was in VMEM — stale garbage that changes run "
+               "to run. The checker fires when no store to an output "
+               "ref exists, or every store sits under a @pl.when guard "
+               "that is provably unsatisfiable over the grid. Data-"
+               "dependent guards are assumed coverable here; the "
+               "SWARMDB_KERNCHECK canary (pre-poisoned outputs verified "
+               "fully overwritten per row descriptor) owns them.",
+        "bad": "def kernel(x_ref, o_ref):\n"
+               "    j = pl.program_id(1)\n"
+               "    @pl.when(j == n_steps)  # grid stops at n_steps - 1\n"
+               "    def _store():\n"
+               "        o_ref[...] = acc",
+        "good": "def kernel(x_ref, o_ref):\n"
+                "    j = pl.program_id(1)\n"
+                "    @pl.when(j == pl.num_programs(1) - 1)\n"
+                "    def _store():\n"
+                "        o_ref[...] = acc",
+    },
 }

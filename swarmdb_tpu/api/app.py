@@ -36,6 +36,7 @@ from pydantic import ValidationError
 from ..core.messages import MessageStatus
 from ..core.runtime import SwarmDB
 from ..obs import HISTOGRAMS, TRACER, propagate
+from ..obs.kerncheck import enabled as kerncheck_enabled
 from ..obs.pagecheck import enabled as pagecheck_enabled
 from ..obs.profiler import profile_enabled, profiler as kernel_profiler
 from ..utils import jwt as jwt_util
@@ -743,6 +744,11 @@ def create_app(
 
             lines.extend(await _run_sync(
                 pagecheck.registry().prometheus_lines))
+        if kerncheck_enabled():
+            from ..obs import kerncheck
+
+            lines.extend(await _run_sync(
+                kerncheck.registry().prometheus_lines))
         # swarmprof (ISSUE 15, SWARMDB_PROFILE — default on): aggregate
         # MFU, per-lane duty cycles, per-variant device seconds /
         # invocations. The pager line is swarmdb_mfu (or a lane's duty)
@@ -1021,6 +1027,23 @@ def create_app(
         return web.json_response(
             await _run_sync(pagecheck.registry().report))
 
+    async def admin_kerncheck(request: web.Request) -> web.Response:
+        """GET /admin/kerncheck — the interpreter-mode kernel
+        sanitizer's full report (SWARMDB_KERNCHECK=1): per-check shadow
+        run tallies and every recorded violation (out-of-bounds block /
+        Ref slice, grid write race, short-written output row, kernel-vs-
+        reference parity break) with the offending kernel, grid cell and
+        slice. 503 with the flag off — an empty report would read as
+        "no kernel bugs" when nothing watched."""
+        require_admin(current_agent(request))
+        if not kerncheck_enabled():
+            raise _error(503, "kernel sanitizer off — set "
+                              "SWARMDB_KERNCHECK=1")
+        from ..obs import kerncheck
+
+        return web.json_response(
+            await _run_sync(kerncheck.registry().report))
+
     async def admin_profile(request: web.Request) -> web.Response:
         """GET /admin/profile — the swarmprof report (ISSUE 15): the
         platform peak table, every compiled variant's invocations /
@@ -1220,6 +1243,7 @@ def create_app(
         web.get("/admin/lanes", admin_lanes),
         web.get("/admin/lockcheck", admin_lockcheck),
         web.get("/admin/pagecheck", admin_pagecheck),
+        web.get("/admin/kerncheck", admin_kerncheck),
         web.get("/admin/profile", admin_profile),
     ])
 

@@ -329,6 +329,16 @@ class Engine:
             from ..obs.pagecheck import registry as _pagecheck_registry
 
             _pagecheck_registry().attach_flight(self.flight)
+        # interpreter-mode kernel sanitizer (SWARMDB_KERNCHECK=1,
+        # obs/kerncheck.py): same one-env-read gate; attaching the flight
+        # recorder arms violation instants + the atexit crash dump
+        from ..obs.kerncheck import enabled as _kerncheck_enabled
+
+        self._kerncheck = _kerncheck_enabled()
+        if self._kerncheck:
+            from ..obs.kerncheck import registry as _kerncheck_registry
+
+            _kerncheck_registry().attach_flight(self.flight)
         # main decode cache: paged pool or dense slot buffer; prefill always
         # uses dense bucket-sized temp caches from init_cache_fn
         self.cache = paged.init_pool() if paged else init_cache_fn(max_batch, max_seq)
@@ -810,9 +820,14 @@ class Engine:
         # from the page pool (ops/layers.ragged_prefill_dispatch — the
         # Pallas ragged-paged-prefill kernel on TPU). Wave widths come off
         # a power-of-two ladder whose smallest rung (SWARMDB_RAGGED_MIN_
-        # WIDTH, default 1) makes every admission round an EXACT binary
-        # decomposition — padding_tokens ~0 where the row-bucketed path
-        # paid 12% — and the ladder is the ONLY compiled-variant axis:
+        # WIDTH, default 8) makes every admission round a near-exact
+        # binary decomposition — padding_tokens ~0 where the row-bucketed
+        # path paid 12%. The floor sits at 8 (one TPU sublane quantum)
+        # rather than 1: rungs below 8 each compile a program that the
+        # dispatcher immediately pads back up to width 8, so they add
+        # compiled variants and per-wave dispatch overhead while moving
+        # zero extra real tokens (PROFILE.md round 11 A/B). The ladder is
+        # the ONLY compiled-variant axis:
         # |widths| programs replace |buckets| x |row buckets| (+ the whole
         # prefix-variant family, since a cache hit is just a nonzero
         # prefix_len here). SWARMDB_RAGGED_PREFILL=0 restores the
@@ -833,12 +848,12 @@ class Engine:
                 and getattr(paged.allocator, "n_shards", 1) <= 1
                 and os.environ.get("SWARMDB_RAGGED_PREFILL", "auto") != "0"):
             try:
-                min_w = int(os.environ.get("SWARMDB_RAGGED_MIN_WIDTH", "1"))
+                min_w = int(os.environ.get("SWARMDB_RAGGED_MIN_WIDTH", "8"))
             except ValueError:
                 logger.warning("SWARMDB_RAGGED_MIN_WIDTH=%r is not an int; "
-                               "using 1",
+                               "using 8",
                                os.environ.get("SWARMDB_RAGGED_MIN_WIDTH"))
-                min_w = 1
+                min_w = 8
             ladder = [max(1, min(min_w, max_seq))]
             while ladder[-1] < max_seq:
                 ladder.append(min(max_seq, ladder[-1] * 2))
@@ -3445,6 +3460,16 @@ class Engine:
                 it[3] = consumed + take
                 filled += take
                 r += 1
+            if self._kerncheck:
+                # descriptor audit BEFORE the wave ships: a bad page id /
+                # trash-page target / duplicate (page, offset) cell is an
+                # engine bug the kernel would silently scatter into the
+                # pool (runtime face of SWL901/902)
+                from ..obs.kerncheck import check_wave_descriptors
+
+                check_wave_descriptors(
+                    tok_row, tok_pos, tables,
+                    self.paged.allocator.num_pages, ps)
             self._mirrored(
                 self.CALL_PAGED_PREFILL_RAGGED, tokens, tok_row, tok_pos,
                 starts, lens, plens, tables, scatter,

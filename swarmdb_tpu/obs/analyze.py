@@ -418,6 +418,9 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
     pagechecks = _pagecheck_dumps(paths)
     if pagechecks:
         report["pagecheck_dumps"] = pagechecks
+    kernchecks = _kerncheck_dumps(paths)
+    if kernchecks:
+        report["kerncheck_dumps"] = kernchecks
     profile_list = ([_profile_summary(p, d) for p, d in profiles]
                     + _profile_dumps(paths))
     if profile_list:
@@ -505,6 +508,42 @@ def _pagecheck_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
     return out
 
 
+def _kerncheck_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Kernel-sanitizer dumps (``kerncheck_<node>.json``, ISSUE 16)
+    sitting next to the analyzed flight/trace files — the kernel twin
+    of the lockcheck/pagecheck listings above: the flight dump says
+    what the node was doing, the kerncheck dump says which Pallas
+    kernel contract it broke doing it (out-of-bounds block or Ref
+    slice, grid write race, short-written output row, parity break).
+    Listed with violation counts/kinds so a detected kernel crime is
+    never invisible in a report."""
+    seen: set = set()
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        if d in seen:
+            continue
+        seen.add(d)
+        for cand in sorted(glob.glob(os.path.join(d,
+                                                  "kerncheck_*.json"))):
+            try:
+                with open(cand, "r", encoding="utf-8") as f:
+                    dump = json.load(f)
+            except (OSError, ValueError):
+                continue
+            violations = dump.get("violations") or []
+            out.append({
+                "path": cand,
+                "node": dump.get("node"),
+                "violations": len(violations),
+                "violation_kinds": sorted(
+                    {v.get("kind") for v in violations}),
+                "kernels": sorted(
+                    {v.get("kernel") for v in violations}),
+            })
+    return out
+
+
 def _profile_summary(path: str, dump: Dict[str, Any]) -> Dict[str, Any]:
     """One line per swarmprof dump for the main report: enough to spot
     "the decode kernel ate 80% of device time at MFU 0.004" without
@@ -582,6 +621,27 @@ def roofline_report(paths: Sequence[str],
             top.append(row)
         tiny = [w for w in (data.get("dispatch_profile") or [])
                 if w.get("tiny_flush")]
+        # static VMEM view (SWL903, analysis/kernelcheck.py): variants
+        # whose dispatch recorded a static footprint estimate, shown
+        # against the dump platform's budget — "how close is this
+        # kernel to spilling" belongs next to its roofline class
+        try:
+            from ..analysis.kernelcheck import vmem_budget
+            budget = vmem_budget(data.get("device_kind") or "")
+        except Exception:
+            budget = None
+        vm_rows = []
+        for v in variants:
+            est = v.get("vmem_est_bytes")
+            if est is None:
+                continue
+            b = v.get("vmem_budget_bytes") or budget
+            vm_rows.append({
+                "variant": v.get("variant"),
+                "vmem_est_bytes": est,
+                "vmem_budget_bytes": b,
+                "vmem_utilization": (round(est / b, 4) if b else None),
+            })
         dumps.append({
             "path": path,
             "node": data.get("node"),
@@ -594,6 +654,8 @@ def roofline_report(paths: Sequence[str],
             "lanes": data.get("lanes"),
             "tiny_flush_waves": data.get("tiny_flush_waves", 0),
             "tiny_flush_rows": tiny,
+            "vmem_budget_bytes": budget,
+            "vmem_variants": vm_rows,
         })
     return {
         "kind": "swarmdb.obs.roofline",

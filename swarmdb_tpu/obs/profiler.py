@@ -293,6 +293,10 @@ class KernelProfiler:
         self._lanes: List[LaneProfile] = []
         # (kind, width) -> [waves, packed, padding, {variant_key: waves}]
         self._waves: Dict[Tuple[str, int], List[Any]] = {}
+        # swarmlint: guarded-by[self._lock]: _vmem_est
+        # variant key (or "kernel:<tag>") -> (static bytes, budget bytes)
+        # — SWL903 estimates folded in by ops.layers._record_static_vmem
+        self._vmem_est: Dict[str, Tuple[int, int]] = {}
         self.harvest_calls = 0
         self.platform: Optional[str] = None
         self.device_kind: str = ""
@@ -358,6 +362,17 @@ class KernelProfiler:
         if meta:
             v.meta.update(meta)
 
+    def record_vmem_estimate(self, key: str, est_bytes: int,
+                             budget_bytes: int) -> None:
+        """Static (SWL903) VMEM footprint for a variant, recorded at
+        dispatch trace time. Deliberately a SIDE table, not
+        ``record_variant``: that would mark the variant harvested and
+        starve the real XLA cost-model harvest. ``key`` is either the
+        exact variant key (``prefill.ragged[w64]``) or a
+        ``kernel:<tag>`` alias matched against ``meta["kernel"]``."""
+        with self._lock:
+            self._vmem_est[key] = (int(est_bytes), int(budget_bytes))
+
     def harvested(self, key: str) -> bool:
         """Whether a variant already carries cost-model facts (lane
         groups harvest once per variant, not once per lane). A racy
@@ -384,8 +399,9 @@ class KernelProfiler:
     def peaks(self) -> Dict[str, float]:
         return platform_peaks(self.platform or "", self.device_kind)
 
-    def _variant_row(self, v: _Variant,
-                     peaks: Dict[str, float]) -> Dict[str, Any]:
+    def _variant_row(self, v: _Variant, peaks: Dict[str, float],
+                     vmem: Optional[Dict[str, Tuple[int, int]]] = None,
+                     ) -> Dict[str, Any]:
         dev_s = v.device_ns / 1e9
         row: Dict[str, Any] = {
             "variant": v.name,
@@ -408,6 +424,15 @@ class KernelProfiler:
             if ridge:
                 row["roofline"] = ("compute-bound" if ai >= ridge
                                    else "memory-bound")
+        if vmem:
+            est = vmem.get(v.name)
+            if est is None and v.meta.get("kernel"):
+                est = vmem.get("kernel:" + str(v.meta["kernel"]))
+            if est is not None:
+                row["vmem_est_bytes"] = est[0]
+                row["vmem_budget_bytes"] = est[1]
+                if est[1] > 0:
+                    row["vmem_utilization"] = round(est[0] / est[1], 4)
         return row
 
     def variants_report(self) -> List[Dict[str, Any]]:
@@ -415,7 +440,8 @@ class KernelProfiler:
         peaks = self.peaks()
         with self._lock:
             vs = list(self._vars.values())
-        rows = [self._variant_row(v, peaks) for v in vs]
+            vmem = dict(self._vmem_est)
+        rows = [self._variant_row(v, peaks, vmem) for v in vs]
         rows.sort(key=lambda r: -r["device_s"])
         return rows
 
@@ -645,6 +671,7 @@ class KernelProfiler:
         with self._lock:
             self._vars.clear()
             self._waves.clear()
+            self._vmem_est.clear()
             lanes = list(self._lanes)
         for lane in lanes:
             lane.busy_ns = 0

@@ -481,6 +481,9 @@ def ragged_paged_prefill_attention(
             pl.BlockSpec((1, ps, Hkv, D), kv_map),
             pl.BlockSpec((1, ps, Hkv, D), kv_map),
         ],
+        # swarmlint: revisit[r] -- every (r, j) step accumulates into the
+        # one stream-resident output block; the masked finalize under
+        # pl.when(j == n_steps - 1) writes each row's lanes exactly once
         out_specs=pl.BlockSpec((W, Hq, D), stream_map),
         scratch_shapes=[
             pltpu.VMEM((Hkv, W * G, D), jnp.float32),    # acc

@@ -122,6 +122,31 @@ def _ragged_prefill_kernel_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _record_static_vmem(kernel: str, key: str, dims) -> None:
+    """Fold the SWL903 static VMEM estimate for ``kernel`` into
+    swarmprof's variant table under ``key``. Runs at dispatch trace
+    time, where every dim in the site's symbolic footprint is a
+    concrete Python int. Best-effort by contract: profiler off, no
+    matching pallas_call site, or an unbound dim all mean 'no
+    estimate', never an error on the dispatch path."""
+    from ..obs.profiler import profiler
+
+    prof = profiler()
+    if not prof.enabled:
+        return
+    try:
+        from ..analysis.kernelcheck import estimate_vmem, vmem_budget
+
+        est = estimate_vmem(kernel, dims)
+        if est is None:
+            return
+        devs = jax.devices()
+        kind = devs[0].device_kind if devs else ""
+        prof.record_vmem_estimate(key, est, vmem_budget(kind))
+    except Exception:  # accounting must never break dispatch
+        pass
+
+
 def paged_attention_dispatch(
     q: jnp.ndarray,          # [B, 1, Hq, D] (decode only)
     k_pages: jnp.ndarray,    # [P, ps, Hkv, D]
@@ -136,6 +161,10 @@ def paged_attention_dispatch(
     if _paged_pallas_enabled(page_table.shape[1] * k_pages.shape[1]):
         from .attention_pallas import paged_decode_gqa_attention
 
+        _record_static_vmem(
+            "_paged_attn_kernel", "kernel:pallas",
+            {"Hq": q.shape[2], "Hkv": k_pages.shape[2],
+             "D": q.shape[3], "ps": k_pages.shape[1]})
         lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
         out = paged_decode_gqa_attention(
             q[:, 0], k_pages, v_pages, page_table, lengths,
@@ -281,6 +310,10 @@ def ragged_prefill_dispatch(
 
         W = q.shape[0]
         pad = (-W) % 8                 # TPU sublane quantum for tiny waves
+        _record_static_vmem(
+            "_ragged_prefill_kernel", f"prefill.ragged[w{W}]",
+            {"W": W + pad, "Hq": q.shape[1], "Hkv": sfx_k.shape[1],
+             "D": q.shape[2], "ps": k_pages.shape[1]})
         if pad:
             grow = ((0, pad), (0, 0), (0, 0))
             q = jnp.pad(q, grow)
@@ -710,3 +743,20 @@ def gqa_attention_prefix(
                            p[..., Pt:].astype(suffix_v.dtype), suffix_v,
                            preferred_element_type=jnp.float32)
     return out.reshape(q.shape).astype(q.dtype)
+
+
+# --- kerncheck: interpreter-mode kernel sanitizer (obs/kerncheck.py) ----
+# SWARMDB_KERNCHECK=1 swaps the TPU-gated dispatchers for shadow-checked
+# wrappers: every concrete (non-traced) call re-runs the kernel through
+# the numpy grid interpreter with canary-poisoned outputs and bounds-
+# checked Refs, then asserts parity against the dispatched result. Flag
+# off, this block never runs and the module exports the plain function
+# objects — type identity is pinned by tests/test_kernelcheck.py.
+if os.environ.get("SWARMDB_KERNCHECK", "0") == "1":
+    from ..obs.kerncheck import (checked_paged_attention_dispatch,
+                                 checked_ragged_prefill_dispatch)
+
+    paged_attention_dispatch = checked_paged_attention_dispatch(
+        paged_attention_dispatch)
+    ragged_prefill_dispatch = checked_ragged_prefill_dispatch(
+        ragged_prefill_dispatch)

@@ -688,3 +688,16 @@ class ShardedPageAllocator(PageAllocator):
                 "pages_allocated_total": self.pages_allocated_total,
                 "pages_freed_total": self.pages_freed_total,
             }
+
+
+# --- kerncheck: descriptor + scatter-replay sanitizer (obs/kerncheck) ---
+# SWARMDB_KERNCHECK=1 wraps the ragged wave scatter so every concrete
+# call first audits its descriptors (live-token page OOB, trash-page
+# targets, duplicate (page, offset) cells) and then replays the scatter
+# in numpy against the returned pool. Flag off this block never runs —
+# the module exports the plain function object (type identity pinned by
+# tests/test_kernelcheck.py).
+if os.environ.get("SWARMDB_KERNCHECK", "0") == "1":
+    from ..obs.kerncheck import checked_paged_write_ragged
+
+    paged_write_ragged = checked_paged_write_ragged(paged_write_ragged)

@@ -38,15 +38,16 @@ def tmp_swarm(tmp_path):
 
 def pytest_sessionfinish(session, exitstatus):
     """With a runtime sanitizer on (SWARMDB_LOCKCHECK=1 /
-    SWARMDB_PAGECHECK=1 — the CI `lockcheck` and `pagecheck` jobs run
-    the chaos/HA/partition/ragged suites this way), a green suite that
-    exercised a violation is still a FAILURE: the chaos harnesses
-    generate the hostile interleavings, these hooks make them assert
-    lock ordering and page safety, not just liveness. Tests that
-    provoke violations deliberately (tests/test_lockcheck.py,
-    tests/test_pagecheck.py) reset the registries in their fixture
-    teardown, so anything left here was exercised by production code
-    paths."""
+    SWARMDB_PAGECHECK=1 / SWARMDB_KERNCHECK=1 — the CI `lockcheck`,
+    `pagecheck` and `kerncheck` jobs run the chaos/HA/partition/ragged
+    suites this way), a green suite that exercised a violation is
+    still a FAILURE: the chaos harnesses generate the hostile
+    interleavings, these hooks make them assert lock ordering, page
+    safety and kernel contracts, not just liveness. Tests that provoke
+    violations deliberately (tests/test_lockcheck.py,
+    tests/test_pagecheck.py, tests/test_kernelcheck.py) reset the
+    registries in their fixture teardown, so anything left here was
+    exercised by production code paths."""
     lines = []
     if os.environ.get("SWARMDB_LOCKCHECK", "0") not in ("", "0"):
         try:
@@ -72,6 +73,18 @@ def pytest_sessionfinish(session, exitstatus):
             for v in violations:
                 lines.append(f"  [{v['kind']}] pool={v['pool']} "
                              f"pages={v['pages']}: {v['message']}")
+    if os.environ.get("SWARMDB_KERNCHECK", "0") not in ("", "0"):
+        try:
+            from swarmdb_tpu.obs import kerncheck
+
+            kviol = kerncheck.registry().violations()
+        except Exception:
+            kviol = []
+        if kviol:
+            lines.append("kernel sanitizer detected violation(s):")
+            for v in kviol:
+                lines.append(f"  [{v['kind']}] kernel={v['kernel']}: "
+                             f"{v['message']}")
     if not lines:
         return
     tr = session.config.pluginmanager.get_plugin("terminalreporter")

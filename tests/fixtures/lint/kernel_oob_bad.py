@@ -1,0 +1,42 @@
+"""Seeded Pallas out-of-bounds index maps (SWL901).
+
+Index maps return BLOCK indices: block b of shape (2, H, D) covers rows
+[2b, 2b+2), so over a grid of (B,) against a B-row operand the upper
+blocks read a full block past the end. The second wrapper steps the
+block index negative on the first grid coordinate. Each violating
+BlockSpec carries an EXPECT annotation consumed by
+tests/test_swarmlint.py.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def oob_overrun(x):
+    B, H, D = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((2, H, D), lambda b: (b, 0, 0)),  # EXPECT: SWL901
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), x.dtype),
+    )(x)
+
+
+def oob_negative(x):
+    B, H, D = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b: (b - 1, 0, 0)),  # EXPECT: SWL901
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), x.dtype),
+    )(x)

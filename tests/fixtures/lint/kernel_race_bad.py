@@ -1,0 +1,38 @@
+"""Seeded Pallas grid write race (SWL902).
+
+The output block index map ignores grid axis 0 ('r'), so every row's
+grid steps write the SAME output block — on TPU's sequential grid the
+last row silently wins. The twin wrapper declares the revisit with the
+``# swarmlint: revisit[r]`` directive (a deliberate accumulate) and
+must stay quiet.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _acc_kernel(x_ref, o_ref):
+    o_ref[...] = o_ref[...] + x_ref[...]
+
+
+def racing_rows(x):
+    R, S, D = x.shape
+    return pl.pallas_call(
+        _acc_kernel,
+        grid=(R, S),
+        in_specs=[pl.BlockSpec((1, 1, D), lambda r, j: (r, j, 0))],
+        out_specs=pl.BlockSpec((1, D), lambda r, j: (0, 0)),  # EXPECT: SWL902
+        out_shape=jax.ShapeDtypeStruct((1, D), x.dtype),
+    )(x)
+
+
+def sanctioned_rows(x):
+    R, S, D = x.shape
+    return pl.pallas_call(
+        _acc_kernel,
+        grid=(R, S),
+        in_specs=[pl.BlockSpec((1, 1, D), lambda r, j: (r, j, 0))],
+        # swarmlint: revisit[r] -- deliberate accumulate into one block
+        out_specs=pl.BlockSpec((1, D), lambda r, j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, D), x.dtype),
+    )(x)

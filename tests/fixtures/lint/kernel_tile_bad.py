@@ -1,0 +1,28 @@
+"""Seeded Pallas tiling misalignment (SWL904).
+
+TPU vector memory is tiled sublane x lane: (8,128) f32, (16,128) bf16,
+(32,128) int8. The input block's 96-wide lane dim is not a multiple of
+128 (dead lanes in every tile); the int8 output block's 16-row sublane
+group is half of the int8 tile's 32 — exactly the shape mistake the
+quantized-KV sprint must not ship.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _quant_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def int8_misaligned(x):
+    N, C = x.shape
+    g = N // 16
+    return pl.pallas_call(
+        _quant_kernel,
+        grid=(g,),
+        in_specs=[pl.BlockSpec((16, 96), lambda i: (i, 0))],  # EXPECT: SWL904
+        out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),  # EXPECT: SWL904
+        out_shape=jax.ShapeDtypeStruct((N, 128), jnp.int8),
+    )(x)

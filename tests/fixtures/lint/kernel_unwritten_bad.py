@@ -1,0 +1,47 @@
+"""Seeded Pallas unwritten outputs (SWL905).
+
+``_never_stores`` computes into a local and never touches its output
+ref — every grid cell hands back stale VMEM garbage. ``_unreachable_
+store`` guards its only store with ``j == pl.num_programs(1)``, one
+past the last grid coordinate, so the store is provably dead over the
+whole grid (the off-by-one the finalize-on-last-step idiom invites).
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _never_stores(x_ref, o_ref):  # EXPECT: SWL905
+    acc = x_ref[...] * 2.0
+    _ = acc
+
+
+def _unreachable_store(x_ref, o_ref):  # EXPECT: SWL905
+    j = pl.program_id(1)
+    n = pl.num_programs(1)
+
+    @pl.when(j == n)
+    def _store():
+        o_ref[...] = x_ref[...]
+
+
+def unwritten_rows(x):
+    B, S, D = x.shape
+    return pl.pallas_call(
+        _never_stores,
+        grid=(B,),
+        in_specs=[pl.BlockSpec((1, S, D), lambda b: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, S, D), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, S, D), x.dtype),
+    )(x)
+
+
+def off_by_one_guard(x):
+    B, S, D = x.shape
+    return pl.pallas_call(
+        _unreachable_store,
+        grid=(B, S),
+        in_specs=[pl.BlockSpec((1, 1, D), lambda b, j: (b, j, 0))],
+        out_specs=pl.BlockSpec((1, 1, D), lambda b, j: (b, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, S, D), x.dtype),
+    )(x)

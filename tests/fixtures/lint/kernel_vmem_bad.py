@@ -1,0 +1,37 @@
+"""Seeded Pallas VMEM budget violations (SWL903).
+
+Pallas double-buffers every pipelined in/out block, so one (4096, 2048)
+f32 block each way is 2*32 + 2*32 = 128 MiB of per-grid-step VMEM —
+an 8x overflow of the 16 MiB default budget. The second wrapper sits at
+13 MiB (81%), inside the budget but past the 80% pressure warning.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _big_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def vmem_blowout(x):
+    n = x.shape[0] // 4096
+    return pl.pallas_call(  # EXPECT: SWL903
+        _big_kernel,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((4096, 2048), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((4096, 2048), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)
+
+
+def vmem_pressure(x):
+    n = x.shape[0] // 832
+    return pl.pallas_call(  # EXPECT: SWL903
+        _big_kernel,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((832, 1024), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((832, 1024), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)

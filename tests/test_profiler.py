@@ -43,7 +43,13 @@ def _serve(eng, prompts, n=8):
 @pytest.fixture(scope="module")
 def run(tmp_path_factory):
     """The shared profiled run: reset registry -> build paged engine ->
-    warmup (harvest) -> serve PROMPTS -> capture every surface."""
+    warmup (harvest) -> serve PROMPTS -> capture every surface.
+
+    Pins SWARMDB_RAGGED_MIN_WIDTH=1: the tiny-flush detection and
+    exact-packing contracts below deliberately seed width-1 waves,
+    which the default floor of 8 folds away (PROFILE.md round 11)."""
+    mp = pytest.MonkeyPatch()
+    mp.setenv("SWARMDB_RAGGED_MIN_WIDTH", "1")
     prof = profiler()
     prof.reset()
     eng = build_backend_engine(CFG, max_batch=4, max_seq=96,
@@ -63,6 +69,7 @@ def run(tmp_path_factory):
         "tmp": tmp,
     }
     prof.reset()
+    mp.undo()
 
 
 # ------------------------------------------------------- harvest discipline

@@ -43,11 +43,19 @@ def _greedy(eng, prompt, n=8):
 def test_ragged_engine_wiring(monkeypatch):
     eng = _build(True, monkeypatch)
     assert eng._ragged_active()
-    # power-of-two ladder from SWARMDB_RAGGED_MIN_WIDTH (1) to max_seq
-    assert eng._ragged_widths == [1, 2, 4, 8, 16, 32, 64, 96]
+    # power-of-two ladder from SWARMDB_RAGGED_MIN_WIDTH (default 8 —
+    # one TPU sublane quantum; rungs below 8 compile programs the
+    # dispatcher pads back up to 8 anyway, PROFILE.md round 11)
+    assert eng._ragged_widths == [8, 16, 32, 64, 96]
     assert eng._ragged_width_for(96) == 96
     assert eng._ragged_width_for(37) == 32   # largest-fit, never round up
-    assert eng._ragged_width_for(1) == 1
+    assert eng._ragged_width_for(1) == 8     # final flush pads < min_w
+    # the knob still widens the ladder down to exact-packing
+    monkeypatch.setenv("SWARMDB_RAGGED_MIN_WIDTH", "1")
+    fine = _build(True, monkeypatch)
+    assert fine._ragged_widths == [1, 2, 4, 8, 16, 32, 64, 96]
+    assert fine._ragged_width_for(1) == 1
+    monkeypatch.delenv("SWARMDB_RAGGED_MIN_WIDTH")
     off = _build(False, monkeypatch)
     assert not off._ragged_active()
     # the row-bucketed fallback machinery stays intact under =0
@@ -55,6 +63,10 @@ def test_ragged_engine_wiring(monkeypatch):
 
 
 def test_ragged_zero_padding_and_exact_packing(monkeypatch):
+    # exact binary decomposition is the min_width=1 contract; the
+    # default floor of 8 trades <8 pad tokens per final flush for a
+    # smaller compiled-variant set (covered by the wiring test above)
+    monkeypatch.setenv("SWARMDB_RAGGED_MIN_WIDTH", "1")
     eng = _build(True, monkeypatch)
     c = eng.metrics.counters
     eng.start()

@@ -57,6 +57,11 @@ def expected_findings(path: Path):
     "page_doublefree_bad.py",   # double-free + write-before-alloc (SWL803/805)
     "pin_bad.py",               # pin-discipline (SWL804)
     "pagelife_snapshot.py",     # pre-fix engine/allocator leaks (SWL801)
+    "kernel_oob_bad.py",        # kernel-check: OOB index maps (SWL901)
+    "kernel_race_bad.py",       # kernel-check: output write race (SWL902)
+    "kernel_vmem_bad.py",       # kernel-check: VMEM budget (SWL903)
+    "kernel_tile_bad.py",       # kernel-check: tiling misalignment (SWL904)
+    "kernel_unwritten_bad.py",  # kernel-check: unwritten output (SWL905)
 ])
 def test_each_family_detects_seeded_violations(name):
     path = FIXTURES / name
