@@ -184,6 +184,7 @@ def bench_echo(seconds: float) -> dict:
     # observability cost of all four.
     try:
         from swarmdb_tpu.obs import HISTOGRAMS, TRACER
+        from swarmdb_tpu.obs.memprof import memprof as _mprof
         from swarmdb_tpu.obs.profiler import profiler as _kprof
 
         was_enabled = TRACER.enabled
@@ -206,12 +207,14 @@ def bench_echo(seconds: float) -> dict:
                         HISTOGRAMS.set_exemplars_enabled(True)
                         db.sentinel.set_enabled(True)
                         _kprof().set_enabled(True)
+                        _mprof().set_enabled(True)
                         on_rate += _echo_loop(db, seg)
                         TRACER.set_enabled(False)
                         HISTOGRAMS.set_enabled(False)
                         HISTOGRAMS.set_exemplars_enabled(False)
                         db.sentinel.set_enabled(False)
                         _kprof().set_enabled(False)
+                        _mprof().set_enabled(False)
                         off_rate += _echo_loop(db, seg)
                     db.close()
             finally:
@@ -220,6 +223,7 @@ def bench_echo(seconds: float) -> dict:
                 HISTOGRAMS.set_exemplars_enabled(
                     os.environ.get("SWARMDB_EXEMPLARS", "1") != "0")
                 _kprof().set_enabled(True)
+                _mprof().set_enabled(True)
             on_rate /= 2
             off_rate /= 2
             result["echo_tracer_on_msgs_per_sec"] = round(on_rate, 2)
@@ -389,6 +393,18 @@ def _device_extras(service, model: str) -> dict:
                 extras["min_lane_duty_cycle"] = round(min(duties), 4)
     except Exception as exc:  # noqa: BLE001 — extras must not kill a bench
         extras["kernel_profile_error"] = repr(exc)[-200:]
+    # swarmmem (ISSUE 17): the per-mode mem block — prefix hit rate,
+    # pool occupancy decomposition, conversation temperature, and the
+    # sampled miss-ratio curve — so every bench record carries the
+    # memory picture next to the device-time one. prefix_hit_rate and
+    # headroom ride the compact summary and are trend-guarded.
+    try:
+        from swarmdb_tpu.obs.memprof import memprof, memprof_enabled
+
+        if memprof_enabled():
+            extras["mem"] = memprof().mem_profile()
+    except Exception as exc:  # noqa: BLE001 — extras must not kill a bench
+        extras["mem_error"] = repr(exc)[-200:]
     return extras
 
 
@@ -922,10 +938,12 @@ def bench_dpserve(seconds: float) -> dict:
         # poison the dp1-vs-dpN diagnosis (and the profiler's variant /
         # duty accounting would mix the dp1 and dpN sub-runs)
         from swarmdb_tpu.obs import TRACER
+        from swarmdb_tpu.obs.memprof import memprof as _mp
         from swarmdb_tpu.obs.profiler import profiler as _kp
 
         TRACER.reset()
         _kp().reset()
+        _mp().reset()
         mesh = make_mesh(ndev, data=ndev, model=1, expert=1)
         with tempfile.TemporaryDirectory() as tmp:
             db = SwarmDB(broker=LocalBroker(), save_dir=tmp,
@@ -2105,6 +2123,18 @@ def _mode_summary(r: dict) -> dict:
     shares = r.get("phase_shares")
     if shares:
         out["ph"] = {k[:1]: round(v, 2) for k, v in shares.items()}
+    # swarmmem compact scalars (ISSUE 17): pool headroom fraction and
+    # the hot-conversation count, so the checked-in driver records can
+    # trend memory pressure next to throughput
+    mem = r.get("mem")
+    if mem:
+        occ = mem.get("occupancy") or {}
+        if occ.get("total_pages"):
+            out["hdrm"] = round(
+                occ["headroom_pages"] / occ["total_pages"], 3)
+        conv = mem.get("conversations") or {}
+        if conv:
+            out["hotc"] = conv.get("hot", 0)
     if r.get("tpu_error"):
         out["pl"] = "cpu-fallback"
     return out
@@ -2135,6 +2165,8 @@ def _compact_summary(results: dict, error: str | None = None) -> dict:
         keep = {"v", "pl", "kern", "native"}
         for mode_sum in line["modes"].values():
             mode_sum.pop("ph", None)
+            mode_sum.pop("hdrm", None)
+            mode_sum.pop("hotc", None)
             for short, _ in _SUMMARY_KEYS:
                 if short not in keep:
                     mode_sum.pop(short, None)

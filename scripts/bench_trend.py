@@ -222,6 +222,23 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
                 if tm / bm < (1.0 - threshold):
                     entry["regressed"] = True
                     entry[f"{tag}_regressed"] = True
+        # swarmmem numbers guarded first-class (ISSUE 17): the prefix
+        # hit rate and the pool headroom fraction can collapse while
+        # throughput holds (bigger batches absorb the re-prefill cost;
+        # the pool fills with cold pages long before allocation
+        # fails). Like-for-like is already enforced above, so a drop
+        # beyond the threshold is a real memory regression.
+        for short, tag in (("hit", "prefix_hit_rate"),
+                           ("hdrm", "mem_headroom")):
+            bm, tm = b.get(short), t.get(short)
+            if isinstance(bm, (int, float)) and \
+                    isinstance(tm, (int, float)) and bm > 0:
+                entry[f"base_{short}"] = bm
+                entry[f"test_{short}"] = tm
+                entry[f"{short}_ratio"] = round(tm / bm, 3)
+                if tm / bm < (1.0 - threshold):
+                    entry["regressed"] = True
+                    entry[f"{tag}_regressed"] = True
         if entry["regressed"]:
             bs, ts = _phase_summary(b), _phase_summary(t)
             if bs is not None and ts is not None:
@@ -264,6 +281,10 @@ def build_report(base_path: str, test_path: str,
                    if v.get("mfu_regressed") else "")
                 + (f", min_lane_duty {v['base_duty']} -> {v['test_duty']}"
                    if v.get("duty_cycle_regressed") else "")
+                + (f", prefix_hit_rate {v['base_hit']} -> {v['test_hit']}"
+                   if v.get("prefix_hit_rate_regressed") else "")
+                + (f", mem_headroom {v['base_hdrm']} -> {v['test_hdrm']}"
+                   if v.get("mem_headroom_regressed") else "")
                 + (f", dominant {v['dominant']} "
                    f"({v['attribution']['shares'][v['dominant']]:.0%})"
                    if v.get("dominant") else ", unattributed")

@@ -2,11 +2,12 @@
 """Observability-overhead smoke for CI (ISSUE 2 acceptance: <= 5%
 budget; ISSUE 6 extended the A/B to the /metrics histograms; ISSUE 7
 extends it to bucket exemplars and the online SLO sentinel; ISSUE 15
-adds the swarmprof device-time profiler to the toggle set).
+adds the swarmprof device-time profiler to the toggle set; ISSUE 17
+adds the swarmmem memory accountant).
 
 Runs the pure-routing echo loop with the span tracer, the fixed-bucket
-histograms, exemplar retention, the SLO sentinel, AND swarmprof enabled
-vs disabled in ALTERNATING segments (back-to-back whole runs drift more
+histograms, exemplar retention, the SLO sentinel, swarmprof, AND
+swarmmem enabled vs disabled in ALTERNATING segments (back-to-back whole runs drift more
 than the effect measured) and fails if the combined overhead exceeds
 the smoke bound. The sentinel runs with a sub-second window so several
 window closes land inside each "on" segment — the tick probe and the
@@ -33,6 +34,7 @@ def main() -> int:
     from swarmdb_tpu.broker.local import LocalBroker
     from swarmdb_tpu.core.runtime import SwarmDB
     from swarmdb_tpu.obs import HISTOGRAMS, TRACER
+    from swarmdb_tpu.obs.memprof import memprof
     from swarmdb_tpu.obs.profiler import profiler
 
     on = off = 0.0
@@ -47,23 +49,26 @@ def main() -> int:
                 HISTOGRAMS.set_exemplars_enabled(True)
                 db.sentinel.set_enabled(True)
                 profiler().set_enabled(True)
+                memprof().set_enabled(True)
                 on += bench._echo_loop(db, SEG_S)
                 TRACER.set_enabled(False)
                 HISTOGRAMS.set_enabled(False)
                 HISTOGRAMS.set_exemplars_enabled(False)
                 db.sentinel.set_enabled(False)
                 profiler().set_enabled(False)
+                memprof().set_enabled(False)
                 off += bench._echo_loop(db, SEG_S)
         finally:
             TRACER.set_enabled(True)
             HISTOGRAMS.set_enabled(True)
             HISTOGRAMS.set_exemplars_enabled(True)
             profiler().set_enabled(True)
+            memprof().set_enabled(True)
             db.close()
     overhead = max(0.0, (off - on) / off * 100.0) if off else 0.0
-    print(f"echo msgs/sec: tracer+histograms+exemplars+sentinel+profiler "
-          f"on {on / 2:.1f}, off {off / 2:.1f}, overhead {overhead:.2f}% "
-          f"(bound {BOUND:.0f}%)")
+    print(f"echo msgs/sec: tracer+histograms+exemplars+sentinel+profiler"
+          f"+memprof on {on / 2:.1f}, off {off / 2:.1f}, "
+          f"overhead {overhead:.2f}% (bound {BOUND:.0f}%)")
     if overhead > BOUND:
         print("FAIL: observability overhead above smoke bound",
               file=sys.stderr)

@@ -175,6 +175,13 @@ RULES: Dict[str, Rule] = {
              "compile-time introspection (cost_analysis()/argful "
              "lower()) inside a hot-path function — the swarmprof cost "
              "harvest belongs in warmup, never on a dispatch path"),
+        Rule("SWL507", "span-discipline",
+             "per-access allocation (container display, comprehension, "
+             "f-string, dict()/list()/set()/str() construction) in hot "
+             "memory-accountant record-path code — the memprof hooks "
+             "piggyback on locks the allocator/prefix cache already "
+             "hold, so their record path must stay int adds and slot "
+             "writes"),
         Rule("SWL601", "heartbeat-safety",
              "blocking call inside `# swarmlint: heartbeat` code — a "
              "stalled failure-detector evaluation reads as a dead peer "

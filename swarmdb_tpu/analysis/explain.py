@@ -236,6 +236,24 @@ EXPLAIN: Dict[str, Dict[str, str]] = {
                 "def _dispatch(self, fn, args):\n"
                 "    prof.dispatch(key, t0, dur)  # counters only",
     },
+    "SWL507": {
+        "doc": "Per-access allocation (container display, comprehension, "
+               "f-string, dict()/list()/set()/str() construction) in a "
+               "hot method of a memory-accountant ledger class "
+               "(MemPool/PrefixProbe/ConvLedger/ReuseSampler): the "
+               "memprof hooks run INSIDE locks the page allocator and "
+               "prefix cache already hold, so their record path must "
+               "stay int adds and slot writes.",
+        "bad": "# swarmlint: hot\n"
+               "def page_alloc(self, pages):\n"
+               "    self.events.append({\"pages\": list(pages)})",
+        "good": "# swarmlint: hot\n"
+                "def page_alloc(self, pages):\n"
+                "    t = time.monotonic_ns()\n"
+                "    for p in pages:\n"
+                "        self.ages[p] = t\n"
+                "    self.alloc_events += 1",
+    },
     "SWL601": {
         "doc": "A blocking call inside `# swarmlint: heartbeat` code: a "
                "stalled failure-detector evaluation reads as a dead "

@@ -35,6 +35,16 @@ contract the type system cannot enforce:
   dict per STEP, so the rule is scoped to the per-observation exemplar
   and sentinel paths rather than every hot function.
 
+- swarmmem's record hooks (ISSUE 17) have the tightest contract of
+  all: they run INSIDE locks the allocator/prefix cache already hold
+  (that is the whole overhead story), so inside ``# swarmlint: hot``
+  methods of the memory-accountant ledger classes (``MemPool``/
+  ``PrefixProbe``/``ConvLedger``/``ReuseSampler``) ANY per-access
+  allocation — displays, comprehensions, f-strings, ``dict()``/
+  ``list()``/``set()``/``str()`` calls — is SWL507: the record path
+  must stay int adds and slot writes, or every page grant pays an
+  allocator while a pool lock is held.
+
 - swarmprof's cost harvest (ISSUE 15) is a compile-time activity with a
   compile-time cost: ``fn.lower(*specs)`` re-traces the function and
   ``cost_analysis()`` runs the XLA cost model — tens of milliseconds to
@@ -104,6 +114,22 @@ def _exemplar_scope(src: SourceFile, fn: ast.AST) -> bool:
                 "exemplar" in node.attr or node.attr.startswith("_ex_")):
             return True
     return False
+
+
+#: memory-accountant ledger classes whose hot record methods must stay
+#: allocation-free (SWL507) — they run under the owner's pool/cache lock
+_MEMPROF_CLASSES = ("MemPool", "PrefixProbe", "ConvLedger", "ReuseSampler")
+
+
+def _memprof_scope(src: SourceFile, fn: ast.AST) -> bool:
+    """True when a hot function is memory-accountant record-path code: a
+    method of one of the memprof ledger classes. Scopes SWL507 the way
+    ``_exemplar_scope`` scopes SWL504 — the engine's own hot functions
+    may legitimately build one record per step; a ledger hook that runs
+    under the allocator's lock may not allocate at all."""
+    cls = src.enclosing_scope(fn.lineno, classes_only=True)
+    return cls is not None and any(tag in cls.name
+                                   for tag in _MEMPROF_CLASSES)
 
 
 def _alloc_desc(node: ast.AST) -> Optional[str]:
@@ -213,6 +239,17 @@ def check(src: SourceFile) -> List[Finding]:
                         f"hot exemplar/sentinel function `{fn.name}` — "
                         f"retention must be an in-place slot write into "
                         f"preallocated lists"))
+        if src.is_hot(fn) and _memprof_scope(src, fn):
+            for node in _own_nodes(fn):
+                desc = _alloc_desc(node)
+                if desc is not None:
+                    findings.append(make_finding(
+                        src, "SWL507", node,
+                        f"per-access allocation ({desc}) inside hot "
+                        f"memory-accountant function `{fn.name}` — the "
+                        f"memprof record path runs under the allocator/"
+                        f"cache lock and must stay int adds and slot "
+                        f"writes"))
         if (begins and ends == 0
                 and fn.name not in _BALANCE_EXEMPT):
             findings.append(make_finding(

@@ -38,6 +38,7 @@ from ..core.runtime import SwarmDB
 from ..obs import HISTOGRAMS, TRACER, propagate
 from ..obs.kerncheck import enabled as kerncheck_enabled
 from ..obs.pagecheck import enabled as pagecheck_enabled
+from ..obs.memprof import memprof, memprof_enabled
 from ..obs.profiler import profile_enabled, profiler as kernel_profiler
 from ..utils import jwt as jwt_util
 from ..utils.sync import lockcheck_enabled
@@ -712,12 +713,14 @@ def create_app(
                 trash = int(pstats.get("n_shards")
                             or pstats.get("lanes") or 1)
                 pinned = 0
+                prefstats = None
                 prefix = getattr(serving.engine, "_prefix", None)
                 if prefix is not None:
                     try:
-                        pinned = int((await _run_sync(prefix.stats)).get(
-                            "pinned_pages", 0))
+                        prefstats = await _run_sync(prefix.stats)
+                        pinned = int(prefstats.get("pinned_pages", 0))
                     except Exception:
+                        prefstats = None
                         pinned = 0
                 lines.append("# TYPE swarmdb_page_free gauge")
                 lines.append(f"swarmdb_page_free {free}")
@@ -739,6 +742,32 @@ def create_app(
                         f"swarmdb_pages_allocated_total{lbl} {a}")
                     lines.append(
                         f"swarmdb_pages_freed_total{lbl} {f}")
+                # prefix-cache lookup gauges (ISSUE 17 satellite): the
+                # per-lookup counters only reached bench records before;
+                # full_misses/lookups climbing is the anchor-jump
+                # signature (runbook step 14), flag-independent like the
+                # page gauges above
+                if prefstats is not None:
+                    lines.append(
+                        "# TYPE swarmdb_prefix_lookups_total counter")
+                    lines.append(f"swarmdb_prefix_lookups_total "
+                                 f"{prefstats.get('lookups', 0)}")
+                    lines.append(
+                        "# TYPE swarmdb_prefix_full_misses_total counter")
+                    lines.append(f"swarmdb_prefix_full_misses_total "
+                                 f"{prefstats.get('full_misses', 0)}")
+                    lines.append(
+                        "# TYPE swarmdb_prefix_cached_pages gauge")
+                    lines.append(f"swarmdb_prefix_cached_pages "
+                                 f"{prefstats.get('cached_pages', 0)}")
+                    lines.append(
+                        "# TYPE swarmdb_prefix_hit_tokens_total counter")
+                    lines.append(f"swarmdb_prefix_hit_tokens_total "
+                                 f"{prefstats.get('hit_tokens', 0)}")
+                    lines.append(
+                        "# TYPE swarmdb_prefix_miss_tokens_total counter")
+                    lines.append(f"swarmdb_prefix_miss_tokens_total "
+                                 f"{prefstats.get('miss_tokens', 0)}")
         if pagecheck_enabled():
             from ..obs import pagecheck
 
@@ -757,6 +786,14 @@ def create_app(
         if profile_enabled():
             lines.extend(await _run_sync(
                 kernel_profiler().prometheus_lines))
+        # swarmmem (ISSUE 17, SWARMDB_MEMPROF — default on): occupancy
+        # decomposition, conversation temperature, the sampled
+        # miss-ratio curve. The pager line is
+        # swarmdb_mem_headroom_pages shrinking while
+        # swarmdb_conversation_temperature{state="cold"} grows — parked
+        # KV is crowding out admission (runbook step 14).
+        if memprof_enabled():
+            lines.extend(await _run_sync(memprof().prometheus_lines))
         # replication lag (acks=all deployments): per-follower fsync-
         # watermark lag so the back-pressure path is observable instead
         # of silent — a disconnected follower shows up here as growing
@@ -1059,6 +1096,20 @@ def create_app(
         return web.json_response(
             await _run_sync(kernel_profiler().report))
 
+    async def admin_mem(request: web.Request) -> web.Response:
+        """GET /admin/mem — the swarmmem report (ISSUE 17): per-pool
+        occupancy decomposition + page residency ages, the
+        hot/warm/cold conversation temperature ledger, the SHARDS-
+        sampled miss-ratio curve, and the warm-tier / cold-resume
+        what-if models that size ROADMAP item 3. 503 with
+        SWARMDB_MEMPROF=0 — an empty ledger would read as "no pages
+        resident" when nothing was watching."""
+        require_admin(current_agent(request))
+        if not memprof_enabled():
+            raise _error(503, "memory accountant off — unset "
+                              "SWARMDB_MEMPROF=0")
+        return web.json_response(await _run_sync(memprof().report))
+
     async def admin_lanes(request: web.Request) -> web.Response:
         """GET /admin/lanes — the lane supervisor's full status: per-lane
         state machine (alive/suspect/quarantined), beat ages, quarantine
@@ -1245,6 +1296,7 @@ def create_app(
         web.get("/admin/pagecheck", admin_pagecheck),
         web.get("/admin/kerncheck", admin_kerncheck),
         web.get("/admin/profile", admin_profile),
+        web.get("/admin/mem", admin_mem),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

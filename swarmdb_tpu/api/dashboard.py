@@ -79,6 +79,12 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     download swarmprof report</button>
   (per-variant device time / MFU / roofline, lane duty cycles,
   dispatch-shape profile; 503 if SWARMDB_PROFILE=0)
+  &middot;
+  <button onclick="download('/admin/mem', 'mem.json')">
+    download swarmmem report</button>
+  (memory accountant: pool occupancy + residency ages, hot/warm/cold
+  conversation temperature, sampled miss-ratio curve, warm-tier and
+  cold-resume models; 503 if SWARMDB_MEMPROF=0)
   &middot; admin token required
 </p>
 <script>

@@ -342,6 +342,17 @@ class Engine:
         # main decode cache: paged pool or dense slot buffer; prefill always
         # uses dense bucket-sized temp caches from init_cache_fn
         self.cache = paged.init_pool() if paged else init_cache_fn(max_batch, max_seq)
+        if paged is not None:
+            # swarmmem (ISSUE 17): KV bytes per pool page — prices the
+            # warm-tier model's re-admission device_put
+            from ..obs.memprof import memprof as _memprof
+
+            try:
+                _k = self.cache["k"]
+                _memprof().set_page_bytes(
+                    2 * _k.nbytes // max(1, int(_k.shape[1])))
+            except Exception:  # cache layouts without nbytes (stubs)
+                pass
         self._decode_forward = paged.decode_forward if paged else forward_fn
         self._prefill_cache_fn = init_cache_fn
         self._seed = seed

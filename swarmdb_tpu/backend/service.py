@@ -403,6 +403,13 @@ class ServingService:
         # running partition leadership — shard hints then come from the
         # conversation's partition LEADER, not the bare pair hash
         self._locality = None
+        # swarmmem conversation-temperature ledger (ISSUE 17): touched
+        # once per served message / retirement — the evidence layer the
+        # tiered-KV hierarchy (ROADMAP item 3) is sized against. Flag
+        # off -> the shared NullConvLedger.
+        from ..obs.memprof import memprof
+
+        self._mem = memprof().conv_ledger()
         rolling_wanted = os.environ.get("SWARMDB_ROLLING_KV") == "1"
         if (rolling_wanted and self.engine.paged is not None
                 and getattr(self.engine.paged.allocator,
@@ -645,6 +652,7 @@ class ServingService:
             st = self._rolling.pop(k)
             if st["epoch"] == epoch:
                 eng.rolling_free(st["pages"])
+            self._mem.drop(k)
             self.db.metrics.counters["rolling_evictions"].inc()
 
     def _rolling_plan(self, key, msg: Message, sampling: SamplingParams,
@@ -819,6 +827,7 @@ class ServingService:
                 "epoch": self._rolling_epoch(),
                 "in_flight": True, "last": time.time(),
             }
+        self._mem.resident(key, len(pages))
 
     def _rolling_finalize(self, key, msg: Message, reason: str) -> None:
         """After the reply message is SENT (reply worker): record the
@@ -848,6 +857,7 @@ class ServingService:
                 if st.get("await_store") and reason in ("length", "eos"):
                     self.db.metrics.counters["rolling_restarts"].inc()
                 self._rolling.pop(key, None)
+                self._mem.drop(key)
                 if (st.get("pages")
                         and st["epoch"] == self._rolling_epoch()):
                     self.engine.rolling_free(st["pages"])
@@ -914,6 +924,7 @@ class ServingService:
                 while len(self._anchors) >= self._anchor_cap:
                     self._anchors.pop(next(iter(self._anchors)))
                 self._anchors[key] = head
+                self._mem.anchor(key, len(head))
                 self.db.metrics.counters["window_heads_anchored"].inc()
             else:
                 # LRU touch (size-capped dict, insertion order = LRU)
@@ -955,6 +966,11 @@ class ServingService:
         prompt = build_prompt(self.db, msg, self.tokenizer,
                               history_limit=_history_limit_for(
                                   self.engine.max_seq))
+        if msg.receiver_id:
+            # temperature ledger: one touch per served message, stamped
+            # with the UNTRIMMED prompt length (what a cold resume would
+            # re-prefill from the log)
+            self._mem.touch((msg.sender_id, msg.receiver_id), len(prompt))
         sampling = sampling_from_message(msg)
         priority = int(msg.priority.value if hasattr(msg.priority, "value")
                        else msg.priority)

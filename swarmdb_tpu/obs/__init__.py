@@ -26,10 +26,16 @@ can import the package without the ML stack.
   invocation/device-time accounting, MFU/roofline classification,
   per-lane duty cycles, and the dispatch-shape (wave kind x width)
   profile.
+- :mod:`.memprof` — swarmmem (``GET /admin/mem``): always-on KV/prefix
+  memory accountant — pool occupancy decomposition + residency ages,
+  the per-conversation hot/warm/cold temperature ledger, SHARDS-sampled
+  miss-ratio curves over prefix-cache accesses, and the warm-tier /
+  cold-resume what-if models ROADMAP item 3 is sized against.
 """
 
 from . import propagate
 from .flight import FlightRecorder
+from .memprof import MemProfiler, memprof, memprof_enabled
 from .metrics import HISTOGRAMS, Histogram, HistogramRegistry
 from .profiler import KernelProfiler, profile_enabled, profiler
 from .sentinel import SLOConfig, SLOSentinel
@@ -38,4 +44,5 @@ from .tracer import TRACER, SpanTracer
 __all__ = ["FlightRecorder", "SpanTracer", "TRACER", "propagate",
            "HISTOGRAMS", "Histogram", "HistogramRegistry",
            "SLOConfig", "SLOSentinel",
-           "KernelProfiler", "profile_enabled", "profiler"]
+           "KernelProfiler", "profile_enabled", "profiler",
+           "MemProfiler", "memprof", "memprof_enabled"]

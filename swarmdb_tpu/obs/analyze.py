@@ -66,7 +66,8 @@ import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["analyze_files", "summarize_trace", "summarize_flight",
-           "diagnose", "roofline_report", "self_check", "main"]
+           "diagnose", "roofline_report", "memory_report", "self_check",
+           "main"]
 
 #: span name -> cost category (everything engine-side that serializes
 #: the loop; routing spans are microseconds and excluded by design)
@@ -90,8 +91,9 @@ _WAVE_GAP_US = 2000.0  # prefill starts closer than this = same wave
 
 def load_file(path: str) -> Tuple[str, Any]:
     """('trace', events) for Chrome trace JSON, ('flight', dump) for a
-    flight-recorder dump, ('profile', dump) for a swarmprof dump;
-    raises ValueError for anything else."""
+    flight-recorder dump, ('profile', dump) for a swarmprof dump,
+    ('mem', dump) for a swarmmem dump; raises ValueError for anything
+    else."""
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     if isinstance(data, dict) and "traceEvents" in data:
@@ -101,9 +103,12 @@ def load_file(path: str) -> Tuple[str, Any]:
         return "flight", data
     if isinstance(data, dict) and data.get("kind") == "swarmdb.profile":
         return "profile", data
+    if isinstance(data, dict) and data.get("kind") == "swarmdb.mem":
+        return "mem", data
     raise ValueError(f"{path}: not a Chrome trace export (traceEvents), "
-                     "a flight dump (steps/requests), or a swarmprof "
-                     "profile dump (kind=swarmdb.profile)")
+                     "a flight dump (steps/requests), a swarmprof "
+                     "profile dump (kind=swarmdb.profile), or a swarmmem "
+                     "dump (kind=swarmdb.mem)")
 
 
 # --------------------------------------------------------------- summaries
@@ -394,6 +399,7 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
     traces: List[Tuple[str, Dict[str, Any]]] = []
     flights: List[Tuple[str, Dict[str, Any]]] = []
     profiles: List[Tuple[str, Dict[str, Any]]] = []
+    mems: List[Tuple[str, Dict[str, Any]]] = []
     inputs = []
     for path in paths:
         kind, data = load_file(path)
@@ -402,11 +408,14 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
             traces.append((path, summarize_trace(data)))
         elif kind == "profile":
             profiles.append((path, data))
+        elif kind == "mem":
+            mems.append((path, data))
         else:
             flights.append((path, summarize_flight(data)))
     if not traces:
         raise ValueError("need at least one Chrome trace export "
-                         "(use --roofline for profile dumps alone)")
+                         "(use --roofline for profile dumps alone, "
+                         "--memory for swarmmem dumps alone)")
     report: Dict[str, Any] = {
         "kind": "swarmdb.obs.analyze",
         "version": 1,
@@ -425,6 +434,10 @@ def analyze_files(paths: Sequence[str]) -> Dict[str, Any]:
                     + _profile_dumps(paths))
     if profile_list:
         report["profile_dumps"] = profile_list
+    mem_list = ([_mem_summary(p, d) for p, d in mems]
+                + _mem_dumps(paths))
+    if mem_list:
+        report["mem_dumps"] = mem_list
     base_flight = flights[0][1] if flights else None
     test_flight = flights[-1][1] if flights else None
     if len(traces) >= 2:
@@ -588,6 +601,104 @@ def _profile_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
                 continue
             out.append(_profile_summary(cand, dump))
     return out
+
+
+def _mem_summary(path: str, dump: Dict[str, Any]) -> Dict[str, Any]:
+    """One line per swarmmem dump for the main report: enough to spot
+    "the pool sat full of cold pages at a 40% prefix hit rate" without
+    opening the file (the --memory mode prints the full picture)."""
+    occ = dump.get("occupancy") or {}
+    conv = dump.get("conversations") or {}
+    prefix = dump.get("prefix") or {}
+    return {
+        "path": path,
+        "node": dump.get("node"),
+        "prefix_hit_rate": prefix.get("hit_rate"),
+        "total_pages": occ.get("total_pages"),
+        "headroom_pages": occ.get("headroom_pages"),
+        "conversations": conv.get("by_state"),
+        "verdict": dump.get("verdict"),
+    }
+
+
+def _mem_dumps(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """swarmmem dumps (``mem_*.json``, ISSUE 17) sitting next to the
+    analyzed flight/trace files — the memory sibling of the profile
+    listing above: the flight dump says what the node was doing, the
+    mem dump says where its KV pages and prefix-cache hit rate stood
+    while it did it."""
+    given = {os.path.abspath(p) for p in paths}
+    seen: set = set()
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        if d in seen:
+            continue
+        seen.add(d)
+        for cand in sorted(glob.glob(os.path.join(d, "mem_*.json"))):
+            if os.path.abspath(cand) in given:
+                continue
+            try:
+                with open(cand, "r", encoding="utf-8") as f:
+                    dump = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if dump.get("kind") != "swarmdb.mem":
+                continue
+            out.append(_mem_summary(cand, dump))
+    return out
+
+
+# ------------------------------------------------------------------- memory
+
+
+def memory_report(paths: Sequence[str]) -> Dict[str, Any]:
+    """``--memory``: the full memory-accounting report over swarmmem
+    dumps (``mem_*.json``). For each dump: the pool occupancy
+    decomposition with residency ages, the hot/warm/cold conversation
+    temperature distribution (plus the heaviest resident
+    conversations — item 3's demote candidates), the sampled miss-ratio
+    curve at the standard capacity multiples, the what-if warm-tier
+    model with re-admission cost, the cold-resume TTFT model, and the
+    sizing verdict ROADMAP item 3 asks for."""
+    dumps: List[Dict[str, Any]] = []
+    for path in paths:
+        kind, data = load_file(path)
+        if kind != "mem":
+            raise ValueError(f"{path}: --memory takes swarmmem dumps "
+                             "(kind=swarmdb.mem)")
+        conv = data.get("conversations") or {}
+        reuse = data.get("reuse") or {}
+        dumps.append({
+            "path": path,
+            "node": data.get("node"),
+            "enabled": data.get("enabled"),
+            "page_bytes": data.get("page_bytes"),
+            "occupancy": data.get("occupancy"),
+            "prefix": data.get("prefix"),
+            "temperature": {
+                "hot_s": data.get("hot_s"),
+                "warm_s": data.get("warm_s"),
+                "tracked": conv.get("tracked"),
+                "by_state": conv.get("by_state"),
+                "resident_pages_by_state":
+                    conv.get("resident_pages_by_state"),
+                "top_resident": conv.get("top_resident"),
+            },
+            "miss_ratio_curve": reuse.get("curve"),
+            "sampling": {k: reuse.get(k) for k in
+                         ("accesses", "sampled", "cold", "sample_rate",
+                          "stack_overflowed",
+                          "device_capacity_pages")},
+            "warm_tier": data.get("warm_tier"),
+            "cold_resume": data.get("cold_resume"),
+            "verdict": data.get("verdict"),
+        })
+    return {
+        "kind": "swarmdb.obs.memory",
+        "version": 1,
+        "dumps": dumps,
+    }
 
 
 # ----------------------------------------------------------------- roofline
@@ -774,6 +885,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "profile dumps (profile_*.json): top device-"
                          "time variants, MFU, compute- vs memory-bound, "
                          "lane duty cycles, tiny ragged flush waves")
+    ap.add_argument("--memory", action="store_true",
+                    help="memory-accounting report over swarmmem dumps "
+                         "(mem_*.json): pool occupancy + residency "
+                         "ages, conversation temperature, sampled "
+                         "miss-ratio curve, warm-tier / cold-resume "
+                         "models and the tier-sizing verdict")
     args = ap.parse_args(argv)
 
     if args.self_check:
@@ -784,8 +901,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.paths:
         ap.error("no input files (or use --self-check)")
     try:
-        report = (roofline_report(args.paths) if args.roofline
-                  else analyze_files(args.paths))
+        if args.memory:
+            report = memory_report(args.paths)
+        elif args.roofline:
+            report = roofline_report(args.paths)
+        else:
+            report = analyze_files(args.paths)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"analyze: {exc}", file=sys.stderr)
         return 2

@@ -200,6 +200,7 @@ class FlightRecorder:
                 path = self.dump_to(directory, reason)
                 logger.info("flight record dumped to %s (%s)", path, reason)
                 self._profile_dump(reason, directory)
+                self._mem_dump(reason, directory)
                 return path
             self.last_dump = self.dump(reason)
             return None
@@ -224,3 +225,16 @@ class FlightRecorder:
                 profiler().auto_dump(reason, directory)
         except Exception:
             logger.exception("profile dump failed (%s)", reason)
+
+    @staticmethod
+    def _mem_dump(reason: str, directory: str) -> None:
+        """swarmmem snapshot riding every flight auto-dump (ISSUE 17):
+        the same failure artifacts carry the pool occupancy /
+        temperature / miss-ratio picture. Best-effort, never raises."""
+        try:
+            from .memprof import memprof, memprof_enabled
+
+            if memprof_enabled():
+                memprof().auto_dump(reason, directory)
+        except Exception:
+            logger.exception("mem dump failed (%s)", reason)

@@ -82,6 +82,7 @@ class SLOConfig:
     __slots__ = ("window_s", "warmup_windows", "min_completions",
                  "ttft_p95_s", "queue_p95_s", "cost_growth_x",
                  "retry_rate", "mfu_drop_x", "duty_drop_x",
+                 "prefix_hit_drop_x", "mem_headroom_min",
                  "max_alerts", "enabled")
 
     def __init__(self,
@@ -94,6 +95,8 @@ class SLOConfig:
                  retry_rate: Optional[float] = None,
                  mfu_drop_x: Optional[float] = None,
                  duty_drop_x: Optional[float] = None,
+                 prefix_hit_drop_x: Optional[float] = None,
+                 mem_headroom_min: Optional[float] = None,
                  max_alerts: Optional[int] = None,
                  enabled: Optional[bool] = None) -> None:
         self.window_s = window_s if window_s is not None else \
@@ -126,6 +129,17 @@ class SLOConfig:
             _env_float("SWARMDB_SLO_MFU_DROP_X", 3.0)
         self.duty_drop_x = duty_drop_x if duty_drop_x is not None else \
             _env_float("SWARMDB_SLO_DUTY_DROP_X", 3.0)
+        # swarmmem SLOs (ISSUE 17): a busy window whose prefix hit rate
+        # fell past baseline/<factor> (the anchor-jump / cache-thrash
+        # signature), or whose pool headroom (free + evictable pages
+        # over total) dropped under an absolute floor — parked KV is
+        # about to starve admission. <= 1 / <= 0 disables.
+        self.prefix_hit_drop_x = prefix_hit_drop_x \
+            if prefix_hit_drop_x is not None else \
+            _env_float("SWARMDB_SLO_PREFIX_HIT_DROP_X", 2.0)
+        self.mem_headroom_min = mem_headroom_min \
+            if mem_headroom_min is not None else \
+            _env_float("SWARMDB_SLO_MEM_HEADROOM_MIN", 0.05)
         self.max_alerts = max_alerts if max_alerts is not None else \
             _env_int("SWARMDB_SLO_ALERTS", 64)
         self.enabled = enabled if enabled is not None else \
@@ -171,6 +185,9 @@ class SLOSentinel:
         # swarmprof cumulative snapshot of the previous close (window
         # MFU / duty cycles are deltas, like every other window number)
         self._prev_prof: Optional[Dict[str, Any]] = None
+        # swarmmem cumulative snapshot (window prefix hit rate is a
+        # token-count delta, same stance)
+        self._prev_mem: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- wiring
 
@@ -194,6 +211,7 @@ class SLOSentinel:
             self._window_opened = time.time()
             self._prev_counters = None  # re-anchor, don't bill the gap
             self._prev_prof = None
+            self._prev_mem = None
 
     # -------------------------------------------------------- record path
 
@@ -317,6 +335,7 @@ class SLOSentinel:
                 / 1e3 / chunks, 3),
         }
         self._profile_window(window)
+        self._mem_window(window)
         self.ingest(window)
 
     def _profile_window(self, window: Dict[str, Any]) -> None:
@@ -348,6 +367,32 @@ class SLOSentinel:
         if duties:
             window["min_lane_duty"] = round(min(duties), 4)
 
+    def _mem_window(self, window: Dict[str, Any]) -> None:
+        """Fold swarmmem deltas into the closing window: the window's
+        prefix hit rate (hit-token delta over looked-up-token delta)
+        and the CURRENT pool headroom fraction (free + cached-evictable
+        over total) — the numbers the prefix_hit_drop_x /
+        mem_headroom_min SLOs watch. No-op with the accountant off."""
+        try:
+            from .memprof import memprof, memprof_enabled
+        except Exception:  # pragma: no cover - import is stdlib-only
+            return
+        if not memprof_enabled():
+            return
+        mp = memprof()
+        cur = mp.counters_snapshot()
+        prev, self._prev_mem = self._prev_mem, cur
+        total = cur.get("pool_total_pages", 0)
+        if total > 0:
+            window["mem_headroom_frac"] = round(
+                cur.get("pool_headroom_pages", 0) / total, 4)
+        if prev is None:
+            return
+        dhit = cur["hit_tokens"] - prev["hit_tokens"]
+        dmiss = cur["miss_tokens"] - prev["miss_tokens"]
+        if dhit + dmiss > 0:
+            window["prefix_hit_rate"] = round(dhit / (dhit + dmiss), 4)
+
     # ---------------------------------------------------------- detection
 
     @staticmethod
@@ -369,6 +414,8 @@ class SLOSentinel:
                      round(w["retried"] / max(1, w["completed"]), 3))
         w.setdefault("mfu", None)
         w.setdefault("min_lane_duty", None)
+        w.setdefault("prefix_hit_rate", None)
+        w.setdefault("mem_headroom_frac", None)
         return w
 
     def _baseline_from_warmup(self) -> Dict[str, Any]:
@@ -389,7 +436,7 @@ class SLOSentinel:
                 sum(w["mean_wave_size"] for w in self._warmup) / n, 2),
         }
         for key in ("p95_ttft_s", "p95_queue_wait_s", "mfu",
-                    "min_lane_duty"):
+                    "min_lane_duty", "prefix_hit_rate"):
             vals = [w[key] for w in self._warmup if w.get(key) is not None]
             base[key] = round(sum(vals) / len(vals), 6) if vals else None
         return base
@@ -462,6 +509,24 @@ class SLOSentinel:
                              "limit": round(base_duty / cfg.duty_drop_x,
                                             4),
                              "value": duty})
+        # swarmmem SLOs (ISSUE 17): hit rate collapsing past
+        # baseline/<factor> is the cache-thrash / anchor-jump signature;
+        # headroom under the absolute floor means parked KV is about to
+        # starve admission (runbook step 14 names the checks).
+        hr = window.get("prefix_hit_rate")
+        base_hr = self.baseline.get("prefix_hit_rate")
+        if (hr is not None and base_hr and cfg.prefix_hit_drop_x > 1.0
+                and hr < base_hr / cfg.prefix_hit_drop_x):
+            breaches.append({"slo": "prefix_hit_drop_x",
+                             "limit": round(
+                                 base_hr / cfg.prefix_hit_drop_x, 4),
+                             "value": hr})
+        headroom = window.get("mem_headroom_frac")
+        if (headroom is not None and cfg.mem_headroom_min > 0
+                and headroom < cfg.mem_headroom_min):
+            breaches.append({"slo": "mem_headroom_min",
+                             "limit": cfg.mem_headroom_min,
+                             "value": headroom})
         return breaches
 
     def _fire_alert(self, window: Dict[str, Any],
@@ -606,6 +671,14 @@ class SLOSentinel:
             lines.append("# TYPE swarmdb_slo_min_lane_duty gauge")
             lines.append(
                 f"swarmdb_slo_min_lane_duty {w['min_lane_duty']}")
+        if w.get("prefix_hit_rate") is not None:
+            lines.append("# TYPE swarmdb_slo_prefix_hit_rate gauge")
+            lines.append(
+                f"swarmdb_slo_prefix_hit_rate {w['prefix_hit_rate']}")
+        if w.get("mem_headroom_frac") is not None:
+            lines.append("# TYPE swarmdb_slo_mem_headroom_frac gauge")
+            lines.append(
+                f"swarmdb_slo_mem_headroom_frac {w['mem_headroom_frac']}")
         if w.get("per_completion_ms"):
             lines.append("# TYPE swarmdb_slo_per_completion_ms gauge")
             for cat in CATEGORIES:

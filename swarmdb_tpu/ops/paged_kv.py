@@ -349,6 +349,12 @@ class PageAllocator:
         # reclaims the whole pool, so a stale holder resuming or freeing
         # them would alias another slot's pages (ADVICE r4 medium #2).
         self.generation = 0
+        # swarmmem residency ledger (ISSUE 17): page alloc/free stamps
+        # piggybacked on the critical sections below. Flag off -> the
+        # shared NullPool, one no-op call per hook site.
+        from ..obs.memprof import memprof
+
+        self.mem = memprof().pool(self.stats)
         self._rebuild_free()
 
     # -- free-list geometry (the ONLY pieces the sharded subclass swaps) -----
@@ -397,6 +403,7 @@ class PageAllocator:
             if pages is None:
                 return None
             self.pages_allocated_total += len(pages)
+            self.mem.page_alloc(pages)
             self._by_slot[slot_id] = _SlotPages(pages)
             row = np.zeros(self.maxp, np.int32)
             row[: len(pages)] = pages
@@ -418,6 +425,7 @@ class PageAllocator:
             if fresh is None:
                 return None
             self.pages_allocated_total += len(fresh)
+            self.mem.page_alloc(fresh)
             self._by_slot[slot_id] = _SlotPages(fresh)
             row = np.zeros(self.maxp, np.int32)
             pages = list(prefix_pages) + fresh
@@ -438,6 +446,7 @@ class PageAllocator:
         path; the caller guarantees no live slot references them)."""
         with self._lock:
             self.pages_freed_total += len(page_ids)
+            self.mem.page_free(page_ids)
             self._give(page_ids)
 
     def reserve(self, n: int) -> List[int]:
@@ -449,7 +458,9 @@ class PageAllocator:
         implicitly (the ids die with the generation)."""
         with self._lock:
             take = min(n, len(self._free))
-            return [self._free.pop() for _ in range(take)]
+            out = [self._free.pop() for _ in range(take)]
+            self.mem.page_alloc(out)
+            return out
 
     def free_count(self, slot_id: Optional[int] = None) -> int:
         """Free pages available — to ``slot_id`` if given (the sharded
@@ -491,6 +502,7 @@ class PageAllocator:
                 sp = self._by_slot.pop(slot_id, None)
                 if sp is not None:
                     self.pages_freed_total += len(sp.pages)
+                    self.mem.page_free(sp.pages)
                     self._give(list(reversed(sp.pages)))
 
     def requeue_pending(self, pending: List[int]) -> None:
@@ -566,6 +578,7 @@ class PageAllocator:
             self._rebuild_free()
             self._by_slot.clear()
             self._pending_free.clear()
+            self.mem.pool_reset()
 
 
 class ShardedPageAllocator(PageAllocator):

@@ -130,6 +130,12 @@ class PrefixLRU:
         # a later occupant's pages (same contract as
         # ops.paged_kv.PageAllocator.generation).
         self.generation = 0
+        # swarmmem reuse-distance probe (ISSUE 17): every match() feeds
+        # its chain accesses to the SHARDS sampler (flag off -> the
+        # shared NullProbe; unsampled accesses cost one hash+compare).
+        from ..obs.memprof import memprof
+
+        self.mem = memprof().prefix_probe(self.stats)
 
     # ---------------------------------------------------------------- lookup
 
@@ -156,6 +162,10 @@ class PrefixLRU:
                 self.lookups += 1
                 if not pages:
                     self.full_misses += 1
+            m = self.mem
+            if m.enabled:
+                for chain in chains:
+                    m.access(chain)
         return pages
 
     # ------------------------------------------------------------ allocation

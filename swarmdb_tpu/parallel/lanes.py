@@ -186,6 +186,10 @@ class ShardLaneGroup:
             # lane d's busy fraction is the admission-overlap win made
             # into a per-lane number (GET /admin/profile, /metrics)
             eng._prof.set_label(f"lane{idx}")
+            # swarmmem pool residency carries the same lane naming, so
+            # the /admin/mem occupancy rows line up with duty cycles
+            if eng.paged is not None:
+                eng.paged.allocator.mem.set_label(f"lane{idx}")
 
     def _make_probe(self, idx: int) -> Callable[[], bool]:
         def probe() -> bool:

@@ -1,0 +1,60 @@
+"""swarmlint fixture: SWL507 — per-access allocation in hot
+memory-accountant record-path code.
+
+The swarmmem hooks (``MemPool.page_alloc``/``page_free``,
+``PrefixProbe.access``, ``ReuseSampler`` record paths) run INSIDE locks
+the page allocator and prefix cache already hold — that is the whole
+"piggybacked int adds" overhead story. Expected findings are marked;
+the clean methods show the sanctioned shape (slot writes and int adds
+only; reporting allocates freely off the hot path).
+"""
+
+import time
+
+
+class MemPoolLedger:
+    def __init__(self):
+        self.ages = {}
+        self.events = []
+        self.alloc_events = 0
+        self.free_events = 0
+
+    # swarmlint: hot
+    def page_alloc_bad(self, pages):
+        self.events.append({"pages": list(pages)})  # EXPECT: SWL507
+        self.alloc_events += 1
+
+    # swarmlint: hot
+    def page_free_bad(self, pages):
+        self.last_free = f"freed {len(pages)}"  # EXPECT: SWL507
+        self.free_events += 1
+
+    # swarmlint: hot
+    def page_alloc_clean(self, pages):
+        # clean: one clock read, one dict slot write per page, int adds
+        t = time.monotonic_ns()
+        ages = self.ages
+        for p in pages:
+            ages[p] = t
+        self.alloc_events += 1
+
+    def report(self):
+        # clean: reporting is OFF the record path — allocate freely
+        return {"pages": len(self.ages), "allocs": self.alloc_events}
+
+
+class ReuseSamplerProbe:
+    def __init__(self):
+        self._hist = {}
+        self.sampled = 0
+
+    # swarmlint: hot
+    def access_bad(self, chain):
+        key = str(chain)  # EXPECT: SWL507
+        self._hist[key] = self._hist.get(key, 0) + 1
+
+    # swarmlint: hot
+    def access_clean(self, chain, sd):
+        # clean: int add into an existing histogram slot
+        self.sampled += 1
+        self._hist[sd] = self._hist.get(sd, 0) + 1

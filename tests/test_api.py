@@ -732,6 +732,95 @@ def test_admin_profile_endpoint(tmp_path, monkeypatch):
         prof.reset()
 
 
+def test_admin_mem_endpoint(tmp_path, monkeypatch):
+    """GET /admin/mem: 503 with SWARMDB_MEMPROF=0 (an empty ledger would
+    read as "no pages resident" when nothing watched); on by default it
+    returns the swarmmem report and /metrics grows the swarmdb_mem_* /
+    swarmdb_conversation_temperature lines — while the PageAllocator /
+    PrefixLRU gauges stay FLAG-INDEPENDENT (ISSUE 17 satellite: the
+    pool/prefix counters render off the serving engine's own stats even
+    with the accountant off)."""
+    import types
+
+    from swarmdb_tpu.obs.memprof import memprof
+    from swarmdb_tpu.ops.paged_kv import PageAllocator
+    from swarmdb_tpu.ops.prefix_cache import PrefixLRU
+
+    def fake_serving(alloc, prefix):
+        return types.SimpleNamespace(engine=types.SimpleNamespace(
+            paged=types.SimpleNamespace(allocator=alloc),
+            _prefix=prefix))
+
+    monkeypatch.setenv("SWARMDB_MEMPROF", "0")
+    alloc_off = PageAllocator(9, 4, 16, 2)
+    assert alloc_off.allocate(0, 2) is not None
+    lru_off = PrefixLRU(9, 4)
+    lru_off.match([b"\x01" * 16], [1, 2, 3, 4])
+
+    async def drive_off(client, db):
+        headers = await get_token(client, "admin", "pw")
+        r = await client.get("/admin/mem", headers=headers)
+        assert r.status == 503
+        r = await client.get("/metrics")
+        body = await r.text()
+        # accountant lines gone with the flag off...
+        assert "swarmdb_mem_" not in body
+        assert "swarmdb_conversation_temperature" not in body
+        # ...but the pool/prefix gauges are flag-independent
+        assert "swarmdb_page_free 6" in body
+        assert 'swarmdb_pages_allocated_total{lane="lane0"} 2' in body
+        assert "swarmdb_prefix_lookups_total 1" in body
+        assert "swarmdb_prefix_full_misses_total 1" in body
+        assert "swarmdb_prefix_cached_pages 0" in body
+
+    api_drive(drive_off, tmp_path, serving=fake_serving(alloc_off,
+                                                        lru_off))
+
+    monkeypatch.delenv("SWARMDB_MEMPROF", raising=False)
+    prof = memprof()
+    prof.reset()
+    prof.set_enabled(True)
+    try:
+        alloc = PageAllocator(9, 4, 16, 2)
+        alloc.mem.set_label("api-mem-lane")
+        assert alloc.allocate(0, 2) is not None
+        lru = PrefixLRU(9, 4)
+        lru.match([b"\x02" * 16], [5, 6, 7, 8])
+        prof.conv_ledger().touch(("api", "mem"), 8)
+
+        async def drive_on(client, db):
+            headers = await get_token(client, "admin", "pw")
+            r = await client.get("/admin/mem", headers=headers)
+            assert r.status == 200
+            report = await r.json()
+            assert report["kind"] == "swarmdb.mem"
+            assert report["enabled"] is True
+            occ = report["occupancy"]
+            assert occ["total_pages"] >= 8  # 9-page pool minus trash
+            assert any(row["pool"] == "api-mem-lane"
+                       for row in occ["pools"])
+            assert report["conversations"]["tracked"] >= 1
+            assert report["conversations"]["by_state"]["hot"] >= 1
+            assert report["prefix"]["lookups"] >= 1
+            assert len(report["reuse"]["curve"]) == 5
+            assert "warm_tier" in report and "cold_resume" in report
+            r = await client.get("/metrics")
+            body = await r.text()
+            assert 'swarmdb_mem_pool_pages{state="free"}' in body
+            assert "swarmdb_mem_headroom_pages " in body
+            assert ('swarmdb_conversation_temperature{state="hot"}'
+                    in body)
+            assert "swarmdb_mem_sampled_accesses_total " in body
+            assert 'swarmdb_mem_curve_hit_rate{capacity="1.0x"}' in body
+            # flag-independent gauges unchanged alongside
+            assert "swarmdb_page_free 6" in body
+            assert "swarmdb_prefix_lookups_total 1" in body
+
+        api_drive(drive_on, tmp_path, serving=fake_serving(alloc, lru))
+    finally:
+        prof.reset()
+
+
 def test_worker_recycling_hook(tmp_path):
     """cfg.max_requests fires the recycle hook exactly once after the
     threshold (gunicorn max_requests counterpart)."""

@@ -45,6 +45,7 @@ def expected_findings(path: Path):
     "metrics_bad.py",           # histogram discipline (SWL503)
     "exemplar_bad.py",          # exemplar/sentinel allocation (SWL504)
     "profile_bad.py",           # compile-time introspection in hot code (SWL506)
+    "memprof_bad.py",           # memprof record-path allocation (SWL507)
     "heartbeat_bad.py",         # heartbeat-safety family (SWL601/602)
     "fence_bad.py",             # fencing discipline (SWL603)
     "retry_bad.py",             # retry-discipline family (SWL701)
@@ -328,7 +329,8 @@ def test_cli_module_smoke():
     assert proc.returncode == 0
     for rule in ("SWL101", "SWL203", "SWL301", "SWL302", "SWL303",
                  "SWL304", "SWL305", "SWL401", "SWL501",
-                 "SWL502", "SWL503", "SWL504", "SWL601", "SWL602",
+                 "SWL502", "SWL503", "SWL504", "SWL506", "SWL507",
+                 "SWL601", "SWL602",
                  "SWL603", "SWL801", "SWL802", "SWL803", "SWL804",
                  "SWL805"):
         assert rule in proc.stdout
