@@ -349,6 +349,16 @@ def _device_extras(service, model: str) -> dict:
         from swarmdb_tpu.ops.layers import decode_kernel_choice
 
         extras["kernel"] = decode_kernel_choice(service.engine.max_seq)
+        # pool payload dtype + decode's pool-read cost per token: the
+        # roofline lever int8 pools pull — bench_trend gates these
+        # like-for-like too (an int8 record must not "beat" a bf16 one)
+        from swarmdb_tpu.ops.paged_kv import (kv_dtype_name,
+                                              pool_page_bytes)
+
+        extras["kv_dtype"] = kv_dtype_name()
+        page_bytes = (pool_page_bytes(service.engine.cache["k"])
+                      + pool_page_bytes(service.engine.cache["v"]))
+        extras["kv_bytes_per_token"] = page_bytes // st["page_size"]
     else:
         extras["kv_cache"] = "dense"
     # warmup cost rides the record (VERDICT r5 #6: the warmup-time drop
@@ -2089,6 +2099,8 @@ _SUMMARY_KEYS = (
     ("hit", "prefix_hit_rate"),
     ("pad", "prefill_padding_ratio"),
     ("kern", "kernel"),
+    ("kv", "kv_dtype"),
+    ("kvb", "kv_bytes_per_token"),
     ("duty", "min_lane_duty_cycle"),
     ("pl", "platform"),
     ("native", "native_broker_msgs_per_sec"),
@@ -2158,11 +2170,12 @@ def _compact_summary(results: dict, error: str | None = None) -> dict:
     line["detail"] = "per-mode JSON lines above"
     raw = json.dumps(line)
     if len(raw) > 1480:  # belt-and-braces: shed perf scalars, then errs.
-        # NEVER shed "pl" or "kern": the cpu-fallback/kernel markers are
-        # what stop a CPU or gather-path number from masquerading as a
-        # TPU/pallas perf claim in the record (bench_trend compares
-        # like-for-like on exactly these fields)
-        keep = {"v", "pl", "kern", "native"}
+        # NEVER shed "pl", "kern", or "kv": the cpu-fallback/kernel/
+        # pool-dtype markers are what stop a CPU, gather-path, or int8
+        # number from masquerading as a TPU/pallas/bf16 perf claim in
+        # the record (bench_trend compares like-for-like on exactly
+        # these fields)
+        keep = {"v", "pl", "kern", "kv", "native"}
         for mode_sum in line["modes"].values():
             mode_sum.pop("ph", None)
             mode_sum.pop("hdrm", None)

@@ -183,6 +183,16 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
                 "reason": f"decode kernel changed ({bk} -> {tk}); "
                           f"compare like-for-like records only"})
             continue
+        # KV pool dtype (ISSUE 18): an int8-pool record halves decode's
+        # pool bytes — comparing it against a bf16 base (or vice versa)
+        # would manufacture a phantom speedup/regression
+        bq, tq = b.get("kv"), t.get("kv")
+        if bq is not None and tq is not None and bq != tq:
+            verdicts.append({
+                "mode": mode, "comparable": False,
+                "reason": f"kv pool dtype changed ({bq} -> {tq}); "
+                          f"compare like-for-like records only"})
+            continue
         ratio = tv / bv
         entry: Dict[str, Any] = {
             "mode": mode,

@@ -91,8 +91,42 @@ def main() -> int:
         q, sk, sv, kp, vp, tables, starts, lens, plens,
         kernel=unmasked)
 
+    # -- crime 4: wrong scale on the int8 pool ------------------------
+    # quantize the same pools, then hand the quant kernel DOUBLED
+    # K-scales while the XLA reference dequantizes with the true scales —
+    # the parity check must catch the scale-bookkeeping divergence
+    from swarmdb_tpu.ops.layers import ragged_prefill_attention_reference
+    from swarmdb_tpu.ops.paged_kv import QuantPool, _quantize_pages
+
+    # draw until some live row ATTENDS prefix pages (plens > 0) — a
+    # suffix-only wave never reads the pool, so wrong scales are moot
+    while not ((np.asarray(plens) > 0) & (np.asarray(lens) > 0)).any():
+        (q, sk, sv, kp, vp, tables, starts, lens, plens,
+         _tok_row) = kerncheck._random_ragged_case(rng)
+    kq, ks = _quantize_pages(kp)
+    vq, vs = _quantize_pages(vp)
+    import jax.numpy as jnp
+
+    got = np.asarray(ap.ragged_paged_prefill_attention_quant(
+        q, sk, sv, kq, ks * 2.0, vq, vs, tables, starts, lens, plens,
+        interpret=True))
+    want_q = np.asarray(ragged_prefill_attention_reference(
+        q, sk, sv, QuantPool(kq, ks), QuantPool(vq, vs), tables,
+        starts, lens, plens, jnp.asarray(_tok_row)))
+    live = np.asarray(_tok_row) < np.asarray(tables).shape[0]
+    err = float(np.max(np.abs(got[live] - want_q[live])))
+    tol = kerncheck.parity_tol("int8")
+    kerncheck.registry().note_check("drill.wrong-scale")
+    if err > tol:
+        kerncheck.registry().record(
+            "parity", "ragged_paged_prefill_attention_quant",
+            f"seeded wrong-scale crime: doubled K scales shift live "
+            f"outputs by {err:.3e} (> {tol}) vs the true-scale "
+            f"reference — scale bookkeeping divergence detected",
+            {"max_err": err})
+
     kinds = {v["kind"] for v in kerncheck.registry().violations()}
-    want = {"oob-block", "short-write", "write-race"}
+    want = {"oob-block", "short-write", "write-race", "parity"}
     missing = want - kinds
     dump = os.path.join(dump_dir, "kerncheck_kerncheck-drill.json")
     print(f"violations recorded: {sorted(kinds)}")

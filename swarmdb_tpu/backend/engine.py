@@ -346,11 +346,14 @@ class Engine:
             # swarmmem (ISSUE 17): KV bytes per pool page — prices the
             # warm-tier model's re-admission device_put
             from ..obs.memprof import memprof as _memprof
+            from ..ops.paged_kv import pool_page_bytes
 
             try:
-                _k = self.cache["k"]
+                # pool_page_bytes folds the int8 QuantPool's scale planes
+                # into the per-page price (plain arrays: nbytes // pages)
                 _memprof().set_page_bytes(
-                    2 * _k.nbytes // max(1, int(_k.shape[1])))
+                    pool_page_bytes(self.cache["k"])
+                    + pool_page_bytes(self.cache["v"]))
             except Exception:  # cache layouts without nbytes (stubs)
                 pass
         self._decode_forward = paged.decode_forward if paged else forward_fn
@@ -786,8 +789,13 @@ class Engine:
             kc = ck.reshape((L, Bp * chunks, ps) + tail)
             vc = cv.reshape((L, Bp * chunks, ps) + tail)
             flat = target_pages.reshape(-1)             # [Bp*chunks]
-            k_pool = k_pool.at[:, flat].set(kc.astype(k_pool.dtype))
-            v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
+            # pool_insert_pages quantizes whole pages on write for the
+            # int8 QuantPool (scale from per-page-per-head amax); plain
+            # pools keep the old cast-and-scatter
+            from ..ops.paged_kv import pool_insert_pages
+
+            k_pool = pool_insert_pages(k_pool, flat, kc)
+            v_pool = pool_insert_pages(v_pool, flat, vc)
             last_tokens = last_tokens.at[slot_ids].set(next_tok, mode="drop")
             last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
             last_tokens, last_lps = self._pin_slot_state(last_tokens,
@@ -976,8 +984,10 @@ class Engine:
                 kc = sk.reshape((L, Bp * chunks, ps) + tail)
                 vc = sv.reshape((L, Bp * chunks, ps) + tail)
                 flat = target_pages.reshape(-1)
-                k_pool = k_pool.at[:, flat].set(kc.astype(k_pool.dtype))
-                v_pool = v_pool.at[:, flat].set(vc.astype(v_pool.dtype))
+                from ..ops.paged_kv import pool_insert_pages
+
+                k_pool = pool_insert_pages(k_pool, flat, kc)
+                v_pool = pool_insert_pages(v_pool, flat, vc)
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
                 last_lps = last_lps.at[slot_ids].set(lp, mode="drop")
@@ -1001,7 +1011,7 @@ class Engine:
                                              row_tables, slot_ids, k_pool,
                                              v_pool, last_tokens, last_lps,
                                              base_keys, temp, topk, topp):
-                from ..ops.paged_kv import paged_write_chunk
+                from ..ops.paged_kv import paged_write_chunk, pool_dtype
 
                 Bp, T = tokens.shape
                 logits, sk, sv = pages_fwd(
@@ -1016,8 +1026,8 @@ class Engine:
                 )
                 lp = token_logprob(last, next_tok)
                 k_pool, v_pool = paged_write_chunk(
-                    k_pool, v_pool, sk.astype(k_pool.dtype),
-                    sv.astype(v_pool.dtype), resume_lens, row_tables,
+                    k_pool, v_pool, sk.astype(pool_dtype(k_pool)),
+                    sv.astype(pool_dtype(v_pool)), resume_lens, row_tables,
                 )
                 last_tokens = last_tokens.at[slot_ids].set(next_tok,
                                                            mode="drop")
@@ -1653,6 +1663,24 @@ class Engine:
                 ca = ca[0] if ca else None
             ca = ca or {}
             meta: Dict[str, Any] = {}
+            if self.paged is not None:
+                # pool payload dtype joins the variant row so roofline
+                # A/Bs (bf16 vs int8 pools) stay like-for-like, and
+                # the pool's true HBM price per covered token rides
+                # along — XLA's cost model prices the FALLBACK graph
+                # (whose dequant materializes f32 pages), not the
+                # in-kernel dequant the TPU path runs, so the roofline
+                # A/B reads KV traffic off this column instead
+                from ..ops.paged_kv import kv_dtype_name, pool_page_bytes
+
+                meta["kv_dtype"] = kv_dtype_name()
+                try:
+                    ps = int(self.paged.page_size)
+                    meta["kv_bytes_per_token"] = (
+                        pool_page_bytes(self.cache["k"])
+                        + pool_page_bytes(self.cache["v"])) // max(1, ps)
+                except Exception:  # stub caches without nbytes
+                    pass
             if (family.startswith(("decode", "resident"))
                     and self._decode_kernel is not None):
                 # which attention path this program lowers to — the

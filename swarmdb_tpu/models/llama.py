@@ -130,10 +130,12 @@ def init_paged_cache(
     max_seq: int,
     num_pages: int,
     page_size: int,
-    dtype: jnp.dtype = jnp.bfloat16,
+    dtype: Optional[jnp.dtype] = None,
 ):
     """Block-paged KV pool (ops/paged_kv.py): HBM ∝ num_pages*page_size,
-    not batch*max_seq. Returns {"k", "v", "page_table"}."""
+    not batch*max_seq. Returns {"k", "v", "page_table"}. ``dtype=None``
+    resolves SWARMDB_KV_DTYPE (bf16 default; int8 yields QuantPool
+    entries — see ops/paged_kv.py)."""
     from ..ops.paged_kv import init_paged_kv_cache
 
     return init_paged_kv_cache(
@@ -226,9 +228,12 @@ def forward_prefix_pages(
     """
     if cfg.is_moe:
         raise ValueError(f"{cfg.name!r} is MoE; use models.mixtral")
+    from ..ops.paged_kv import _dequantize_pages, is_quantized, pool_data
+
     Bp, T = tokens.shape
-    L, P = pool_k.shape[0], pool_k.shape[1]
-    ps = pool_k.shape[2]
+    quant = is_quantized(pool_k)
+    L, P = pool_data(pool_k).shape[0], pool_data(pool_k).shape[1]
+    ps = pool_data(pool_k).shape[2]
     PP = prefix_table.shape[1]
     Pt = PP * ps
     x = params["embed"][tokens]
@@ -238,16 +243,24 @@ def forward_prefix_pages(
     # one fused gather per layer: flatten (L, P) so layer index l and the
     # page table combine into a single index array (a dynamic_slice of the
     # pool followed by a page gather may or may not fuse; this form always
-    # reads only the needed pages)
-    pool_k_flat = pool_k.reshape((L * P,) + pool_k.shape[2:])
-    pool_v_flat = pool_v.reshape((L * P,) + pool_v.shape[2:])
+    # reads only the needed pages). Quantized pools gather payload AND
+    # scale rows, dequantizing to f32 right after the gather.
+    from ..ops.paged_kv import pool_flat
+
+    pool_k_flat = pool_flat(pool_k)
+    pool_v_flat = pool_flat(pool_v)
+
+    def _gather_pages(flat, idx):
+        if quant:
+            return _dequantize_pages(flat.data[idx], flat.scale[idx]
+                                     ).reshape(Bp, Pt, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        return flat[idx].reshape(Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
 
     def layer_step(x, scanned):
         lp, l = scanned
-        kp = pool_k_flat[l * P + prefix_table].reshape(
-            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
-        vp = pool_v_flat[l * P + prefix_table].reshape(
-            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
+        kp = _gather_pages(pool_k_flat, l * P + prefix_table)
+        vp = _gather_pages(pool_v_flat, l * P + prefix_table)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
                            cfg.head_dim, cos, sin)
@@ -308,13 +321,15 @@ def forward_ragged_prefill(
         raise ValueError(f"{cfg.name!r} is MoE; ragged prefill is "
                          "dense-Llama-only for now")
     from ..ops.layers import ragged_prefill_dispatch
+    from ..ops.paged_kv import pool_data, pool_dtype, pool_flat
 
     W = tokens.shape[0]
-    L, P = pool_k.shape[0], pool_k.shape[1]
+    L, P = pool_data(pool_k).shape[0], pool_data(pool_k).shape[1]
     x = params["embed"][tokens][None]                    # [1, W, D]
     cos, sin = rope_cos_sin(tok_pos[None], cfg.head_dim, cfg.rope_theta)
-    pool_k_flat = pool_k.reshape((L * P,) + pool_k.shape[2:])
-    pool_v_flat = pool_v.reshape((L * P,) + pool_v.shape[2:])
+    pool_k_flat = pool_flat(pool_k)
+    pool_v_flat = pool_flat(pool_v)
+    kdt, vdt = pool_dtype(pool_k), pool_dtype(pool_v)
     tables = row_tables.astype(jnp.int32)
     starts = starts.astype(jnp.int32)
     lens = lens.astype(jnp.int32)
@@ -325,11 +340,14 @@ def forward_ragged_prefill(
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
                            cfg.head_dim, cos, sin)
-        # suffix K/V cast to the pool dtype BEFORE attention (matching
-        # forward_prefix_pages): what this wave attends is bit-identical
-        # to what later waves/decodes read back from the pages
-        ks = k[0].astype(pool_k.dtype)
-        vs = v[0].astype(pool_v.dtype)
+        # suffix K/V cast to the pool's LOGICAL dtype BEFORE attention
+        # (matching forward_prefix_pages): what this wave attends is
+        # bit-identical to what later waves/decodes read back from the
+        # pages — under int8 pools the cast targets the dequant dtype
+        # and the residual quantization error is bounded by the parity
+        # suite instead (tests/test_kv_quant.py)
+        ks = k[0].astype(kdt)
+        vs = v[0].astype(vdt)
         attn = ragged_prefill_dispatch(
             q[0], ks, vs, pool_k_flat, pool_v_flat, tables + l * P,
             starts, lens, plens, tok_row, window=cfg.sliding_window)

@@ -303,23 +303,32 @@ def forward_prefix_pages(
         raise ValueError(f"{cfg.name!r} is dense; use models.llama")
     from ..ops.layers import gqa_attention_prefix
 
+    from ..ops.paged_kv import (_dequantize_pages, is_quantized, pool_data,
+                                pool_flat)
+
     Bp, T = tokens.shape
-    L, P = pool_k.shape[0], pool_k.shape[1]
-    ps = pool_k.shape[2]
+    quant = is_quantized(pool_k)
+    L, P = pool_data(pool_k).shape[0], pool_data(pool_k).shape[1]
+    ps = pool_data(pool_k).shape[2]
     Pt = prefix_table.shape[1] * ps
     x = params["embed"][tokens]
     positions = prefix_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
-    pool_k_flat = pool_k.reshape((L * P,) + pool_k.shape[2:])
-    pool_v_flat = pool_v.reshape((L * P,) + pool_v.shape[2:])
+    pool_k_flat = pool_flat(pool_k)
+    pool_v_flat = pool_flat(pool_v)
+
+    def _gather_pages(flat, idx):
+        if quant:
+            return _dequantize_pages(flat.data[idx], flat.scale[idx]
+                                     ).reshape(Bp, Pt, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        return flat[idx].reshape(Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
 
     def layer_step(x, scanned):
         lp, l = scanned
-        kp = pool_k_flat[l * P + prefix_table].reshape(
-            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
-        vp = pool_v_flat[l * P + prefix_table].reshape(
-            Bp, Pt, cfg.n_kv_heads, cfg.head_dim)
+        kp = _gather_pages(pool_k_flat, l * P + prefix_table)
+        vp = _gather_pages(pool_v_flat, l * P + prefix_table)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = qkv_proj(h, lp, cfg.n_heads, cfg.n_kv_heads,
                            cfg.head_dim, cos, sin)
@@ -483,9 +492,12 @@ def init_paged_cache(
     max_seq: int,
     num_pages: int,
     page_size: int,
-    dtype: jnp.dtype = jnp.bfloat16,
+    dtype: Optional[jnp.dtype] = None,
 ):
-    """Block-paged KV pool; see ``llama.init_paged_cache``."""
+    """Block-paged KV pool; see ``llama.init_paged_cache``.
+
+    ``dtype=None`` resolves from ``SWARMDB_KV_DTYPE`` (int8 → quantized
+    ``QuantPool``)."""
     from ..ops.paged_kv import init_paged_kv_cache
 
     return init_paged_kv_cache(

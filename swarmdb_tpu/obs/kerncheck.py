@@ -68,7 +68,8 @@ import numpy as np
 logger = logging.getLogger("swarmdb_tpu.obs")
 
 __all__ = ["enabled", "registry", "KernCheckRegistry", "ShadowRef",
-           "CANARY", "shadow_ragged_prefill", "shadow_paged_decode",
+           "CANARY", "parity_tol",
+           "shadow_ragged_prefill", "shadow_paged_decode",
            "shadow_paged_write_ragged", "check_wave_descriptors",
            "differential_ragged_prefill", "differential_paged_decode",
            "checked_ragged_prefill_dispatch",
@@ -84,6 +85,37 @@ CANARY = -16384.0
 # the dispatched path (kernel or dense reference): both accumulate in
 # fp32 but tile reductions differently; bf16 outputs round to ~1e-2
 _PARITY_TOL = 2e-2
+
+# int8 pools: the shadow dequantizes at the boundary while the kernel
+# dequantizes per-tile (same values, different mult order), and every
+# scale product rounds through bf16 once more — a hair looser
+_PARITY_TOL_INT8 = 6e-2
+
+
+def parity_tol(dtype_name: Optional[str] = None) -> float:
+    """Shadow-vs-dispatch tolerance for the ACTIVE pool dtype
+    (``SWARMDB_KV_DTYPE``); pass ``dtype_name`` to override."""
+    if dtype_name is None:
+        from ..ops.paged_kv import kv_dtype_name
+
+        try:
+            dtype_name = kv_dtype_name()
+        except ValueError:
+            dtype_name = "bf16"
+    return _PARITY_TOL_INT8 if dtype_name == "int8" else _PARITY_TOL
+
+
+def _dequant_pools(k_pages, v_pages):
+    """QuantPool -> plain f32 pools (identity on plain arrays): the
+    shadow interpreter runs the full-precision kernel on boundary-
+    dequantized pages — the same values the quant kernel produces
+    in-tile, so parity still binds the dispatched path."""
+    from ..ops.paged_kv import _dequantize_pages, is_quantized
+
+    if is_quantized(k_pages):
+        k_pages = _dequantize_pages(k_pages.data, k_pages.scale)
+        v_pages = _dequantize_pages(v_pages.data, v_pages.scale)
+    return k_pages, v_pages
 
 
 def enabled() -> bool:
@@ -722,23 +754,40 @@ def _random_ragged_case(rng: np.random.Generator):
 
 
 def differential_ragged_prefill(seed: int = 0, rounds: int = 4,
-                                tol: float = _PARITY_TOL) -> int:
+                                tol: float = _PARITY_TOL,
+                                quantized: bool = False) -> int:
     """Randomized kernel-vs-dense-reference parity over ragged
     descriptor soups; a mismatch on any live token is a ``parity``
-    violation. Returns the number of mismatching rounds."""
-    from ..ops.attention_pallas import ragged_paged_prefill_attention
+    violation. Returns the number of mismatching rounds.
+    ``quantized=True`` int8-quantizes the random pools and pits the
+    quant kernel (in-tile dequant) against the quantized XLA reference
+    — the two dequantize identically, so the plain tolerance holds."""
+    from ..ops.attention_pallas import (
+        ragged_paged_prefill_attention,
+        ragged_paged_prefill_attention_quant)
     from ..ops.layers import ragged_prefill_attention_reference
+    from ..ops.paged_kv import QuantPool, _quantize_pages
 
     rng = np.random.default_rng(seed)
     bad = 0
     for i in range(rounds):
         (q, sk, sv, kp, vp, tables, starts, lens, plens,
          tok_row) = _random_ragged_case(rng)
-        registry().note_check("differential.ragged-prefill")
-        got = np.asarray(ragged_paged_prefill_attention(
-            q, sk, sv, kp, vp, tables, starts, lens, plens,
-            interpret=True))
         import jax.numpy as jnp
+
+        if quantized:
+            registry().note_check("differential.ragged-prefill.int8")
+            kq, ks = _quantize_pages(kp)
+            vq, vs = _quantize_pages(vp)
+            got = np.asarray(ragged_paged_prefill_attention_quant(
+                q, sk, sv, kq, ks, vq, vs, tables, starts, lens, plens,
+                interpret=True))
+            kp, vp = QuantPool(kq, ks), QuantPool(vq, vs)
+        else:
+            registry().note_check("differential.ragged-prefill")
+            got = np.asarray(ragged_paged_prefill_attention(
+                q, sk, sv, kp, vp, tables, starts, lens, plens,
+                interpret=True))
 
         want = np.asarray(ragged_prefill_attention_reference(
             q, sk, sv, kp, vp, tables, starts, lens, plens,
@@ -758,14 +807,19 @@ def differential_ragged_prefill(seed: int = 0, rounds: int = 4,
 
 
 def differential_paged_decode(seed: int = 0, rounds: int = 4,
-                              tol: float = _PARITY_TOL) -> int:
+                              tol: float = _PARITY_TOL,
+                              quantized: bool = False) -> int:
     """Randomized parity of the paged decode kernel against the XLA
-    page-gather path (mixed lengths incl. empty slots)."""
+    page-gather path (mixed lengths incl. empty slots);
+    ``quantized=True`` runs the int8 kernel against the quantized
+    gather path."""
     import jax.numpy as jnp
 
-    from ..ops.attention_pallas import paged_decode_gqa_attention
+    from ..ops.attention_pallas import (paged_decode_gqa_attention,
+                                        paged_decode_gqa_attention_quant)
     from ..ops.layers import gqa_attention
-    from ..ops.paged_kv import paged_gather_kv
+    from ..ops.paged_kv import (QuantPool, _quantize_pages,
+                                paged_gather_kv)
 
     rng = np.random.default_rng(seed)
     bad = 0
@@ -785,10 +839,19 @@ def differential_paged_decode(seed: int = 0, rounds: int = 4,
                          jnp.float32)
         vp = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)),
                          jnp.float32)
-        registry().note_check("differential.paged-decode")
-        got = np.asarray(paged_decode_gqa_attention(
-            q, kp, vp, jnp.asarray(table), jnp.asarray(lengths),
-            interpret=True))
+        if quantized:
+            registry().note_check("differential.paged-decode.int8")
+            kq, ks = _quantize_pages(kp)
+            vq, vs = _quantize_pages(vp)
+            got = np.asarray(paged_decode_gqa_attention_quant(
+                q, kq, ks, vq, vs, jnp.asarray(table),
+                jnp.asarray(lengths), interpret=True))
+            kp, vp = QuantPool(kq, ks), QuantPool(vq, vs)
+        else:
+            registry().note_check("differential.paged-decode")
+            got = np.asarray(paged_decode_gqa_attention(
+                q, kp, vp, jnp.asarray(table), jnp.asarray(lengths),
+                interpret=True))
         kg, vg = paged_gather_kv(kp, vp, jnp.asarray(table))
         want = np.asarray(gqa_attention(
             q[:, None], kg, vg,
@@ -823,19 +886,22 @@ def checked_ragged_prefill_dispatch(fn: Callable) -> Callable:
     @functools.wraps(fn)
     def wrapper(q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts,
                 lens, prefix_lens, tok_row, *, window=None):
+        from ..ops.paged_kv import pool_data
+
         out = fn(q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts,
                  lens, prefix_lens, tok_row, window=window)
-        if (_any_tracer(q, k_pages, row_tables)
+        if (_any_tracer(q, pool_data(k_pages), row_tables)
                 or q.shape[0] > _max_shadow_width()):
             return out
         try:
             registry().note_check("shadow.ragged-prefill")
+            kp, vp = _dequant_pools(k_pages, v_pages)
             shadow = shadow_ragged_prefill(
-                q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts,
+                q, sfx_k, sfx_v, kp, vp, row_tables, starts,
                 lens, prefix_lens, window=window)
             _parity("ragged_paged_prefill_attention", shadow,
                     np.asarray(out), np.asarray(starts),
-                    np.asarray(lens))
+                    np.asarray(lens), tol=parity_tol())
         except Exception:
             logger.exception("kerncheck ragged-prefill shadow failed")
         return out
@@ -852,21 +918,25 @@ def checked_paged_attention_dispatch(fn: Callable) -> Callable:
     @functools.wraps(fn)
     def wrapper(q, k_pages, v_pages, page_table, q_positions, *,
                 window=None):
+        from ..ops.paged_kv import pool_data
+
         out = fn(q, k_pages, v_pages, page_table, q_positions,
                  window=window)
-        if (_any_tracer(q, k_pages, page_table)
+        if (_any_tracer(q, pool_data(k_pages), page_table)
                 or q.shape[0] > _max_shadow_width()):
             return out
         try:
             registry().note_check("shadow.paged-decode")
             lengths = (np.asarray(q_positions)[:, 0] + 1).astype(np.int32)
+            kp, vp = _dequant_pools(k_pages, v_pages)
             shadow = shadow_paged_decode(
-                np.asarray(q)[:, 0], k_pages, v_pages, page_table,
+                np.asarray(q)[:, 0], kp, vp, page_table,
                 lengths, window=window)
             B = shadow.shape[0]
             _parity("paged_decode_gqa_attention", shadow,
                     np.asarray(out)[:, 0],
-                    np.arange(B, dtype=np.int32), np.ones(B, np.int32))
+                    np.arange(B, dtype=np.int32), np.ones(B, np.int32),
+                    tol=parity_tol())
         except Exception:
             logger.exception("kerncheck paged-decode shadow failed")
         return out
@@ -883,19 +953,25 @@ def checked_paged_write_ragged(fn: Callable) -> Callable:
     @functools.wraps(fn)
     def wrapper(k_pages, v_pages, sfx_k, sfx_v, tok_row, tok_pos,
                 row_tables):
+        from ..ops.paged_kv import is_quantized, pool_data
+
         out = fn(k_pages, v_pages, sfx_k, sfx_v, tok_row, tok_pos,
                  row_tables)
-        if _any_tracer(k_pages, sfx_k, tok_row, row_tables):
+        if _any_tracer(pool_data(k_pages), sfx_k, tok_row, row_tables):
             return out
         try:
             registry().note_check("shadow.paged-write-ragged")
             n = check_wave_descriptors(
                 tok_row, tok_pos, row_tables,
-                np.asarray(k_pages).shape[1],
-                np.asarray(k_pages).shape[2])
+                pool_data(k_pages).shape[1],
+                pool_data(k_pages).shape[2])
             if n == 0:
-                _replay_write_parity(k_pages, sfx_k, tok_row, tok_pos,
-                                     row_tables, out[0])
+                if is_quantized(k_pages):
+                    _replay_write_parity_quant(sfx_k, tok_row, tok_pos,
+                                               row_tables, out[0])
+                else:
+                    _replay_write_parity(k_pages, sfx_k, tok_row,
+                                         tok_pos, row_tables, out[0])
         except Exception:
             logger.exception("kerncheck paged-write shadow failed")
         return out
@@ -935,6 +1011,44 @@ def _replay_write_parity(k_pages, sfx_k, tok_row, tok_pos, row_tables,
             f"scatter result differs from the per-token replay in "
             f"{ndiff} element(s) — positional write math diverged",
             {"ndiff": ndiff})
+
+
+def _replay_write_parity_quant(sfx_k, tok_row, tok_pos, row_tables,
+                               out_k) -> None:
+    """Positional check for the QUANTIZED ragged write: dequantize each
+    live token's landing slot from the written pool and compare to the
+    suffix value. The window requant is not bit-replayed — instead the
+    round-to-nearest bound (half a scale step per element) pins the
+    slot: a token scattered to the wrong (page, offset) misses its
+    value by far more than scale/2."""
+    tok_row = np.asarray(tok_row)
+    tok_pos = np.asarray(tok_pos)
+    tables = np.asarray(row_tables)
+    R, maxp = tables.shape
+    data = np.asarray(out_k.data)           # [L, P, ps, Hkv, D] int8
+    scale = np.asarray(out_k.scale, np.float32)  # [L, P, Hkv]
+    ps = data.shape[2]
+    sk = np.asarray(sfx_k, np.float32)      # [L, W, Hkv, D]
+    worst = 0.0
+    for t in range(tok_row.shape[0]):
+        if not (0 <= tok_row[t] < R and 0 <= tok_pos[t] < maxp * ps):
+            continue                        # dead token -> trash page
+        page = int(tables[int(tok_row[t]), int(tok_pos[t]) // ps])
+        off = int(tok_pos[t]) % ps
+        s = scale[:, page]                  # [L, Hkv]
+        deq = data[:, page, off].astype(np.float32) * s[..., None]
+        err = np.abs(deq - sk[:, t])
+        # per-(layer, head) budget: half a quant step + fp slack
+        over = err - (0.5 * s[..., None] + 1e-6)
+        worst = max(worst, float(np.max(over)))
+    if worst > 0.0:
+        registry().record(
+            "parity", "paged_write_ragged",
+            f"quantized scatter: a live token's dequantized slot "
+            f"misses its suffix value by {worst:.3e} beyond the "
+            f"half-step rounding budget — positional write math or "
+            f"scale bookkeeping diverged",
+            {"max_over": worst})
 
 
 def _parity(kernel: str, shadow: np.ndarray, dispatched: np.ndarray,

@@ -149,15 +149,24 @@ def _online_update(h, s, v, acc_ref, m_ref, l_ref):
 
 
 def _attend_tile(q_ref, k_tile_ref, v_tile_ref, valid, n_kv_heads,
-                 acc_ref, m_ref, l_ref):
+                 acc_ref, m_ref, l_ref, k_scale=None, v_scale=None):
     """One [Tk]-token KV tile against every head's query: per-kv-head MXU
     dots (a batched einsum won't lower in Mosaic) folded into the online
-    softmax scratch. ``valid`` is the [1, Tk] position mask."""
+    softmax scratch. ``valid`` is the [1, Tk] position mask.
+
+    ``k_scale``/``v_scale`` ([Hkv] f32, or None) are the quantized-pool
+    page scales: int8 tiles are dequantized HERE, in VMEM, after the
+    page's one HBM read — the roofline sees half the bytes and the MXU
+    still runs the f32 math (SWARMDB_KV_DTYPE=int8, ISSUE 18)."""
     Hq, D = q_ref.shape[1], q_ref.shape[2]
     G = Hq // n_kv_heads
     q = q_ref[0].reshape(n_kv_heads, G, D).astype(jnp.float32)
     k = k_tile_ref[0].astype(jnp.float32)              # [Tk, Hkv, D]
     v = v_tile_ref[0].astype(jnp.float32)
+    if k_scale is not None:
+        k = k * k_scale.reshape(1, n_kv_heads, 1)
+    if v_scale is not None:
+        v = v * v_scale.reshape(1, n_kv_heads, 1)
     scale = 1.0 / (D ** 0.5)
     for h in range(n_kv_heads):
         s = jax.lax.dot_general(
@@ -668,3 +677,390 @@ def paged_decode_gqa_attention(
         interpret=interpret,
     )(table, lengths, q, k_pages, v_pages)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Quantized-pool kernel variants (SWARMDB_KV_DTYPE=int8, ISSUE 18).
+#
+# Same grids, same online softmax, same DMA-skip index maps as the three
+# kernels above — the ONLY difference is the KV operands: int8 page
+# payloads plus a per-page-per-head f32 scale operand shaped [P, 1, Hkv]
+# (block (1, 1, Hkv), whole in its last two dims — Mosaic-legal — and
+# indexed by the SAME page map as the payload, so a page's scale row
+# rides the page's DMA step). Dequantization happens inside
+# `_attend_tile` in VMEM: HBM sees half the bytes, the MXU still runs
+# f32. Suffix streams and in-chunk buffers stay full precision — only
+# what lives in the POOL is quantized.
+
+
+def _paged_attn_kernel_quant(table_ref, len_ref, q_ref, k_ref, ks_ref,
+                             v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                             *, page_size: int, n_kv_heads: int, window):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    maxp = pl.num_programs(1)
+    length = len_ref[b]
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = n_kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * page_size < length)
+    def _compute():
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < length
+        if window is not None:
+            valid &= pos > (length - 1 - window)
+        _attend_tile(q_ref, k_ref, v_ref, valid, Hkv, acc_ref, m_ref,
+                     l_ref, k_scale=ks_ref[...], v_scale=vs_ref[...])
+
+    @pl.when(j == maxp - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).reshape(Hq, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def paged_decode_gqa_attention_quant(
+    q: jnp.ndarray,           # [B, Hq, D] one decode query per slot
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] int8 single-layer pool
+    k_scale: jnp.ndarray,     # [P, Hkv] f32 per-page-per-head scales
+    v_pages: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp] int32
+    lengths: jnp.ndarray,     # [B] int32 valid prefix (q position + 1)
+    window=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Quantized ragged paged decode attention; returns [B, Hq, D]."""
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    G = Hq // Hkv
+    table = page_table.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+    ks3 = k_scale.reshape(P, 1, Hkv)
+    vs3 = v_scale.reshape(P, 1, Hkv)
+
+    def q_map(b, j, table_ref, len_ref):
+        return (b, 0, 0)
+
+    def kv_map(b, j, table_ref, len_ref):
+        last_live = _last_live_page(len_ref[b], ps)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, 0, 0)
+
+    def sc_map(b, j, table_ref, len_ref):
+        last_live = _last_live_page(len_ref[b], ps)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, 0)
+
+    def o_map(b, j, table_ref, len_ref):
+        return (b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, maxp),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), q_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, 1, Hkv), sc_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, 1, Hkv), sc_map),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running max (bcast)
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running denom (bcast)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel_quant, page_size=ps,
+                          n_kv_heads=Hkv, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(table, lengths, q, k_pages, ks3, v_pages, vs3)
+    return out
+
+
+def _paged_chunk_attn_kernel_quant(table_ref, start_ref, step_ref, q_ref,
+                                   k_ref, ks_ref, v_ref, vs_ref, ck_ref,
+                                   cv_ref, o_ref, acc_ref, m_ref, l_ref,
+                                   *, page_size: int, n_kv_heads: int,
+                                   window):
+    """Quantized two-segment decode: int8 pages dequantize per tile, the
+    in-chunk buffer (never pool-resident) stays full precision."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    maxp = pl.num_programs(1) - 1
+    start = start_ref[b]
+    step = step_ref[0]
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    Hkv = n_kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when((j < maxp) & (j * page_size < start))
+    def _pages():
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < start
+        if window is not None:
+            valid &= pos > (start + step - window)
+        _attend_tile(q_ref, k_ref, v_ref, valid, Hkv, acc_ref, m_ref,
+                     l_ref, k_scale=ks_ref[...], v_scale=vs_ref[...])
+
+    @pl.when(j == maxp)
+    def _chunk():
+        Kc = ck_ref.shape[1]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, Kc), 1)
+        valid = idx <= step
+        if window is not None:
+            valid &= (start + idx) > (start + step - window)
+        _attend_tile(q_ref, ck_ref, cv_ref, valid, Hkv, acc_ref, m_ref,
+                     l_ref)
+
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).reshape(Hq, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def paged_decode_gqa_attention_chunked_quant(
+    q: jnp.ndarray,           # [B, Hq, D]
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] int8 FROZEN pool
+    k_scale: jnp.ndarray,     # [P, Hkv] f32
+    v_pages: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, maxp] int32
+    chunk_k: jnp.ndarray,     # [B, Kc, Hkv, D] full-precision chunk buffer
+    chunk_v: jnp.ndarray,
+    starts: jnp.ndarray,      # [B] int32 frozen prefix length
+    step: jnp.ndarray,        # scalar int32 step within the chunk
+    window=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Quantized two-segment ragged paged decode; returns [B, Hq, D]."""
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    G = Hq // Hkv
+    table = page_table.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    step_arr = jnp.reshape(step, (1,)).astype(jnp.int32)
+    ks3 = k_scale.reshape(P, 1, Hkv)
+    vs3 = v_scale.reshape(P, 1, Hkv)
+
+    def q_map(b, j, table_ref, start_ref, step_ref):
+        return (b, 0, 0)
+
+    def kv_map(b, j, table_ref, start_ref, step_ref):
+        last_live = _last_live_page(start_ref[b], ps)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, 0, 0)
+
+    def sc_map(b, j, table_ref, start_ref, step_ref):
+        last_live = _last_live_page(start_ref[b], ps)
+        return (table_ref[b, jnp.minimum(j, last_live)], 0, 0)
+
+    def chunk_map(b, j, table_ref, start_ref, step_ref):
+        return (b, 0, 0, 0)
+
+    def o_map(b, j, table_ref, start_ref, step_ref):
+        return (b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, maxp + 1),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), q_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, 1, Hkv), sc_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, 1, Hkv), sc_map),
+            pl.BlockSpec((1, chunk_k.shape[1], Hkv, D), chunk_map),
+            pl.BlockSpec((1, chunk_k.shape[1], Hkv, D), chunk_map),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running max (bcast)
+            pltpu.VMEM((Hkv, G, 128), jnp.float32),  # running denom (bcast)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_chunk_attn_kernel_quant, page_size=ps,
+                          n_kv_heads=Hkv, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(table, starts, step_arr, q, k_pages, ks3, v_pages, vs3,
+      chunk_k, chunk_v)
+    return out
+
+
+def _ragged_prefill_kernel_quant(table_ref, starts_ref, lens_ref,
+                                 plens_ref, q_ref, sk_ref, sv_ref, kp_ref,
+                                 kps_ref, vp_ref, vps_ref, o_ref, acc_ref,
+                                 m_ref, l_ref, *, page_size: int,
+                                 n_kv_heads: int, n_pages: int, tile: int,
+                                 window):
+    """Quantized ragged prefill: int8 PREFIX pages dequantize per page
+    tile; the packed suffix stream (this wave's own K/V, not yet
+    pool-resident) stays full precision."""
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    n_steps = pl.num_programs(1)
+    W, Hq, D = q_ref.shape
+    Hkv = n_kv_heads
+    G = Hq // Hkv
+    ps = page_size
+    start = starts_ref[r]
+    ln = lens_ref[r]
+    plen = plens_ref[r]
+    scale = 1.0 / (D ** 0.5)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when((r == 0) & (j == 0))
+    def _zero_out():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    wq = jax.lax.div(
+        jax.lax.broadcasted_iota(jnp.int32, (W * G, 1), 0), jnp.int32(G))
+    q_abs = plen + wq - start
+
+    def fold(k_tile, v_tile, valid):
+        q = q_ref[...].reshape(W, Hkv, G, D).astype(jnp.float32)
+        k = k_tile.astype(jnp.float32)
+        v = v_tile.astype(jnp.float32)
+        for h in range(Hkv):
+            qh = q[:, h].reshape(W * G, D)
+            s = jax.lax.dot_general(
+                qh, k[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            _online_update(h, jnp.where(valid, s, -1e30), v[:, h, :],
+                           acc_ref, m_ref, l_ref)
+
+    @pl.when((j < n_pages) & (j * ps < plen))
+    def _prefix():
+        kpos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        valid = kpos < plen
+        if window is not None:
+            valid &= kpos > (q_abs - window)
+        kd = kp_ref[0].astype(jnp.float32) * kps_ref[...].reshape(1, Hkv, 1)
+        vd = vp_ref[0].astype(jnp.float32) * vps_ref[...].reshape(1, Hkv, 1)
+        fold(kd, vd, jnp.broadcast_to(valid, (W * G, ps)))
+
+    @pl.when((j >= n_pages) & (ln > 0))
+    def _suffix():
+        t = j - n_pages
+        first = jax.lax.div(start, jnp.int32(tile))
+        last = jax.lax.div(start + ln - 1, jnp.int32(tile))
+        tt = first + t
+
+        @pl.when(tt <= last)
+        def _live():
+            s0 = jnp.minimum(tt * tile, jnp.int32(W - tile))
+            x = s0 + jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+            valid = ((x >= tt * tile) & (x >= start) & (x < start + ln)
+                     & (x <= wq))
+            if window is not None:
+                valid &= x > (wq - window)
+            fold(sk_ref[pl.ds(s0, tile)], sv_ref[pl.ds(s0, tile)], valid)
+
+    @pl.when(j == n_steps - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
+        out = (acc_ref[...] / denom).reshape(Hkv, W, G, D)
+        out = out.transpose(1, 0, 2, 3).reshape(W, Hq, D)
+        w_iota = jax.lax.broadcasted_iota(jnp.int32, (W, 1, 1), 0)
+        mine = (w_iota >= start) & (w_iota < start + ln)
+        o_ref[...] = jnp.where(mine, out.astype(o_ref.dtype), o_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("window", "tile", "interpret"))
+def ragged_paged_prefill_attention_quant(
+    q: jnp.ndarray,           # [W, Hq, D] packed query stream
+    sfx_k: jnp.ndarray,       # [W, Hkv, D] packed suffix K (full precision)
+    sfx_v: jnp.ndarray,
+    k_pages: jnp.ndarray,     # [P, ps, Hkv, D] int8 single-layer pool
+    k_scale: jnp.ndarray,     # [P, Hkv] f32
+    v_pages: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    row_tables: jnp.ndarray,  # [R, maxp] int32 page ids per wave row
+    starts: jnp.ndarray,      # [R] int32 — row r's offset in the stream
+    lens: jnp.ndarray,        # [R] int32 — row r's token count (0 = dead)
+    prefix_lens: jnp.ndarray,  # [R] int32 — tokens already in r's pages
+    window=None,
+    tile: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Quantized ragged paged prefill attention; returns [W, Hq, D]."""
+    W, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    R, maxp = row_tables.shape
+    G = Hq // Hkv
+    Tk = min(tile, W)
+    n_st = -(-W // Tk)
+    table = row_tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    lens = lens.astype(jnp.int32)
+    plens = prefix_lens.astype(jnp.int32)
+    ks3 = k_scale.reshape(P, 1, Hkv)
+    vs3 = v_scale.reshape(P, 1, Hkv)
+
+    def stream_map(r, j, table_ref, starts_ref, lens_ref, plens_ref):
+        return (0, 0, 0)
+
+    def kv_map(r, j, table_ref, starts_ref, lens_ref, plens_ref):
+        last_live = _last_live_page(plens_ref[r], ps)
+        return (table_ref[r, jnp.minimum(j, last_live)], 0, 0, 0)
+
+    def sc_map(r, j, table_ref, starts_ref, lens_ref, plens_ref):
+        last_live = _last_live_page(plens_ref[r], ps)
+        return (table_ref[r, jnp.minimum(j, last_live)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(R, maxp + n_st),
+        in_specs=[
+            pl.BlockSpec((W, Hq, D), stream_map),
+            pl.BlockSpec((W, Hkv, D), stream_map),
+            pl.BlockSpec((W, Hkv, D), stream_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, 1, Hkv), sc_map),
+            pl.BlockSpec((1, ps, Hkv, D), kv_map),
+            pl.BlockSpec((1, 1, Hkv), sc_map),
+        ],
+        # swarmlint: revisit[r] -- every (r, j) step accumulates into the
+        # one stream-resident output block; the masked finalize under
+        # pl.when(j == n_steps - 1) writes each row's lanes exactly once
+        out_specs=pl.BlockSpec((W, Hq, D), stream_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, W * G, D), jnp.float32),    # acc
+            pltpu.VMEM((Hkv, W * G, 128), jnp.float32),  # running max
+            pltpu.VMEM((Hkv, W * G, 128), jnp.float32),  # running denom
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_prefill_kernel_quant, page_size=ps,
+                          n_kv_heads=Hkv, n_pages=maxp, tile=Tk,
+                          window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, Hq, D), q.dtype),
+        interpret=interpret,
+    )(table, starts, lens, plens, q, sfx_k, sfx_v,
+      k_pages, ks3, v_pages, vs3)

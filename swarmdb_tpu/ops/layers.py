@@ -157,22 +157,42 @@ def paged_attention_dispatch(
     window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Decode attention over the paged pool: ragged Pallas kernel on TPU,
-    XLA page-gather fallback elsewhere. Returns [B, 1, Hq, D]."""
-    if _paged_pallas_enabled(page_table.shape[1] * k_pages.shape[1]):
+    XLA page-gather fallback elsewhere. Returns [B, 1, Hq, D].
+
+    Accepts a plain pool OR an int8 :class:`~..ops.paged_kv.QuantPool`
+    (SWARMDB_KV_DTYPE=int8): the quantized pool routes to the in-kernel
+    dequant kernel variant; the gather fallback dequantizes to a dense
+    f32 view inside ``paged_gather_kv``."""
+    from .paged_kv import is_quantized, paged_gather_kv, pool_data
+
+    kd = pool_data(k_pages)
+    if _paged_pallas_enabled(page_table.shape[1] * kd.shape[1]):
+        lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
+        interp = jax.default_backend() != "tpu"
+        if is_quantized(k_pages):
+            from .attention_pallas import paged_decode_gqa_attention_quant
+
+            _record_static_vmem(
+                "_paged_attn_kernel_quant", "kernel:pallas-int8",
+                {"Hq": q.shape[2], "Hkv": kd.shape[2],
+                 "D": q.shape[3], "ps": kd.shape[1]})
+            out = paged_decode_gqa_attention_quant(
+                q[:, 0], k_pages.data, k_pages.scale,
+                v_pages.data, v_pages.scale, page_table, lengths,
+                window=window, interpret=interp,
+            )
+            return out[:, None]
         from .attention_pallas import paged_decode_gqa_attention
 
         _record_static_vmem(
             "_paged_attn_kernel", "kernel:pallas",
-            {"Hq": q.shape[2], "Hkv": k_pages.shape[2],
-             "D": q.shape[3], "ps": k_pages.shape[1]})
-        lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
+            {"Hq": q.shape[2], "Hkv": kd.shape[2],
+             "D": q.shape[3], "ps": kd.shape[1]})
         out = paged_decode_gqa_attention(
             q[:, 0], k_pages, v_pages, page_table, lengths,
-            window=window, interpret=jax.default_backend() != "tpu",
+            window=window, interpret=interp,
         )
         return out[:, None]
-    from .paged_kv import paged_gather_kv
-
     kg, vg = paged_gather_kv(k_pages, v_pages, page_table)
     return gqa_attention(q, kg, vg, q_positions, window=window)
 
@@ -200,18 +220,31 @@ def paged_attention_dispatch_chunked(
     frozen-segment mask (kv_pos < chunk start) already expresses "pool
     holds strictly the prefix".
     """
-    if _paged_pallas_enabled(page_table.shape[1] * k_pages.shape[1]):
+    from .paged_kv import is_quantized, paged_gather_kv, pool_data
+
+    kd = pool_data(k_pages)
+    if _paged_pallas_enabled(page_table.shape[1] * kd.shape[1]):
+        starts = (q_positions[:, 0] - step).astype(jnp.int32)
+        interp = jax.default_backend() != "tpu"
+        if is_quantized(k_pages):
+            from .attention_pallas import (
+                paged_decode_gqa_attention_chunked_quant)
+
+            out = paged_decode_gqa_attention_chunked_quant(
+                q[:, 0], k_pages.data, k_pages.scale,
+                v_pages.data, v_pages.scale, page_table, chunk_k,
+                chunk_v, starts, step.astype(jnp.int32),
+                window=window, interpret=interp,
+            )
+            return out[:, None]
         from .attention_pallas import paged_decode_gqa_attention_chunked
 
-        starts = (q_positions[:, 0] - step).astype(jnp.int32)
         out = paged_decode_gqa_attention_chunked(
             q[:, 0], k_pages, v_pages, page_table, chunk_k, chunk_v,
             starts, step.astype(jnp.int32),
-            window=window, interpret=jax.default_backend() != "tpu",
+            window=window, interpret=interp,
         )
         return out[:, None]
-    from .paged_kv import paged_gather_kv
-
     kg, vg = paged_gather_kv(k_pages, v_pages, page_table)
     return gqa_attention_chunked(q, kg, vg, chunk_k, chunk_v, q_positions,
                                  step, window=window)
@@ -240,17 +273,29 @@ def ragged_prefill_attention_reference(
 
     Materializes [W, Pt] gathered prefix KV and [W, Pt + W] fp32 scores —
     the densification the Pallas kernel exists to avoid; fine for CPU
-    tests/fallback waves, wrong for silicon. Returns [W, Hq, D]."""
+    tests/fallback waves, wrong for silicon. Quantized pools dequantize
+    after the table gather (same math the quant kernel runs per tile).
+    Returns [W, Hq, D]."""
+    from .paged_kv import _dequantize_pages, is_quantized, pool_data
+
     W, Hq, D = q.shape
     Hkv = sfx_k.shape[1]
     G = Hq // Hkv
     R, maxp = row_tables.shape
-    ps = k_pages.shape[1]
+    ps = pool_data(k_pages).shape[1]
     Pt = maxp * ps
 
     row = jnp.clip(tok_row, 0, R - 1)
-    kp = k_pages[row_tables].reshape(R, Pt, Hkv, D)
-    vp = v_pages[row_tables].reshape(R, Pt, Hkv, D)
+    if is_quantized(k_pages):
+        kp = _dequantize_pages(
+            k_pages.data[row_tables],
+            k_pages.scale[row_tables]).reshape(R, Pt, Hkv, D)
+        vp = _dequantize_pages(
+            v_pages.data[row_tables],
+            v_pages.scale[row_tables]).reshape(R, Pt, Hkv, D)
+    else:
+        kp = k_pages[row_tables].reshape(R, Pt, Hkv, D)
+        vp = v_pages[row_tables].reshape(R, Pt, Hkv, D)
     kp_t = kp[row]                                       # [W, Pt, Hkv, D]
     vp_t = vp[row]
 
@@ -306,24 +351,39 @@ def ragged_prefill_dispatch(
     elsewhere. Same TPU-gated / interpreter-tested pattern as the paged
     decode dispatchers above. Returns [W, Hq, D]."""
     if _ragged_prefill_kernel_enabled():
-        from .attention_pallas import ragged_paged_prefill_attention
+        from .paged_kv import is_quantized, pool_data
 
+        quant = is_quantized(k_pages)
         W = q.shape[0]
         pad = (-W) % 8                 # TPU sublane quantum for tiny waves
         _record_static_vmem(
-            "_ragged_prefill_kernel", f"prefill.ragged[w{W}]",
+            "_ragged_prefill_kernel_quant" if quant
+            else "_ragged_prefill_kernel",
+            f"prefill.ragged[w{W}]",
             {"W": W + pad, "Hq": q.shape[1], "Hkv": sfx_k.shape[1],
-             "D": q.shape[2], "ps": k_pages.shape[1]})
+             "D": q.shape[2], "ps": pool_data(k_pages).shape[1]})
         if pad:
             grow = ((0, pad), (0, 0), (0, 0))
             q = jnp.pad(q, grow)
             sfx_k = jnp.pad(sfx_k, grow)
             sfx_v = jnp.pad(sfx_v, grow)
-        out = ragged_paged_prefill_attention(
-            q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts, lens,
-            prefix_lens, window=window,
-            interpret=jax.default_backend() != "tpu",
-        )
+        interp = jax.default_backend() != "tpu"
+        if quant:
+            from .attention_pallas import (
+                ragged_paged_prefill_attention_quant)
+
+            out = ragged_paged_prefill_attention_quant(
+                q, sfx_k, sfx_v, k_pages.data, k_pages.scale,
+                v_pages.data, v_pages.scale, row_tables, starts, lens,
+                prefix_lens, window=window, interpret=interp,
+            )
+        else:
+            from .attention_pallas import ragged_paged_prefill_attention
+
+            out = ragged_paged_prefill_attention(
+                q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts,
+                lens, prefix_lens, window=window, interpret=interp,
+            )
         return out[:W] if pad else out
     return ragged_prefill_attention_reference(
         q, sfx_k, sfx_v, k_pages, v_pages, row_tables, starts, lens,

@@ -37,7 +37,7 @@ from __future__ import annotations
 import os
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,160 @@ import numpy as np
 from ..utils.sync import make_lock
 
 PagedCache = Dict[str, jnp.ndarray]  # {"k", "v", "page_table"}
+
+
+# --------------------------------------------------- quantized KV pages
+# SWARMDB_KV_DTYPE picks the POOL storage dtype (ISSUE 18): f32 and bf16
+# store pages verbatim (bf16 = today's default, bit-identical); int8
+# stores symmetric per-page-per-head quantized pages with f32 scales
+# alongside — decode's roofline bytes halve, and the hot kernels
+# dequantize IN-KERNEL (ops/attention_pallas.py) so full-precision KV
+# never round-trips through HBM. Applies to PAGED pools only; dense slot
+# caches and the dense prefix side pool ignore the flag.
+
+KV_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8}
+
+#: logical dtype a quantized pool represents — dequantized reads and
+#: suffix-KV casts target this, matching the unquantized default
+DEQUANT_DTYPE = jnp.bfloat16
+
+#: quantized-pool range: symmetric [-127, 127], leaving int8's -128 free
+#: for the page sanitizer's canary (never produced by the quantizer)
+_QMAX = 127.0
+
+
+class QuantPool(NamedTuple):
+    """A quantized page pool: int8 payload + f32 symmetric scales.
+
+    ``data``  [..., P, ps, Hkv, D] int8 — quantized K or V pages
+    ``scale`` [..., P, Hkv]        f32  — per-page-per-head scale;
+              dequantized value = data * scale. Leading axes mirror the
+              payload's (a per-layer slice of an [L, ...] pool carries
+              its per-layer scale slice — ``lax.scan`` over the pool
+              slices both, since NamedTuples are pytrees).
+
+    Stored under the same ``{"k", "v"}`` cache keys as a plain pool, so
+    the engine's fused dispatches, donation, warmup specs, and sharded
+    cache plumbing are structure-transparent; code that touches the raw
+    arrays goes through the ``pool_*`` helpers below.
+    """
+
+    data: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def kv_dtype_name() -> str:
+    """Resolve SWARMDB_KV_DTYPE (default ``bf16`` — today's pool dtype,
+    bit-identical with the flag unset)."""
+    name = os.environ.get("SWARMDB_KV_DTYPE", "bf16").strip().lower()
+    if name in ("", "auto"):
+        return "bf16"
+    if name not in KV_DTYPES:
+        raise ValueError(
+            f"SWARMDB_KV_DTYPE={name!r}: expected one of "
+            f"{sorted(KV_DTYPES)}")
+    return name
+
+
+def kv_quantized(name: Optional[str] = None) -> bool:
+    return (name or kv_dtype_name()) == "int8"
+
+
+def is_quantized(pool: Any) -> bool:
+    return isinstance(pool, QuantPool)
+
+
+def pool_data(pool: Any) -> jnp.ndarray:
+    """Raw storage array of a pool (int8 payload for quantized pools)."""
+    return pool.data if isinstance(pool, QuantPool) else pool
+
+
+def pool_dtype(pool: Any) -> jnp.dtype:
+    """LOGICAL dtype of a pool — what reads dequantize to, and what
+    suffix K/V should be cast to before attending (the write-what-you-
+    attend contract of forward_ragged_prefill)."""
+    return DEQUANT_DTYPE if isinstance(pool, QuantPool) else pool.dtype
+
+
+def pool_layer(pool: Any, l: int) -> Any:
+    """Layer ``l``'s slice of an [L, ...] pool. NOTE: plain ``pool[l]``
+    on a :class:`QuantPool` is NamedTuple FIELD indexing (returns the
+    payload array), not a layer slice — always go through here (inside
+    ``lax.scan`` the pytree leaves are sliced per layer automatically,
+    so scanned model code needs no change)."""
+    if isinstance(pool, QuantPool):
+        return QuantPool(pool.data[l], pool.scale[l])
+    return pool[l]
+
+
+def pool_flat(pool: Any) -> Any:
+    """Flatten the leading (L, P) axes to one L*P page axis — the view
+    the ragged/prefix forwards address with per-layer table offsets. A
+    reshape on both payload and scales, never a copy."""
+    if isinstance(pool, QuantPool):
+        d, s = pool.data, pool.scale
+        return QuantPool(d.reshape((-1,) + d.shape[2:]),
+                         s.reshape((-1,) + s.shape[2:]))
+    return pool.reshape((-1,) + pool.shape[2:])
+
+
+def pool_page_bytes(pool: Any) -> int:
+    """HBM bytes ONE page id of this pool occupies ACROSS layers, scale
+    rows included — prices swarmmem's warm-tier H2D model (a page's
+    admission moves its slot in every layer). Accepts [L, P, ...] or
+    single-layer [P, ...] pools; the divisor is always the page axis."""
+    if isinstance(pool, QuantPool):
+        pages = int(pool.data.shape[-4])
+        return (pool.data.nbytes + pool.scale.nbytes) // max(1, pages)
+    pages = int(pool.shape[-4])
+    return pool.nbytes // max(1, pages)
+
+
+def _quantize_pages(vals: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-page-per-head quantization of full pages.
+
+    ``vals`` [..., ps, Hkv, D] (any float dtype) -> (int8 [..., ps, Hkv,
+    D], f32 scale [..., Hkv]). scale = amax(|page|, over token-slot and
+    D) / 127; all-zero pages get a harmless positive scale (payload is
+    zero either way, so dequantization is exact).
+    """
+    v = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v), axis=(-3, -1))            # [..., Hkv]
+    scale = jnp.maximum(amax, 1e-30) / _QMAX
+    q = jnp.clip(jnp.round(v / scale[..., None, :, None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def _dequantize_pages(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """f32 view of quantized pages: data [..., ps, Hkv, D] * scale
+    [..., Hkv] (broadcast per head)."""
+    return q.astype(jnp.float32) * scale[..., None, :, None]
+
+
+def _requant_window(old_q: jnp.ndarray, old_s: jnp.ndarray,
+                    new_v: jnp.ndarray, is_new: jnp.ndarray,
+                    is_keep: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared requantization core for INCREMENTAL page writes.
+
+    Whole-page writes quantize fresh values exactly; appends into a
+    partially-filled page instead gather the touched pages, dequantize
+    the SURVIVORS (``is_keep`` — slots before the write window), zero
+    the stale slots (freed-page garbage / canaries must not poison the
+    new amax), splice in the new tokens (``is_new``), and requantize the
+    whole page. Requantizing an unchanged full page is idempotent (its
+    amax slot re-rounds to +-127 exactly); when a new token raises the
+    page amax, survivors re-round under the larger scale — a bounded,
+    tolerance-tested error documented in README's quantization notes.
+
+    ``old_q`` [..., ps, Hkv, D] int8, ``old_s`` [..., Hkv] f32,
+    ``new_v`` broadcastable to [..., ps, Hkv, D] (float), ``is_new`` /
+    ``is_keep`` [..., ps] bool. Returns the requantized (payload, scale).
+    """
+    old_f = _dequantize_pages(old_q, old_s)
+    vals = jnp.where(is_new[..., None, None], new_v.astype(jnp.float32),
+                     jnp.where(is_keep[..., None, None], old_f, 0.0))
+    return _quantize_pages(vals)
 
 
 def pagecheck_enabled() -> bool:
@@ -87,34 +241,79 @@ def make_sharded_page_allocator(pages_per_shard: int, n_shards: int,
 #: forward pass at sane scales
 CANARY_VALUE = -16384.0
 
+#: int8 pools can't hold -16384: their canary is -128, the one int8 code
+#: point the quantizer never emits (payload is clipped to [-127, 127])
+INT8_CANARY_VALUE = -128
 
-def canary_fill(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+#: canary for a quantized pool's SCALE slots — real scales are strictly
+#: positive by construction, so a write-after-free that recomputes a
+#: page's scale always trips this even if the int8 payload collides
+SCALE_CANARY_VALUE = -1.0
+
+
+def canary_for(dtype: Any) -> float:
+    """Dtype-derived canary value: the float canary where it's exactly
+    representable, int8's reserved -128 code point on quantized pools."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        return float(INT8_CANARY_VALUE)
+    return CANARY_VALUE
+
+
+def canary_fill(k_pages: Any, v_pages: Any,
                 page_ids: Sequence[int],
-                value: float = CANARY_VALUE
-                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                value: Optional[float] = None) -> Tuple[Any, Any]:
     """Poison freed pages' device K/V with the canary (sanitizer-only
     path — an eager scatter per reclaim batch; the flag-off path never
-    calls this)."""
+    calls this). Quantized pools get BOTH slots poisoned: -128 in the
+    int8 payload and -1.0 in the scale row."""
     ids = jnp.asarray(np.asarray(page_ids, np.int32))
-    k_pages = k_pages.at[:, ids].set(value)
-    v_pages = v_pages.at[:, ids].set(value)
+    if isinstance(k_pages, QuantPool):
+        dv = int(value) if value is not None else INT8_CANARY_VALUE
+        k_pages = QuantPool(
+            k_pages.data.at[:, ids].set(jnp.int8(dv)),
+            k_pages.scale.at[:, ids].set(SCALE_CANARY_VALUE))
+        v_pages = QuantPool(
+            v_pages.data.at[:, ids].set(jnp.int8(dv)),
+            v_pages.scale.at[:, ids].set(SCALE_CANARY_VALUE))
+        return k_pages, v_pages
+    fv = value if value is not None else canary_for(k_pages.dtype)
+    k_pages = k_pages.at[:, ids].set(fv)
+    v_pages = v_pages.at[:, ids].set(fv)
     return k_pages, v_pages
 
 
-def canary_check(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+def canary_check(k_pages: Any, v_pages: Any,
                  page_ids: Sequence[int],
-                 value: float = CANARY_VALUE) -> List[int]:
+                 value: Optional[float] = None) -> List[int]:
     """Page ids whose canary was OVERWRITTEN between free and
     re-allocation (a write-after-free landed in the pool). One host
-    sync per verified allocation — sanitizer-only path."""
+    sync per verified allocation — sanitizer-only path. Quantized pools
+    verify payload AND scale slots (a crime that rewrites either is
+    caught)."""
     ids = np.asarray(page_ids, np.int32)
     if ids.size == 0:
         return []
+    quant = isinstance(k_pages, QuantPool)
+    if quant:
+        dv = int(value) if value is not None else INT8_CANARY_VALUE
+        kc = np.asarray(jax.device_get(k_pages.data[:, ids]))
+        vc = np.asarray(jax.device_get(v_pages.data[:, ids]))
+        ks = np.asarray(jax.device_get(k_pages.scale[:, ids]))
+        vs = np.asarray(jax.device_get(v_pages.scale[:, ids]))
+        bad: List[int] = []
+        for i, p in enumerate(ids):
+            ok = (np.all(kc[:, i] == dv) and np.all(vc[:, i] == dv)
+                  and np.all(ks[:, i] == SCALE_CANARY_VALUE)
+                  and np.all(vs[:, i] == SCALE_CANARY_VALUE))
+            if not ok:
+                bad.append(int(p))
+        return bad
+    fv = value if value is not None else canary_for(k_pages.dtype)
     kc = np.asarray(jax.device_get(k_pages[:, ids]))
     vc = np.asarray(jax.device_get(v_pages[:, ids]))
-    bad: List[int] = []
+    bad = []
     for i, p in enumerate(ids):
-        if not (np.all(kc[:, i] == value) and np.all(vc[:, i] == value)):
+        if not (np.all(kc[:, i] == fv) and np.all(vc[:, i] == fv)):
             bad.append(int(p))
     return bad
 
@@ -131,11 +330,33 @@ def init_paged_kv_cache(
     head_dim: int,
     batch: int,
     max_seq: int,
-    dtype: jnp.dtype = jnp.bfloat16,
+    dtype: Optional[jnp.dtype] = None,
 ) -> PagedCache:
     """Zeroed page pool + all-trash page table. ``num_pages`` INCLUDES the
-    reserved trash page 0."""
+    reserved trash page 0.
+
+    ``dtype=None`` (the service default) resolves SWARMDB_KV_DTYPE:
+    f32/bf16 give plain pools of that dtype, int8 gives :class:`QuantPool`
+    entries under the same ``{"k", "v"}`` keys (int8 payload + zeroed f32
+    scale rows — zero payload x any scale dequantizes to zero, matching
+    the unquantized zero-init)."""
+    if dtype is None:
+        dtype = KV_DTYPES[kv_dtype_name()]
     shape = (n_layers, num_pages, page_size, n_kv_heads, head_dim)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        def _qpool() -> QuantPool:
+            return QuantPool(
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros((n_layers, num_pages, n_kv_heads), jnp.float32))
+
+        return {
+            "k": _qpool(),
+            "v": _qpool(),
+            "page_table": jnp.zeros(
+                (batch, pages_per_slot(max_seq, page_size)), jnp.int32
+            ),
+            "pos0": jnp.zeros((batch,), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
@@ -166,13 +387,27 @@ def paged_write_decode(
     writes from inactive slots (zeroed table rows) both land in trash
     page 0 — see module invariants.
     """
-    ps = k_pages.shape[1]
+    ps = pool_data(k_pages).shape[1]
     maxp = page_table.shape[1]
     pos = positions[:, 0]                                # [B]
     col = jnp.minimum(pos // ps, maxp - 1)
     page = jnp.take_along_axis(page_table, col[:, None], axis=1)[:, 0]
     page = jnp.where(pos < maxp * ps, page, 0)           # overshoot -> trash
     off = pos % ps
+    if isinstance(k_pages, QuantPool):
+        # one-column requant window: slots before pos survive, the new
+        # token lands at off, later slots are stale garbage -> zeroed
+        slots = jnp.arange(ps, dtype=jnp.int32)[None, :]         # [1, ps]
+        slot_pos = (col * ps)[:, None] + slots                   # [B, ps]
+        is_new = slots == off[:, None]
+        is_keep = slot_pos < pos[:, None]
+        out = []
+        for pool, tok in ((k_pages, k), (v_pages, v)):
+            q, s = _requant_window(pool.data[page], pool.scale[page],
+                                   tok[:, 0][:, None], is_new, is_keep)
+            out.append(QuantPool(pool.data.at[page].set(q),
+                                 pool.scale.at[page].set(s)))
+        return out[0], out[1]
     k_pages = k_pages.at[page, off].set(k[:, 0].astype(k_pages.dtype))
     v_pages = v_pages.at[page, off].set(v[:, 0].astype(v_pages.dtype))
     return k_pages, v_pages
@@ -187,6 +422,17 @@ def paged_gather_kv(
     attention input; bandwidth equals the dense cache, so use the Pallas
     ragged kernel on TPU for the savings)."""
     B, maxp = page_table.shape
+    if isinstance(k_pages, QuantPool):
+        # fallback dequant site: gather payload + scales, expand to a
+        # dense f32 view (the XLA reference attends full precision; the
+        # Pallas kernels dequantize per tile instead)
+        ps = k_pages.data.shape[1]
+        kg = _dequantize_pages(k_pages.data[page_table],
+                               k_pages.scale[page_table])
+        vg = _dequantize_pages(v_pages.data[page_table],
+                               v_pages.scale[page_table])
+        new_shape = (B, maxp * ps) + k_pages.data.shape[2:]
+        return kg.reshape(new_shape), vg.reshape(new_shape)
     ps = k_pages.shape[1]
     kg = k_pages[page_table]  # [B, maxp, ps, Hkv, D]
     vg = v_pages[page_table]
@@ -208,8 +454,8 @@ def paged_insert_prefill(
     engine's hot path performs this scatter inside its fused paged
     prefill (`Engine._prefill_paged_fused`); tests check that fused path
     against this standalone form."""
-    L = k_pages.shape[0]
-    ps = k_pages.shape[2]
+    L = pool_data(k_pages).shape[0]
+    ps = pool_data(k_pages).shape[2]
     n, chunks = target_pages.shape
     bucket = dense_k.shape[2]
     assert bucket == chunks * ps, (bucket, chunks, ps)
@@ -218,9 +464,23 @@ def paged_insert_prefill(
     kc = dense_k[:, :n].reshape((L, n * chunks, ps) + tail)
     vc = dense_v[:, :n].reshape((L, n * chunks, ps) + tail)
     flat = target_pages.reshape(-1)  # [n*chunks]
-    k_pages = k_pages.at[:, flat].set(kc.astype(k_pages.dtype))
-    v_pages = v_pages.at[:, flat].set(vc.astype(v_pages.dtype))
+    k_pages = pool_insert_pages(k_pages, flat, kc)
+    v_pages = pool_insert_pages(v_pages, flat, vc)
     return k_pages, v_pages
+
+
+def pool_insert_pages(pool: Any, flat_ids: jnp.ndarray,
+                      dense_pages: jnp.ndarray) -> Any:
+    """WHOLE-page insert: ``dense_pages`` [L, n, ps, Hkv, D] full
+    precision -> pool pages at ``flat_ids`` [n]. On quantized pools this
+    is the EXACT quantization path (per-page amax over the fresh values
+    only — no survivor requant); the engine's fused paged prefill and
+    prefix-insert closures route their page scatters through here."""
+    if isinstance(pool, QuantPool):
+        q, s = _quantize_pages(dense_pages)
+        return QuantPool(pool.data.at[:, flat_ids].set(q),
+                         pool.scale.at[:, flat_ids].set(s))
+    return pool.at[:, flat_ids].set(dense_pages.astype(pool.dtype))
 
 
 def paged_write_chunk(
@@ -239,10 +499,42 @@ def paged_write_chunk(
     past the table's coverage and rows with zeroed (retired/inactive)
     table entries land in trash page 0 and are never read.
     """
-    L = k_pages.shape[0]
-    ps = k_pages.shape[2]
+    L = pool_data(k_pages).shape[0]
+    ps = pool_data(k_pages).shape[2]
     B, maxp = page_table.shape
     Kc = chunk_k.shape[2]
+    if isinstance(k_pages, QuantPool):
+        # requant window: the chunk spans at most ceil((ps-1+Kc)/ps)
+        # consecutive page columns from start//ps. Survivors are slots
+        # before start; slots past the chunk end are stale -> zeroed.
+        npc = min(maxp, (Kc + 2 * ps - 2) // ps)
+        start = start_positions.astype(jnp.int32)
+        c0 = jnp.clip(start // ps, 0, maxp - 1)                  # [B]
+        cols = c0[:, None] + jnp.arange(npc, dtype=jnp.int32)    # [B, npc]
+        colc = jnp.clip(cols, 0, maxp - 1)
+        page = jnp.take_along_axis(page_table, colc, axis=1)     # [B, npc]
+        touched = (cols < maxp) & (cols * ps < (start + Kc)[:, None])
+        page = jnp.where(touched, page, 0)                       # -> trash
+        slots = jnp.arange(ps, dtype=jnp.int32)
+        slot_pos = cols[..., None] * ps + slots                  # [B, npc, ps]
+        t = slot_pos - start[:, None, None]                      # chunk index
+        is_new = (t >= 0) & (t < Kc) & (slot_pos < maxp * ps)
+        is_keep = slot_pos < start[:, None, None]
+        tc = jnp.clip(t, 0, Kc - 1)
+        bidx = jnp.arange(B)[:, None, None]
+        pf = page.reshape(-1)                                    # [B*npc]
+        out = []
+        for pool, chunk in ((k_pages, chunk_k), (v_pages, chunk_v)):
+            new_v = chunk[:, bidx, tc]           # [L, B, npc, ps, Hkv, D]
+            q, s = _requant_window(pool.data[:, page],
+                                   pool.scale[:, page],
+                                   new_v, is_new, is_keep)
+            out.append(QuantPool(
+                pool.data.at[:, pf].set(
+                    q.reshape((L, B * npc) + q.shape[3:])),
+                pool.scale.at[:, pf].set(
+                    s.reshape((L, B * npc) + s.shape[3:]))))
+        return out[0], out[1]
     pos = start_positions[:, None] + jnp.arange(Kc, dtype=jnp.int32)[None, :]
     col = jnp.minimum(pos // ps, maxp - 1)
     page = jnp.take_along_axis(page_table, col, axis=1)   # [B, Kc]
@@ -272,8 +564,11 @@ def paged_write_ragged(
     ps``. Padding tokens (row id out of range, or positions past the
     table's coverage) land in trash page 0 — the same invariants as
     :func:`paged_write_decode` / :func:`paged_write_chunk`."""
-    ps = k_pages.shape[2]
+    ps = pool_data(k_pages).shape[2]
     R, maxp = row_tables.shape
+    if isinstance(k_pages, QuantPool):
+        return _paged_write_ragged_quant(
+            k_pages, v_pages, sfx_k, sfx_v, tok_row, tok_pos, row_tables)
     col = jnp.clip(tok_pos // ps, 0, maxp - 1)
     row = jnp.clip(tok_row, 0, R - 1)
     page = row_tables[row, col]                          # [W]
@@ -283,6 +578,74 @@ def paged_write_ragged(
     k_pages = k_pages.at[:, page, off].set(sfx_k.astype(k_pages.dtype))
     v_pages = v_pages.at[:, page, off].set(sfx_v.astype(v_pages.dtype))
     return k_pages, v_pages
+
+
+def _paged_write_ragged_quant(
+    k_pages: "QuantPool", v_pages: "QuantPool",
+    sfx_k: jnp.ndarray, sfx_v: jnp.ndarray,
+    tok_row: jnp.ndarray, tok_pos: jnp.ndarray,
+    row_tables: jnp.ndarray,
+) -> Tuple["QuantPool", "QuantPool"]:
+    """Quantized ragged wave write: per-row requant window.
+
+    Each wave row's tokens are CONTIGUOUS positions, so a row touches at
+    most ceil(W/ps)+1 consecutive page columns starting at its first
+    token's column (derived on-device via a segment-min over ``tok_pos``
+    — the signature carries no per-row lengths). Survivors are slots
+    before the row's first wave token (earlier chunks of a split prompt
+    in the same partially-filled page); slots past the row's last wave
+    token are stale -> zeroed. Prefix-cache HIT pages are page-aligned
+    and sit strictly before every window, so shared pages are never
+    rewritten. Untouched window columns and dead/padding rows route to
+    trash page 0. Write amplification vs the unquantized scatter is
+    ~R x window pages (the wave path is compute-bound; documented in
+    README's quantization notes).
+    """
+    L = k_pages.data.shape[0]
+    ps = k_pages.data.shape[2]
+    tail = k_pages.data.shape[3:]                         # (Hkv, D)
+    R, maxp = row_tables.shape
+    W = tok_pos.shape[0]
+    big = maxp * ps
+    live = ((tok_row >= 0) & (tok_row < R)
+            & (tok_pos >= 0) & (tok_pos < big))
+    rowc = jnp.clip(tok_row, 0, R - 1)
+    row_min = jnp.full((R,), big, jnp.int32).at[rowc].min(
+        jnp.where(live, tok_pos, big))
+    row_max = jnp.full((R,), -1, jnp.int32).at[rowc].max(
+        jnp.where(live, tok_pos, -1))
+    npc = min(maxp, -(-W // ps) + 1)
+    c0 = jnp.clip(row_min // ps, 0, maxp - 1)             # [R]
+    cols = c0[:, None] + jnp.arange(npc, dtype=jnp.int32)  # [R, npc]
+    colc = jnp.clip(cols, 0, maxp - 1)
+    page = jnp.take_along_axis(row_tables, colc, axis=1)  # [R, npc]
+    touched = (cols < maxp) & (cols * ps <= row_max[:, None])
+    page = jnp.where(touched, page, 0)                    # -> trash
+    # stage the packed wave into per-row dense windows (scatter; padding
+    # tokens and out-of-window strays are dropped via OOB row index)
+    rel = tok_pos - c0[rowc] * ps
+    okw = live & (rel >= 0) & (rel < npc * ps)
+    sr = jnp.where(okw, rowc, R)                          # R = dropped
+    srel = jnp.where(okw, rel, 0)
+    is_new = jnp.zeros((R, npc * ps), bool).at[sr, srel].set(
+        True, mode="drop").reshape(R, npc, ps)
+    slots = jnp.arange(ps, dtype=jnp.int32)
+    slot_pos = cols[..., None] * ps + slots               # [R, npc, ps]
+    is_keep = slot_pos < row_min[:, None, None]
+    pf = page.reshape(-1)                                 # [R*npc]
+    out = []
+    for pool, sfx in ((k_pages, sfx_k), (v_pages, sfx_v)):
+        stage = jnp.zeros((L, R, npc * ps) + tail, jnp.float32)
+        stage = stage.at[:, sr, srel].set(
+            sfx.astype(jnp.float32), mode="drop")
+        new_v = stage.reshape((L, R, npc, ps) + tail)
+        q, s = _requant_window(pool.data[:, page], pool.scale[:, page],
+                               new_v, is_new, is_keep)
+        out.append(QuantPool(
+            pool.data.at[:, pf].set(q.reshape((L, R * npc) + q.shape[3:])),
+            pool.scale.at[:, pf].set(
+                s.reshape((L, R * npc) + s.shape[3:]))))
+    return out[0], out[1]
 
 
 _set_page_table_rows = jax.jit(

@@ -241,11 +241,20 @@ def build_sharded_paged(
         from jax.experimental.shard_map import shard_map
 
     from ..ops.layers import pallas_disabled
-    from ..ops.paged_kv import (init_paged_kv_cache,
+    from ..ops.paged_kv import (init_paged_kv_cache, kv_quantized,
                                 make_sharded_page_allocator,
                                 pages_per_slot)
 
     cfg, mesh, fam = sm.cfg, sm.mesh, _family(sm.cfg)
+    if kv_quantized():
+        # PAGED_CACHE_SPECS are rank-5 payload PartitionSpecs; the int8
+        # QuantPool carries rank-3 scale planes they cannot shard. Fail
+        # loudly here rather than deep inside jit with a spec/rank error.
+        raise NotImplementedError(
+            "SWARMDB_KV_DTYPE=int8 is single-chip only: the sharded paged "
+            "pool's PartitionSpecs do not cover QuantPool scale planes. "
+            "Unset SWARMDB_KV_DTYPE (or use f32/bf16) for sharded serving."
+        )
     if any(mesh.shape.get(ax, 1) > 1 for ax in ("model", "expert", "pipe")):
         raise ValueError(
             "sharded paged serving requires a pure-DP mesh (model/expert/"

@@ -91,6 +91,13 @@ def test_ragged_greedy_bit_identical_to_bucketed(monkeypatch):
     SWARMDB_RAGGED_PREFILL=1 vs 0 — same PRNG folds, same bf16 KV bytes,
     prompts spanning single-wave, multi-wave-split, and sub-page
     shapes."""
+    from swarmdb_tpu.ops.paged_kv import kv_quantized
+    if kv_quantized():
+        # int8 pool: each admission path quantizes against its own
+        # page-window contents, so cross-path bit-identity is a
+        # plain-pool contract (tests/test_kv_quant.py pins the int8
+        # drift floor instead)
+        pytest.skip("bit-identity is a plain-pool (f32/bf16) contract")
     rag = _build(True, monkeypatch)
     buck = _build(False, monkeypatch)
     rag.start()

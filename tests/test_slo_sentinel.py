@@ -389,6 +389,29 @@ def test_bench_trend_kernel_and_platform_gate(tmp_path):
     assert bt._platform_class({}) == "native"
 
 
+def test_bench_trend_kv_dtype_gate(tmp_path):
+    """ISSUE 18: an int8-pool record against a bf16 base (or vice
+    versa) is incomparable — halved pool bytes would otherwise read as
+    a phantom speedup/regression. Same-dtype pairs still gate."""
+    bt = _load_bench_trend()
+    base = {"parsed": {"modes": {
+        "serve": {"v": 100.0, "kv": "bf16"},
+        "echo": {"v": 100.0, "kv": "int8"},
+    }}}
+    test = {"parsed": {"modes": {
+        "serve": {"v": 130.0, "kv": "int8"},
+        "echo": {"v": 20.0, "kv": "int8"},
+    }}}
+    b, t = tmp_path / "a.json", tmp_path / "b.json"
+    b.write_text(json.dumps(base))
+    t.write_text(json.dumps(test))
+    report = bt.build_report(str(b), str(t), threshold=0.15)
+    by_mode = {v["mode"]: v for v in report["modes"]}
+    assert by_mode["serve"]["comparable"] is False
+    assert "kv pool dtype changed" in by_mode["serve"]["reason"]
+    assert by_mode["echo"]["regressed"] is True
+
+
 def test_bench_trend_pairs_without_phase_shares(tmp_path):
     bt = _load_bench_trend()
     base = {"parsed": {"modes": {"serve": {"v": 50.0, "p50": 1.0}}}}
