@@ -33,6 +33,10 @@ Modes (SWARMDB_BENCH_MODE) — one per BASELINE.md config:
   group    — config 3: group_message fan-out to 4 LLM assistants.
   tooluse  — config 4: function_call -> Mixtral-arch MoE -> function_result.
   swarm100 — config 5: 100-agent swarm, mixed priorities.
+  swarm1M  — tiered conversation state (ISSUE 19): a conversation
+             universe >=100x device page capacity under Zipf long-tail
+             arrivals; records warm-hit vs cold-resume TTFT, warm hit
+             rate, pages by tier (CPU by design, like dpserve).
   dpserve  — DP-scaling A/B of the sharded paged path on N virtual CPU
              devices (never probes the TPU; see bench_dpserve docstring).
   longctx  — S=1024 paged + in-place prefix reuse (long-context regime;
@@ -384,6 +388,13 @@ def _device_extras(service, model: str) -> dict:
             "evictions": c["rolling_evictions"].value,
             "conversations": len(service._rolling),
         }
+    # tier hierarchy (ISSUE 19): pages by tier + demote/promote/cold
+    # counters + measured warm hit rate, whenever a TierManager is live
+    if getattr(service, "_tier", None) is not None:
+        try:
+            extras["tier"] = service._tier.status()
+        except Exception as exc:  # noqa: BLE001
+            extras["tier_error"] = repr(exc)[-200:]
     # swarmprof (ISSUE 15): the per-mode kernel_profile block — per-
     # variant invocations / device seconds / harvested FLOPs / MFU /
     # roofline class — plus per-lane duty cycles, so every bench record
@@ -1044,6 +1055,235 @@ def bench_dpserve(seconds: float) -> dict:
         **({"dp_diagnosis": dp_diag} if dp_diag is not None else {}),
         "note": ("virtual-CPU-device A/B of the per-shard-lane paged "
                  "path at equal total slots; not TPU perf"),
+    }
+
+
+# --------------------------------------------------------------------------
+# Mode: swarm1M (ISSUE 19 acceptance)
+
+
+def _zipf_indices(k: int, exponent: float, count: int, seed: int):
+    """``count`` conversation indices in [0, k) drawn from a bounded
+    Zipf (inverse-CDF over rank**-exponent): a head of conversations
+    that return constantly (hot), a mid-band that returns after gaps
+    (the demote->promote band), and a long tail that arrives once."""
+    import numpy as np
+
+    ranks = np.arange(1, k + 1, dtype=np.float64)
+    cdf = np.cumsum(ranks ** -exponent)
+    cdf /= cdf[-1]
+    rng = np.random.default_rng(seed)
+    return np.searchsorted(cdf, rng.random(count)).astype(np.int64)
+
+
+def bench_swarm1M(seconds: float) -> dict:
+    """Tiered-conversation-state acceptance (ISSUE 19): a registered
+    conversation universe ~100-1000x larger than the device page pool,
+    Zipf long-tail arrivals, rolling KV + the tier manager on. The
+    record carries warm-hit vs cold-resume TTFT (the number the warm
+    tier exists to separate), the measured warm hit rate, pages by
+    tier, and swarmmem's predicted-vs-measured validation. Runs on
+    CPU by design (like dpserve): the tier machinery — demote gather,
+    host store, promote device_put, cold replay — is platform-neutral;
+    wall-clock here is a liveness/correctness record, not TPU perf."""
+    _force_cpu()
+    import numpy as np  # noqa: F401 — _zipf_indices needs it present
+
+    model = _env("SWARMDB_BENCH_TIER_MODEL", "tiny-debug")
+    n_users = _env("SWARMDB_BENCH_TIER_USERS", 2048)
+    n_assistants = _env("SWARMDB_BENCH_TIER_ASSISTANTS", 32)
+    max_batch = _env("SWARMDB_BENCH_TIER_BATCH", 4)
+    # deep window: the tier gap is prefill economics — at S=512 a cold
+    # re-prefill is a few hundred tokens, comparable to the resume
+    # machinery's own overhead on CPU, and the warm/cold ordering reads
+    # as noise; at S=1024 with a ~650-token opener the re-prefill
+    # clearly dominates
+    max_seq = _env("SWARMDB_BENCH_TIER_SEQ", 1024)
+    new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 16)
+    # workload shape: each conversation OPENS with a long context turn
+    # (the "system prompt / task doc" every real conversation carries)
+    # and then exchanges short deltas. That split is what the tiers
+    # separate: a warm hit prefills only the short delta (its context
+    # KV comes back via the host store), while a cold resume must
+    # re-prefill the whole history, context included. Uniform short
+    # turns would hide the gap — the Zipf tail's cold victims have 1-2
+    # turn histories, so their re-prefill would cost the same as a
+    # warm delta and the comparison would read as noise.
+    # word counts are calibrated to the synthetic tokenizer (~6 tokens
+    # per "ctxN" word): the opener lands ~900 tokens — the deepest
+    # ragged-prefill bucket, ~3x the device cost of a paged resume in
+    # this config, but comfortably inside max_seq so the window never
+    # trims it — and each delta ~40 tokens, a shallow one
+    ctx_words = _env("SWARMDB_BENCH_TIER_CTX_WORDS", 140)
+    filler = _env("SWARMDB_BENCH_TIER_TURN_WORDS", 4)
+    zipf_s = _env("SWARMDB_BENCH_TIER_ZIPF", 1.1, float)
+    # warm store sized as a multiple of the device pool's KV bytes —
+    # the same axis swarmmem's warm_tier_model prices (warm_x rows)
+    warm_x = _env("SWARMDB_BENCH_TIER_WARM_X", 1.0, float)
+    k_conversations = n_users * n_assistants
+
+    scoped = {"SWARMDB_ROLLING_KV": "1", "SWARMDB_TIER": "1"}
+    if "SWARMDB_BENCH_PAGE_SIZE" not in os.environ:
+        # big pages at the deep window: fewer page-table entries per
+        # conversation keeps the resume compose shallow (the gap under
+        # test is re-prefill cost, not page bookkeeping)
+        scoped["SWARMDB_BENCH_PAGE_SIZE"] = "32"
+    saved = {k: os.environ.get(k) for k in scoped}
+    os.environ.update(scoped)
+    try:
+        with serving_stack(model, n_assistants, max_batch, max_seq,
+                           _env("SWARMDB_BENCH_CHUNK", 16),
+                           paged=True) as (db, service, assistants):
+            tier = service._tier
+            if tier is None:
+                return {"mode": "swarm1M",
+                        "error": "tier manager did not attach "
+                                 "(rolling or paged unavailable)"}
+            from swarmdb_tpu.ops.paged_kv import pool_page_bytes
+
+            pstats = service.engine.paged.allocator.stats()
+            capacity = max(1, pstats["num_pages"] - 1)
+            page_bytes = (pool_page_bytes(service.engine.cache["k"])
+                          + pool_page_bytes(service.engine.cache["v"]))
+            # exact warm_x sizing: the store exists but is empty this
+            # early, so resizing it is race-free
+            tier.store.capacity_bytes = max(
+                page_bytes, int(warm_x * capacity * page_bytes))
+            # short-window demote eligibility: the production 0.5s idle
+            # floor would exempt everything in a seconds-long bench
+            tier.min_idle_s = _env("SWARMDB_BENCH_TIER_MIN_IDLE",
+                                   0.05, float)
+
+            users = [f"conv_{i}" for i in range(n_users)]
+            for u in users:
+                db.register_agent(u)
+            draws = _zipf_indices(
+                k_conversations, zipf_s,
+                _env("SWARMDB_BENCH_TIER_DRAWS", 200_000),
+                _env("SWARMDB_BENCH_SEED", 1234))
+
+            ctx_pad = " ".join(f"ctx{j}" for j in range(ctx_words))
+            turn_pad = " ".join(f"d{j}" for j in range(filler))
+            opened = set()
+
+            def send(i: int) -> None:
+                c = int(draws[i % len(draws)])
+                if c in opened:
+                    text = f"Continue conversation {c}, step {i}. {turn_pad}"
+                else:
+                    # sends run on the single pump thread: no races on
+                    # the opened set
+                    opened.add(c)
+                    text = f"Conversation {c} context: {ctx_pad}"
+                db.send_message(
+                    users[c % n_users],
+                    assistants[(c // n_users) % n_assistants],
+                    text,
+                    metadata={"generation": {
+                        "max_new_tokens": new_tokens,
+                        "temperature": 0.0}},
+                )
+
+            # phase 1 — CHURN (closed loop): saturate the pool so the
+            # demote watermark trips and the Zipf tail spills through
+            # warm into cold. TTFT samples taken here are queue-depth
+            # artifacts (closed-loop TTFT = outstanding/throughput) and
+            # carry an arrival-time bias — warm hits cluster right
+            # after pressure waves — so they are DISCARDED below.
+            pump = _make_pump(db, max_batch + 2, send)
+            window = _run_window(db, seconds * 0.5, pump)
+            completed = db.metrics.counters["completed_messages"]
+            drain_deadline = time.time() + _env(
+                "SWARMDB_BENCH_TIER_DRAIN_S", 30.0, float)
+            while (completed.value < pump.sent
+                   and time.time() < drain_deadline):
+                time.sleep(0.05)
+            # phase 2 — MEASURE (open loop): fixed arrival rate well
+            # under phase-1 throughput, fresh per-origin histograms, so
+            # warm-hit vs cold-resume TTFT reflects what each tier
+            # actually computes (delta prefill vs full re-prefill), not
+            # shared queue wait
+            from swarmdb_tpu.utils.metrics import LatencyHistogram
+            for origin in ("hot", "warm", "cold", "fresh"):
+                db.metrics.latencies[f"tier_ttft_{origin}_s"] = \
+                    LatencyHistogram(capacity=1_000_000)
+            rate = _env("SWARMDB_BENCH_TIER_RATE", 0.0, float) \
+                or max(1.0, 0.45 * window["completed_per_sec"])
+            open_sent = 0
+            t0 = time.time()
+            while time.time() - t0 < seconds * 0.5:
+                due = int((time.time() - t0) * rate)
+                while open_sent < due:
+                    send(pump.sent + open_sent)
+                    open_sent += 1
+                time.sleep(0.002)
+            # acked-loss drain: every send from BOTH phases must
+            # complete — a demoted or cold-evicted conversation may
+            # resume slower, never lose
+            sent_total = pump.sent + open_sent
+            drain_deadline = time.time() + _env(
+                "SWARMDB_BENCH_TIER_DRAIN_S", 30.0, float)
+            while (completed.value < sent_total
+                   and time.time() < drain_deadline):
+                time.sleep(0.05)
+            acked_loss = max(0, sent_total - completed.value)
+            extras = _device_extras(service, model)
+            extras.update(_deposit_obs_artifacts(service, "swarm1M"))
+            ttft = {}
+            for origin in ("hot", "warm", "cold", "fresh"):
+                h = db.metrics.latencies.get(f"tier_ttft_{origin}_s")
+                if h is not None:
+                    for q in (50, 95):
+                        v = h.percentile(q)
+                        if v is not None:
+                            ttft[f"{origin}_p{q}"] = round(v, 4)
+            tier_validation = None
+            try:
+                from swarmdb_tpu.obs.memprof import memprof
+
+                tier_validation = memprof().tier_validation()
+            except Exception:  # noqa: BLE001
+                pass
+            status = tier.status()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    value = window.pop("completed_per_sec")
+    return {
+        "metric": "swarm1M_completed_messages_per_sec",
+        "value": round(value, 2),
+        "unit": "msgs/sec",
+        "vs_baseline": round(value / TARGET_MSGS_PER_SEC, 4),
+        "mode": "swarm1M",
+        "model": model,
+        "registered_conversations": k_conversations,
+        "device_page_capacity": capacity,
+        "conversations_vs_capacity_x": round(k_conversations / capacity, 1),
+        "zipf_exponent": zipf_s,
+        "warm_x": warm_x,
+        "acked_loss": acked_loss,
+        "measure_rate_per_s": round(rate, 2),
+        "measure_sent": open_sent,
+        "warm_hit_rate": round(status["warm_hit_rate"], 4),
+        "warm_hit_ttft_p50": ttft.get("warm_p50"),
+        "warm_hit_ttft_p95": ttft.get("warm_p95"),
+        "cold_resume_ttft_p50": ttft.get("cold_p50"),
+        "cold_resume_ttft_p95": ttft.get("cold_p95"),
+        "ttft_by_tier_origin": ttft,
+        "tier_pages": status["pages"],
+        "tier_counters": status["counters"],
+        "warm_store": status["warm_store"],
+        "tier_validation": tier_validation,
+        "tokens_per_sec": round(window["tokens_per_sec"], 1),
+        **{k: v for k, v in window.items() if k != "tokens_per_sec"},
+        **extras,
+        "note": ("CPU long-tail tiered-state acceptance: conversation "
+                 "universe >=100x device pages, Zipf arrivals; "
+                 "liveness/correctness record, not TPU perf"),
     }
 
 
@@ -2013,6 +2253,7 @@ _MODES = {
     "group": bench_group,
     "tooluse": bench_tooluse,
     "swarm100": bench_swarm100,
+    "swarm1M": bench_swarm1M,
     "dpserve": bench_dpserve,
     "longctx": bench_longctx,
     "ha": bench_ha,
@@ -2020,8 +2261,9 @@ _MODES = {
     "chaos_cluster_serve": bench_chaos_cluster_serve,
 }
 
-# dpserve is NOT here: it is a virtual-CPU-device measurement by design
-# (forces its own platform; probing the TPU for it would be wrong)
+# dpserve/swarm1M are NOT here: both are CPU measurements by design
+# (they force their own platform; probing the TPU for them would be
+# wrong — swarm1M's tier machinery is platform-neutral)
 _NEEDS_BACKEND = {"serve", "group", "tooluse", "swarm100", "longctx"}
 
 # what `mode=all` actually runs; the watchdog scales its limit by THIS
@@ -2030,7 +2272,8 @@ _NEEDS_BACKEND = {"serve", "group", "tooluse", "swarm100", "longctx"}
 # it is the slowest warmup, so a cold-container budget squeeze sheds the
 # long-context line rather than the headline serve/tooluse records
 _ALL_MODES = ("echo", "ha", "chaos_serve", "chaos_cluster_serve", "serve",
-              "group", "tooluse", "swarm100", "dpserve", "longctx")
+              "group", "tooluse", "swarm100", "swarm1M", "dpserve",
+              "longctx")
 
 
 def _force_cpu() -> None:
@@ -2106,6 +2349,8 @@ _SUMMARY_KEYS = (
     ("native", "native_broker_msgs_per_sec"),
     ("dpx", "dp_scaling_x"),
     ("ovh", "tracer_overhead_pct"),
+    ("whit", "warm_hit_rate"),
+    ("cold", "cold_resume_ttft_p50"),
     ("loss", "acked_loss"),
     ("blast", "blast_radius"),
     ("wsx", "write_scaling_x"),

@@ -239,7 +239,11 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
         # fails). Like-for-like is already enforced above, so a drop
         # beyond the threshold is a real memory regression.
         for short, tag in (("hit", "prefix_hit_rate"),
-                           ("hdrm", "mem_headroom")):
+                           ("hdrm", "mem_headroom"),
+                           # swarmtier (ISSUE 19): the measured warm hit
+                           # rate — fewer promotions per resume means
+                           # more full re-prefills at the same load
+                           ("whit", "warm_hit_rate")):
             bm, tm = b.get(short), t.get(short)
             if isinstance(bm, (int, float)) and \
                     isinstance(tm, (int, float)) and bm > 0:
@@ -249,6 +253,18 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
                 if tm / bm < (1.0 - threshold):
                     entry["regressed"] = True
                     entry[f"{tag}_regressed"] = True
+        # cold-resume TTFT is a LATENCY: direction inverts — regression
+        # is the ratio growing past 1+threshold (a slower log-replay
+        # resume), not shrinking below 1-threshold
+        bc, tc = b.get("cold"), t.get("cold")
+        if isinstance(bc, (int, float)) and \
+                isinstance(tc, (int, float)) and bc > 0:
+            entry["base_cold"] = bc
+            entry["test_cold"] = tc
+            entry["cold_ratio"] = round(tc / bc, 3)
+            if tc / bc > (1.0 + threshold):
+                entry["regressed"] = True
+                entry["cold_resume_ttft_regressed"] = True
         if entry["regressed"]:
             bs, ts = _phase_summary(b), _phase_summary(t)
             if bs is not None and ts is not None:

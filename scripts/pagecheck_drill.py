@@ -5,9 +5,11 @@ The CI ``pagecheck`` job runs the serving-chaos and ragged-prefill
 suites under ``SWARMDB_PAGECHECK=1`` and fails on any violation; this
 script is the other direction: it deliberately commits every page
 crime the sanitizer hunts — a write into a freed (canary-poisoned)
-page, a reference to a dead page, a double-free — and exits non-zero
-unless the detector FIRED on each and dumped evidence to disk. A green
-chaos run only means something if this drill stays red-on-crime.
+page, a reference to a dead page, a double-free, and (ISSUE 19) the
+cross-tier custody crimes: use-after-demote, double-demote,
+demote-of-free, promote-unreserved — and exits non-zero unless the
+detector FIRED on each and dumped evidence to disk. A green chaos run
+only means something if this drill stays red-on-crime.
 
 Run: SWARMDB_PAGECHECK=1 python scripts/pagecheck_drill.py
 (the script forces the flag itself so a bare invocation also works).
@@ -75,8 +77,35 @@ def main() -> int:
     # swarmlint: disable=SWL803 -- seeded crime: the drill exists to prove the runtime detector fires
     alloc.add_free(taken)
 
+    # -- cross-tier crimes (ISSUE 19): a separate pool so the tier
+    # shadow states don't entangle with the crimes above ---------------
+    talloc = make_page_allocator(9, 4, 16, 2, label="drill-tier")
+
+    # -- crime 4: use-after-demote ------------------------------------
+    # a conversation's pages leave for the warm tier; referencing the
+    # device copies afterwards reads pages about to be freed
+    assert talloc.allocate(0, 2) is not None
+    tpages = talloc.pages_for(0)
+    talloc.pagecheck.on_demote(tpages, ("drill", "tier-conv"))
+    # swarmlint: disable=SWL801 -- seeded crime: resume referencing demoted pages
+    talloc.pagecheck.on_reference(1, tpages)
+
+    # -- crime 5: double-demote ---------------------------------------
+    # a second demotion of the same key would spill pages already gone
+    talloc.pagecheck.on_demote(tpages, ("drill", "tier-conv"))
+
+    # -- crime 6: demote-of-free + promote-unreserved -----------------
+    # demoting pages the conversation does not hold, then promoting a
+    # payload into page ids the allocator never reserved
+    loose = talloc.reserve(1)
+    talloc.add_free(loose)
+    talloc.pagecheck.on_demote(loose, ("drill", "tier-conv2"))
+    talloc.pagecheck.on_promote(loose, ("drill", "tier-conv2"))
+
     kinds = {vv["kind"] for vv in pagecheck.registry().violations()}
-    want = {"canary", "stale-reference", "double-free"}
+    want = {"canary", "stale-reference", "double-free",
+            "use-after-demote", "double-demote", "demote-of-free",
+            "promote-unreserved"}
     missing = want - kinds
     dump = os.path.join(dump_dir, "pagecheck_pagecheck-drill.json")
     print(f"violations recorded: {sorted(kinds)}")

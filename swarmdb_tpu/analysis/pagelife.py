@@ -64,7 +64,10 @@ _FREE_TAILS = {"add_free", "release_taken", "_give", "rolling_free"}
 #: call tails that discharge a pin
 _UNPIN_TAILS = {"unpin"}
 #: call tails transferring custody without freeing (handle stays live)
-_XFER_TAILS = {"register", "transfer_to_cache", "requeue_pending"}
+#: — on_demote/on_promote move pages across the tier boundary (host
+#: custody, ISSUE 19); the handle stays live until rolling_free
+_XFER_TAILS = {"register", "transfer_to_cache", "requeue_pending",
+               "on_demote", "on_promote"}
 #: page-table write / dispatch-descriptor sinks (SWL802/SWL805 anchors)
 _TABLE_TAILS = {"set_page_table_rows", "paged_write_ragged",
                 "paged_write_decode", "paged_write_chunk",

@@ -768,6 +768,44 @@ def create_app(
                         "# TYPE swarmdb_prefix_miss_tokens_total counter")
                     lines.append(f"swarmdb_prefix_miss_tokens_total "
                                  f"{prefstats.get('miss_tokens', 0)}")
+        # tier gauges (ISSUE 19): flag-independent like the page-pool
+        # gauges — pages by tier plus the demote/promote/cold-resume
+        # counters, rendered off the live TierManager. Without one the
+        # hot gauge still renders (everything device-resident is "hot")
+        # so dashboards keep a stable series across deployments.
+        tier = getattr(serving, "_tier", None)
+        if tier is not None:
+            try:
+                tstatus = await _run_sync(tier.status)
+            except Exception:
+                logger.exception("tier status read failed")
+                tstatus = None
+            if tstatus is not None:
+                lines.append("# TYPE swarmdb_tier_pages gauge")
+                for name in ("hot", "warm", "cold"):
+                    lines.append(
+                        f'swarmdb_tier_pages{{tier="{name}"}} '
+                        f"{tstatus['pages'].get(name, 0)}")
+                tcounters = tstatus.get("counters", {})
+                for cname in ("demotions", "promotions", "cold_resumes"):
+                    lines.append(
+                        f"# TYPE swarmdb_tier_{cname}_total counter")
+                    lines.append(f"swarmdb_tier_{cname}_total "
+                                 f"{tcounters.get(cname, 0)}")
+        elif paged is not None:
+            try:
+                pstats2 = await _run_sync(paged.allocator.stats)
+                hot = max(0, int(pstats2.get("num_pages", 0)) - 1
+                          - int(pstats2.get("free_pages", 0)))
+            except Exception:
+                hot = 0
+            lines.append("# TYPE swarmdb_tier_pages gauge")
+            lines.append(f'swarmdb_tier_pages{{tier="hot"}} {hot}')
+            lines.append('swarmdb_tier_pages{tier="warm"} 0')
+            lines.append('swarmdb_tier_pages{tier="cold"} 0')
+            for cname in ("demotions", "promotions", "cold_resumes"):
+                lines.append(f"# TYPE swarmdb_tier_{cname}_total counter")
+                lines.append(f"swarmdb_tier_{cname}_total 0")
         if pagecheck_enabled():
             from ..obs import pagecheck
 
@@ -1110,6 +1148,32 @@ def create_app(
                               "SWARMDB_MEMPROF=0")
         return web.json_response(await _run_sync(memprof().report))
 
+    async def admin_tiers(request: web.Request) -> web.Response:
+        """GET /admin/tiers — the conversation-state tier hierarchy
+        (ISSUE 19): pages by tier (hot device / warm host-RAM / cold
+        log-replay), warm-store byte occupancy and LRU churn, the
+        demote/promote/cold-resume counters, the measured warm hit
+        rate, and the live config (demote watermark, min idle,
+        warm capacity). Always answers: without a tier manager the
+        payload is ``{"enabled": false}`` plus the hot page count, so
+        "is tiering even on" is a curl, not a log dig."""
+        require_admin(current_agent(request))
+        tier = getattr(serving, "_tier", None)
+        if tier is not None:
+            return web.json_response(await _run_sync(tier.status))
+        out: Dict[str, Any] = {"enabled": False,
+                               "pages": {"hot": 0, "warm": 0, "cold": 0}}
+        paged = getattr(getattr(serving, "engine", None), "paged", None)
+        if paged is not None:
+            try:
+                pstats = await _run_sync(paged.allocator.stats)
+                out["pages"]["hot"] = max(
+                    0, int(pstats.get("num_pages", 0)) - 1
+                    - int(pstats.get("free_pages", 0)))
+            except Exception:
+                logger.exception("page-pool stats read failed")
+        return web.json_response(out)
+
     async def admin_lanes(request: web.Request) -> web.Response:
         """GET /admin/lanes — the lane supervisor's full status: per-lane
         state machine (alive/suspect/quarantined), beat ages, quarantine
@@ -1297,6 +1361,7 @@ def create_app(
         web.get("/admin/kerncheck", admin_kerncheck),
         web.get("/admin/profile", admin_profile),
         web.get("/admin/mem", admin_mem),
+        web.get("/admin/tiers", admin_tiers),
     ])
 
     async def on_shutdown(app: web.Application) -> None:

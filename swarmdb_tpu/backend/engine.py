@@ -143,6 +143,14 @@ class GenRequest:
     # reclaims every page, so resuming stale ids would alias another
     # slot's pages — cross-conversation KV corruption (ADVICE r4 #2).
     resume_epoch: Optional[int] = None
+    # promote_payload: warm-tier promotion (ISSUE 19) — the host-RAM
+    # raw page payload ((k, v) pool_gather_pages outputs) that must be
+    # bulk-inserted into resume_pages BEFORE the resume prefill reads
+    # them. resume_pages were freshly RESERVED by the tier manager;
+    # admission performs the H2D insert on the engine thread (the pools
+    # are donated by engine jits — no other thread may touch them) and
+    # clears this field. None for ordinary (hot) resumes.
+    promote_payload: Optional[Any] = None
     # shard_hint: DP-sharded paged pools only — admission prefers a free
     # slot on this shard (mod n_shards). Prefix-cache pages are only
     # usable by same-shard slots, so routing a conversation's turns to
@@ -464,6 +472,17 @@ class Engine:
         self._bp_shed = max(_env_frac("SWARMDB_POOL_SHED", 0.98),
                             self._bp_high)
         self._bp_paused = False
+        # tiered-KV demote watermark (ISSUE 19): BELOW the pause
+        # watermark — the gate starts signalling the tier manager to
+        # spill cold conversations to host RAM before admission ever
+        # has to pause, with the same hysteresis band (active until
+        # util falls back to the low watermark). SWARMDB_TIER_DEMOTE
+        # >= 1 disables the early signal (demote_now still fires on
+        # hard allocation failure via on_pool_pressure).
+        _d = _env_frac("SWARMDB_TIER_DEMOTE", 0.85)
+        self._bp_demote = (_d if _d >= 1.0
+                           else max(self._bp_low, min(_d, self._bp_high)))
+        self._tier_demoting = False
 
         self._queue: List[Tuple[int, float, int, GenRequest]] = []  # heap
         # rotates the DP-shard interleave in _free_slot_ids (engine
@@ -488,7 +507,19 @@ class Engine:
         # break-retries forever (admission only retried after retirements,
         # and a fully-idle engine has none).
         self.on_pool_pressure: Optional[Callable[[int], None]] = None
+        # tiered-KV hooks (ISSUE 19, wired by TierManager when rolling
+        # KV is active on a single-shard paged engine):
+        # - on_tier_pressure(need): engine thread, backpressure gate —
+        #   the demote watermark tripped; the tier WORKER plans victims
+        #   (non-blocking signal, no device work here);
+        # - on_tier_drain(): engine thread, start of each admission
+        #   round (after the pending-free flush) — execute planned
+        #   demotions; their D2H gathers ride the flush wave the
+        #   engine already syncs on.
+        self.on_tier_pressure: Optional[Callable[[int], None]] = None
+        self.on_tier_drain: Optional[Callable[[], None]] = None
 
+        self._donate_cache = donate_cache
         donate = (4,) if donate_cache else ()
         K = self.decode_chunk
 
@@ -2609,6 +2640,26 @@ class Engine:
         if self.paged is None or self._bp_high >= 1.0:
             return True
         util = 1.0 - self._pool_headroom()
+        # tiered-KV demote band (ISSUE 19): same hysteresis shape as the
+        # pause band but one rung lower — start spilling cold
+        # conversations to the warm tier BEFORE admission pauses, stop
+        # once utilization falls back under the low watermark. The hook
+        # only signals the tier worker (no device work in the gate).
+        if self.on_tier_pressure is not None and self._bp_demote < 1.0:
+            if self._tier_demoting:
+                if util <= self._bp_low:
+                    self._tier_demoting = False
+            elif util >= self._bp_demote:
+                self._tier_demoting = True
+                self.tracer.instant("tier.pressure", cat="engine",
+                                    args={"util": round(util, 3)})
+            if self._tier_demoting:
+                cap = max(1, self.paged.num_pages - 1)
+                need = max(1, int((util - self._bp_low) * cap))
+                try:
+                    self.on_tier_pressure(need)
+                except Exception:
+                    logger.exception("tier-pressure callback failed")
         if self._bp_paused:
             if util <= self._bp_low:
                 self._bp_paused = False
@@ -2712,6 +2763,14 @@ class Engine:
                 self.paged.allocator.release_taken(pending)
                 if self._pagecheck is not None and freed_pages:
                     self._pagecheck_poison(freed_pages)
+            if self.on_tier_drain is not None:
+                # tiered KV (ISSUE 19): execute the tier worker's planned
+                # demotions here — the D2H gathers ride the flush wave
+                # this round already syncs on, never the decode hot path
+                try:
+                    self.on_tier_drain()
+                except Exception:
+                    logger.exception("tier drain failed")
             if not self._backpressure_gate():
                 return
         pressure_called = False
@@ -2925,6 +2984,14 @@ class Engine:
                     np.asarray([r[0] for r in rows], np.int32),
                     np.stack([r[1] for r in rows]).astype(np.int32),
                 )
+            if self.paged:
+                # warm-tier promotions (ISSUE 19): bulk-insert the host
+                # payload into the freshly reserved resume pages BEFORE
+                # the resume prefill reads them. Engine thread only —
+                # the pools are donated by the prefill jits below.
+                for req in popped:
+                    if req.promote_payload is not None:
+                        self._promote_insert(req)
             use_prefix = self._prefix is not None
             ragged = self.paged is not None and self._ragged_active()
             row_by_slot = dict(rows) if self.paged else {}
@@ -2933,7 +3000,6 @@ class Engine:
             prefix_batch: List[Tuple] = []
             resume_batch: List[Tuple] = []
             max_suffix = max_hits = 0
-            max_suffix_r = max_pages_r = 0
             # paged pops can SKIP a slot (stale resume popped without
             # consuming it), so pair each request with the slot recorded
             # at its allocation, not positionally with `free`
@@ -2942,8 +3008,6 @@ class Engine:
             for slot_id, req in zip(slot_ids, popped):
                 if slot_id in resume_rows:
                     resume_batch.append((slot_id, req, resume_rows[slot_id]))
-                    max_suffix_r = max(max_suffix_r, len(req.prompt))
-                    max_pages_r = max(max_pages_r, len(req.resume_pages))
                     continue
                 if ragged:
                     # packed ragged waves absorb BOTH the plain and the
@@ -2961,8 +3025,6 @@ class Engine:
                     # dense rolling resume: kept prefix-pool pages compose
                     # into the lane (no row-table — the lane IS the slot)
                     resume_batch.append((slot_id, req, None))
-                    max_suffix_r = max(max_suffix_r, len(req.prompt))
-                    max_pages_r = max(max_pages_r, len(req.resume_pages))
                     continue
                 # sub-page prompts (no hit possible, nothing to register)
                 # stay on the plain path; everything else goes through the
@@ -2999,11 +3061,25 @@ class Engine:
                        self._pp_bucket_for(max(1, max_hits)))
                 groups[key] = prefix_batch
             if resume_batch:
-                # rolling-KV continuations: same one-group-per-wave rule;
-                # the sentinel -ppb key routes to the resume prefill
-                key = (self._bucket_for(max(1, max_suffix_r)),
-                       -self._pp_bucket_for(max(1, max_pages_r)))
-                groups[key] = resume_batch
+                # rolling-KV continuations, grouped PER suffix bucket
+                # (sentinel -ppb keys route to the resume prefill). The
+                # prefix wave's one-group rule does not transfer here:
+                # resume deltas are bimodal — a one-turn continuation is
+                # a few tokens while a conversation that chatted plain
+                # during an in-flight stretch returns with hundreds — and
+                # padding the short rows to the deep straggler's bucket
+                # multiplies their whole-model pass (measured 290ms vs
+                # 10ms at S=512), landing squarely on resume TTFT. The
+                # warmup grid already covers every (bucket, width) pair.
+                per_bucket: Dict[int, List[Tuple]] = {}
+                for item in resume_batch:
+                    b = self._bucket_for(max(1, len(item[1].prompt)))
+                    per_bucket.setdefault(b, []).append(item)
+                for b, items in per_bucket.items():
+                    maxp = max(
+                        max(1, len(it[1].resume_pages)) for it in items)
+                    key = (b, -self._pp_bucket_for(maxp))
+                    groups.setdefault(key, []).extend(items)
             if ragged_batch:
                 groups[("ragged", 0)] = ragged_batch
             for (bucket, ppb), batch in groups.items():
@@ -3189,6 +3265,50 @@ class Engine:
             pc.canary_violation(
                 bad, detail=f"at admission of {req.request_id}")
         pc.clear_poison(poisoned)
+
+    def _promote_insert(self, req: "GenRequest") -> None:
+        """Warm-tier promotion (ISSUE 19): bulk-device_put the host-RAM
+        payload into the request's freshly reserved resume pages — the
+        EXACT storage-width bytes that left the pool at demotion come
+        back (``pool_insert_raw``: no requantization), so a resumed
+        greedy decode is bit-identical to never having spilled.
+
+        Engine thread only (the pools are donated by engine jits). The
+        insert loops a ONE-page jitted scatter over the payload rather
+        than batching: a batched insert's shape varies with the
+        conversation's page count, and every new count would compile a
+        fresh variant — a multi-hundred-ms stall landing exactly on the
+        warm-hit TTFT this tier exists to shrink. One fixed-shape
+        variant compiles once; per-page dispatches are off the decode
+        hot path and cheap."""
+        payload, req.promote_payload = req.promote_payload, None
+        if payload is None or not req.resume_pages:
+            return
+        from ..ops.paged_kv import pool_insert_raw
+
+        t0 = time.time()
+
+        def _page(pay, i):
+            if isinstance(pay, tuple):
+                return tuple(a[:, i:i + 1] for a in pay)
+            return pay[:, i:i + 1]
+
+        fn = getattr(self, "_promote_jit", None)
+        if fn is None:
+            fn = jax.jit(
+                pool_insert_raw,
+                donate_argnums=(0,) if self._donate_cache else ())
+            self._promote_jit = fn
+        with self._device_ctx():
+            new_k, new_v = self.cache["k"], self.cache["v"]
+            for i, pid in enumerate(req.resume_pages):
+                ids_arr = jnp.asarray([pid], jnp.int32)
+                new_k = fn(new_k, ids_arr, _page(payload[0], i))
+                new_v = fn(new_v, ids_arr, _page(payload[1], i))
+        self.cache = self._paged_cache_with(new_k, new_v)
+        self.metrics.counters["engine_tier_promote_inserts"].inc()
+        self.metrics.latencies["tier_promote_s"].observe(
+            time.time() - t0)
 
     # swarmlint: hot
     def _prefill_paged_prefix_batch(self, batch: List[Tuple], bucket: int,

@@ -432,6 +432,18 @@ class ServingService:
             # conversations' kept pages instead of stalling/not rolling —
             # non-rolling traffic must never starve behind parked KV
             self.engine.on_pool_pressure = self._on_pool_pressure
+        # swarmtier (ISSUE 19): the three-tier conversation-state
+        # hierarchy — hot device pages, warm host-RAM spill, cold
+        # log-replay resume. Engages on the same preconditions as
+        # rolling resume itself (warm custody IS registry custody):
+        # single-shard paged engine, no pod. SWARMDB_TIER=0 disables.
+        self._tier = None
+        if (self._rolling is not None and self.engine.paged is not None
+                and self.engine._mh is None):
+            from .tiering import TierManager, tiering_enabled
+
+            if tiering_enabled():
+                self._tier = TierManager(self, self.engine)
 
     def bind_partition_leadership(self, ha_node) -> None:
         """Ride partition leadership (ISSUE 14): every conversation's
@@ -551,6 +563,10 @@ class ServingService:
         if self._consumer_thread is not None:
             self._consumer_thread.join(timeout=10)
             self._consumer_thread = None
+        if self._tier is not None:
+            # stop tier planning before the engine: a demotion order
+            # queued after engine shutdown would never drain
+            self._tier.stop()
         if self.supervisor is not None:
             # stop supervision BEFORE the engine: a lane going dead
             # during shutdown must not trigger a restart/migration race
@@ -632,9 +648,15 @@ class ServingService:
 
     def _on_pool_pressure(self, need: int) -> None:
         """Engine thread, paged admission failed to allocate ``need``
-        pages: LRU-evict idle conversations' kept KV to unblock it."""
+        pages: spill the coldest idle conversations to the warm tier
+        first (their KV survives and comes back via promotion), then
+        LRU-evict to nothing for any shortfall — the pre-tier
+        behavior, and still the only option with SWARMDB_TIER=0."""
         with self._rolling_lock:
-            self._rolling_evict(need)
+            if self._tier is not None:
+                need -= self._tier.demote_now(need)
+            if need > 0:
+                self._rolling_evict(need)
 
     # swarmlint: holds[self._rolling_lock]
     def _rolling_evict(self, need_free: int) -> None:
@@ -654,6 +676,10 @@ class ServingService:
                 eng.rolling_free(st["pages"])
             self._mem.drop(k)
             self.db.metrics.counters["rolling_evictions"].inc()
+            if self._tier is not None:
+                # evicted to NOTHING — the conversation's next turn is
+                # a cold resume (re-prefill from the broker log)
+                self._tier.note_cold(k, len(st["pages"]))
 
     def _rolling_plan(self, key, msg: Message, sampling: SamplingParams,
                       pre_count: int = 0):
@@ -678,8 +704,12 @@ class ServingService:
         with self._rolling_lock:
             epoch = self._rolling_epoch()
             st = self._rolling.get(key)
-            if st is not None and st["epoch"] != epoch:
-                # stale epoch: pool was rebuilt, page ids are dangling
+            if (st is not None and st["epoch"] != epoch
+                    and st.get("pages")):
+                # stale epoch: pool was rebuilt, page ids are dangling.
+                # WARM (host-resident) entries hold no device ids and
+                # survive pool resets by design — the payload re-enters
+                # whatever pool exists at promotion time (ISSUE 19)
                 self._rolling.pop(key, None)
                 st = None
             if st is not None and st.get("in_flight"):
@@ -705,8 +735,18 @@ class ServingService:
                            # exist in neither the KV nor the prompt
                            "await_store": True,
                            "last": time.time()}
-            if st is None or not st.get("pages"):
+            # warm hit (ISSUE 19): the conversation's pages were spilled
+            # to the host store; the resume path below runs unchanged
+            # (st["len"]/tail/msg_count are tier-independent) and the
+            # actual reservation + payload pop happen only after every
+            # delta/fit check has passed
+            warm = (st is not None and not st.get("pages")
+                    and st.get("host") and self._tier is not None)
+            if st is None or (not st.get("pages") and not warm):
                 self._rolling[key] = placeholder
+                if self._tier is not None:
+                    msg.metadata["tier_origin"] = (
+                        "cold" if self._tier.take_cold(key) else "fresh")
                 return "keep", None, None
 
             # atomic (total, delta) — a split length+fetch pair can drop
@@ -719,8 +759,12 @@ class ServingService:
                 logger.debug("rolling restart %s: msg %s not in delta "
                              "(msg_count=%d total=%d)", key, msg.id,
                              st["msg_count"], total)
-                if st["epoch"] == epoch:
+                if st.get("pages") and st["epoch"] == epoch:
                     eng.rolling_free(st["pages"])
+                elif warm:
+                    # the warm payload is obsolete (the restart rebuilds
+                    # the prompt from the full window) — discard it
+                    self._tier.drop_warm(key)
                 self._rolling[key] = placeholder
                 self.db.metrics.counters["rolling_restarts"].inc()
                 return "keep", None, None
@@ -754,8 +798,12 @@ class ServingService:
                              "ptoks=%d max_new=%d max_seq=%d)", key,
                              st["len"], len(ptoks),
                              sampling.max_new_tokens, eng.max_seq)
-                if st["epoch"] == epoch:
+                if st.get("pages") and st["epoch"] == epoch:
                     eng.rolling_free(st["pages"])
+                elif warm:
+                    # the warm payload is obsolete (the restart rebuilds
+                    # the prompt from the full window) — discard it
+                    self._tier.drop_warm(key)
                 self._rolling[key] = placeholder
                 self.db.metrics.counters["rolling_restarts"].inc()
                 return "keep", None, None
@@ -771,8 +819,18 @@ class ServingService:
             total_pages = -(-(st["len"] + len(ptoks)
                               + sampling.max_new_tokens
                               + eng.decode_chunk) // ps)
-            need = (total_pages - len(st["pages"]) if eng.paged
-                    else total_pages)
+            # kept pages by COUNT, not list: a warm entry's pages are
+            # host-resident (st["pages"] is None) but cover exactly
+            # ceil(len/ps) device pages once promoted — same count a
+            # hot entry's kept list holds (engine _retire invariant)
+            kept_n = -(-st["len"] // ps)
+            if warm:
+                # promotion draws the kept pages from the pool TOO (a
+                # hot resume references them in place)
+                need = total_pages
+            else:
+                need = (total_pages - kept_n if eng.paged
+                        else total_pages)
             # claim THIS conversation before evicting: _rolling_evict
             # skips in_flight entries, and without the claim a
             # pool-pressure eviction here could LRU-free the very pages
@@ -799,11 +857,32 @@ class ServingService:
             # the serve mix at S=256 with ~105-token turn deltas)
             self._rolling_delta_ema = (0.8 * self._rolling_delta_ema
                                        + 0.2 * len(ptoks))
+            payload = None
+            if warm:
+                got = self._tier.begin_promote(key, st, epoch)
+                if got is None:
+                    # warm copy lost (store capacity eviction raced) or
+                    # the pool cannot host it even after evicting: the
+                    # conversation resumes COLD — the fresh prefill
+                    # re-derives its KV from the broker log, which PR 8
+                    # proved bit-identical at every chunk boundary
+                    self._rolling[key] = placeholder
+                    msg.metadata["tier_origin"] = (
+                        "cold" if self._tier.take_cold(key) else "fresh")
+                    self.db.metrics.counters["rolling_restarts"].inc()
+                    return "keep", None, None
+                ids, payload = got
+                st["pages"] = list(ids)
+                st["epoch"] = epoch
+                st["host"] = False
+            if self._tier is not None:
+                msg.metadata["tier_origin"] = "warm" if warm else "hot"
             # the observed epoch travels WITH the plan: submit/admission
             # re-validate it against the live pool generation, so a pool
             # reset in the plan->admit window fails the request instead
             # of resuming dangling page ids (ADVICE r4 #2)
-            return "resume", (st["pages"], st["len"], epoch), ptoks
+            return "resume", (st["pages"], st["len"], epoch,
+                              payload), ptoks
 
     def _rolling_store(self, key, pages, written, tail) -> None:
         """on_pages (engine thread, at retirement): adopt the turn's
@@ -861,6 +940,10 @@ class ServingService:
                 if (st.get("pages")
                         and st["epoch"] == self._rolling_epoch()):
                     self.engine.rolling_free(st["pages"])
+                elif st.get("host") and self._tier is not None:
+                    # host-resident state dropped non-clean: the warm
+                    # payload no longer matches the stream — discard
+                    self._tier.drop_warm(key)
 
     # ------------------------------------------------------- window trimming
 
@@ -1111,6 +1194,12 @@ class ServingService:
                         # load (the engine's priority admission, bench swarm100)
                         self.db.metrics.latencies[
                             f"send_to_first_token_prio{priority}_s"].observe(ttft)
+                        # per-tier TTFT (ISSUE 19): warm-hit vs
+                        # cold-resume is THE number swarm1M reports
+                        origin = (msg.metadata or {}).get("tier_origin")
+                        if origin:
+                            self.db.metrics.latencies[
+                                f"tier_ttft_{origin}_s"].observe(ttft)
                 if sampling.stop:
                     _watch_stop(rid, token)
                 if on_token is not None:
@@ -1148,6 +1237,10 @@ class ServingService:
                     req.resume_pages = list(resume[0])
                     req.resume_len = resume[1]
                     req.resume_epoch = resume[2]
+                    # warm-tier promotion payload (ISSUE 19): the host
+                    # bytes admission bulk-inserts into the reserved
+                    # pages before the resume prefill reads them
+                    req.promote_payload = resume[3]
             if n > 1:
                 rid = self._serve_n(msg, req, prompt, sampling, priority, n,
                                     want_logprobs, on_done)
@@ -1568,4 +1661,6 @@ class ServingService:
             "probe_ms": round(probe_ms, 3),
             "backend_id": self.backend_id,
             "engine": self.engine.stats(),
+            "tier": (self._tier.status() if self._tier is not None
+                     else {"enabled": False}),
         }

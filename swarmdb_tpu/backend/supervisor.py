@@ -388,7 +388,7 @@ class LaneSupervisor:
                 sp, max_new_tokens=max(1, sp.max_new_tokens - len(emitted))),
             submitted_at=time.time(),
             resume_pages=None, resume_len=0, resume_epoch=None,
-            keep_pages=False, on_pages=None,
+            keep_pages=False, on_pages=None, promote_payload=None,
         )
         replay.on_token, replay.on_done = self._wrap(tr, attempt)
         return replay

@@ -1,0 +1,467 @@
+"""swarmtier — the three-tier conversation-state hierarchy (ISSUE 19).
+
+ROADMAP item 3 made real: every conversation's KV lived in HBM-resident
+page pools behind a shed-only LRU, which caps the registry at what the
+device pool holds — absurd at the millions-of-idle-conversations scale
+the north star demands. This module manages the spill:
+
+    HOT   device page pool (today's pools, unchanged)
+      |  demote: temperature-ledger victims when the backpressure
+      |  gate's SWARMDB_TIER_DEMOTE watermark trips; the D2H gather
+      |  rides the admission flush wave (engine thread — pool buffers
+      |  are donated by the engine's jits, so no other thread may read
+      |  them)
+      v
+    WARM  host-RAM page store (ops/host_pool.py): raw storage-width
+      |   payloads (int8 + scales on quantized pools) keyed by
+      |   conversation; promotion reserves fresh device pages and
+      |   bulk-device_puts the exact bytes back on next arrival —
+      |   bit-identical by construction
+      v
+    COLD  nothing: the conversation re-prefills idempotently from the
+          broker log on resume (PR 8 proved replay bit-identical at
+          every chunk boundary), so "recompute from the log" is a
+          correct tier by construction
+
+Custody invariants are guarded by swarmpage: a demoted page is
+``host_resident`` (not freed) until its device id returns to the free
+list; double-demote, demote-of-free, use-after-demote and
+promote-unreserved are violations (obs/pagecheck.py).
+
+Threading:
+- the tier WORKER thread only plans (victim selection over the rolling
+  registry, under the service's registry lock) and enqueues demote
+  orders — no device work, no engine-loop sync;
+- ALL device-touching work (the D2H gather of a demotion, the H2D
+  insert of a promotion) executes on the ENGINE thread: orders drain at
+  the start of each admission round (``Engine._admit`` calls
+  ``on_tier_drain`` right after the pending-free flush) and promotion
+  payloads ride the resumed :class:`GenRequest` into admission;
+- the synchronous path ``demote_now`` runs when paged admission
+  actually failed to allocate (``ServingService._on_pool_pressure``,
+  engine thread, registry lock held): spilling idle conversations is
+  strictly better than the old evict-to-nothing, which stays as the
+  fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import TRACER
+
+logger = logging.getLogger("swarmdb_tpu.backend")
+
+__all__ = ["TierManager", "select_victims", "tiering_enabled"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def tiering_enabled() -> bool:
+    """SWARMDB_TIER master switch (default ON — the tier only engages
+    on rolling-KV paged engines, and a demotion is observably identical
+    to today's behavior except the conversation comes back warm instead
+    of cold)."""
+    return os.environ.get("SWARMDB_TIER", "1") not in ("", "0")
+
+
+def select_victims(cands: Sequence[Tuple[Any, int, float, int]],
+                   need_pages: int, now: float,
+                   min_idle_s: float) -> List[Any]:
+    """Temperature-ordered demotion victims (pure — unit-tested).
+
+    ``cands``: ``(key, n_pages, last_touch_ts, touches)`` per
+    device-resident idle conversation. Coldest first: oldest last touch,
+    then fewest lifetime touches (the ledger's two signals). Entries
+    idle less than ``min_idle_s`` are never picked — the hysteresis
+    guard that stops an oscillating load from demoting a conversation
+    that is about to arrive again (thrash). Returns keys covering at
+    least ``need_pages`` pages (or every eligible key if they can't).
+    """
+    eligible = [c for c in cands if now - c[2] >= min_idle_s]
+    eligible.sort(key=lambda c: (c[2], c[3]))
+    out: List[Any] = []
+    got = 0
+    for key, n_pages, _last, _touches in eligible:
+        if got >= need_pages:
+            break
+        out.append(key)
+        got += n_pages
+    return out
+
+
+class TierManager:
+    """Per-lane tier manager: owns the warm store, the cold ledger,
+    victim selection, and the demote/promote counters.
+
+    Wired by :class:`ServingService` when rolling KV is enabled on a
+    single-shard paged engine (the same preconditions as rolling resume
+    itself — warm custody is registry custody)."""
+
+    def __init__(self, service: Any, engine: Any,
+                 store: Optional[Any] = None) -> None:
+        from ..ops.host_pool import HostPageStore
+
+        self.service = service
+        self.engine = engine
+        self.store = store if store is not None else HostPageStore()
+        self.min_idle_s = _env_float("SWARMDB_TIER_MIN_IDLE_S", 0.5)
+        # cold ledger: conversations evicted out of the hierarchy, with
+        # the page footprint they held — bounded LRU (swarm1M registers
+        # ~1M conversations; the ledger is accounting, not correctness:
+        # an aged-out key just counts as "fresh" instead of "cold")
+        self._cold_cap = int(_env_float("SWARMDB_TIER_COLD_TRACK", 200000))
+        self._cold: "OrderedDict[Any, Tuple[float, int]]" = OrderedDict()
+        self._cold_lock = threading.Lock()
+        # demote orders planned by the worker, executed by the engine
+        # thread at the next admission flush wave
+        self._orders: "deque[Any]" = deque()
+        self._need = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.demotions = 0
+        self.promotions = 0
+        self.cold_resumes = 0
+        self.warm_evictions = 0
+        # wire the engine hooks: the gate's demote watermark signals the
+        # worker; the admission flush wave drains the planned orders
+        engine.on_tier_pressure = self.notify_pressure
+        engine.on_tier_drain = self.drain_engine
+        # close the swarmmem loop: the what-if warm_tier_model gets a
+        # measured counterpart (memprof.tier_validation)
+        try:
+            from ..obs.memprof import memprof
+            memprof().bind_tier(self.status)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "TierManager":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="swarmdb-tier", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # ---------------------------------------------------- pressure / worker
+
+    def notify_pressure(self, need: int) -> None:
+        """Engine thread (backpressure gate, demote watermark tripped):
+        non-blocking signal — planning happens on the worker."""
+        self._need = max(self._need, int(need))
+        if self._thread is None:
+            self.start()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            need, self._need = self._need, 0
+            if need <= 0:
+                continue
+            try:
+                self._plan(need)
+            except Exception:
+                logger.exception("tier demotion planning failed")
+
+    def _plan(self, need: int) -> None:
+        """Worker thread: pick victims under the registry lock, claim
+        them (``tier_demote`` + ``in_flight`` so no plan/evict races the
+        order), and queue them for the engine's flush wave."""
+        svc = self.service
+        if svc._rolling is None:
+            return
+        now = time.time()
+        epoch = self.engine.pool_epoch()
+        mem = svc._mem
+        touch_by_key: Dict[Any, int] = {}
+        try:
+            for row in mem.snapshot():
+                touch_by_key[row[0]] = int(row[2])
+        except Exception:
+            pass
+        with svc._rolling_lock:
+            cands = [
+                (k, len(st["pages"]), st["last"],
+                 touch_by_key.get(k, 0))
+                for k, st in svc._rolling.items()
+                if st.get("pages") and not st.get("in_flight")
+                and st["epoch"] == epoch
+            ]
+            victims = select_victims(cands, need, now, self.min_idle_s)
+            for k in victims:
+                st = svc._rolling[k]
+                st["in_flight"] = True
+                st["tier_demote"] = True
+                self._orders.append(k)
+
+    # ------------------------------------------------- engine-thread execute
+
+    def drain_engine(self) -> None:
+        """ENGINE THREAD, start of an admission round (right after the
+        pending-free flush): execute the worker's planned demotions —
+        the D2H gathers ride the wave the engine already syncs on."""
+        if not self._orders:  # swarmlint: disable=SWL303 -- benign racy emptiness peek; the drain below re-reads under the lock
+            return
+        svc = self.service
+        with svc._rolling_lock:
+            while self._orders:
+                key = self._orders.popleft()
+                st = svc._rolling.get(key) if svc._rolling else None
+                if (st is None or not st.get("tier_demote")
+                        or not st.get("pages")):
+                    continue
+                st.pop("tier_demote", None)
+                self._demote_locked(key, st)
+
+    def demote_now(self, need: int) -> int:
+        """ENGINE THREAD, registry lock HELD (the pool-pressure hook):
+        paged admission failed to allocate ``need`` pages — spill the
+        coldest idle conversations instead of evicting them to nothing.
+        Returns pages freed; the caller falls back to cold eviction for
+        any shortfall."""
+        svc = self.service
+        if svc._rolling is None:
+            return 0
+        now = time.time()
+        epoch = self.engine.pool_epoch()
+        cands = [
+            (k, len(st["pages"]), st["last"], 0)
+            for k, st in svc._rolling.items()
+            if st.get("pages") and not st.get("in_flight")
+            and st["epoch"] == epoch
+        ]
+        freed = 0
+        for key in select_victims(cands, need, now, self.min_idle_s):
+            st = svc._rolling.get(key)
+            if st is None or not st.get("pages"):
+                continue
+            st["in_flight"] = True
+            st.pop("tier_demote", None)
+            freed += self._demote_locked(key, st)
+        return freed
+
+    def _demote_locked(self, key: Any, st: Dict[str, Any]) -> int:
+        """Engine thread, registry lock held: gather the entry's pages
+        to host RAM, hand them to the warm store, free the device ids.
+        Any failure degrades to the old cold eviction — never a leak."""
+        from ..ops.paged_kv import pool_gather_pages
+
+        eng = self.engine
+        pages = list(st["pages"])
+        if st["epoch"] != eng.pool_epoch():
+            # pool rebuilt under the claim: the ids are dangling — the
+            # reset already reclaimed them; drop the entry cold
+            self._finish_cold(key, st, len(pages), free=False)
+            return 0
+        pc = getattr(eng, "_pagecheck", None)
+        if pc is not None:
+            pc.on_demote(pages, key)
+        try:
+            k_pay = pool_gather_pages(eng.cache["k"], pages)
+            v_pay = pool_gather_pages(eng.cache["v"], pages)
+        except Exception:
+            logger.exception("tier demote gather failed for %r", key)
+            self._finish_cold(key, st, len(pages), free=True)
+            return len(pages)
+        evicted = self.store.put(key, k_pay, v_pay, len(pages), st["len"])
+        for ek in evicted:
+            if ek == key:
+                continue
+            # a warm entry fell out of the store to make room: its
+            # conversation just went cold
+            self._warm_to_cold(ek)
+        if key in evicted:
+            # entry alone exceeds warm capacity — straight to cold
+            self._finish_cold(key, st, len(pages), free=True)
+            return len(pages)
+        eng.rolling_free(pages)
+        st["pages"] = None
+        st["host"] = True
+        st["in_flight"] = False
+        st["last"] = st.get("last", time.time())
+        self.demotions += 1
+        self.service.db.metrics.counters["tier_demotions"].inc()
+        self.service._mem.resident(key, 0)
+        TRACER.instant("tier.demote", cat="tier",
+                       args={"pages": len(pages)})
+        eng.flight.record_event(
+            {"kind": "tier.demote", "ts": time.time(),
+             "pages": len(pages), "shard": eng.flight_shard})
+        return len(pages)
+
+    def _finish_cold(self, key: Any, st: Dict[str, Any], n_pages: int,
+                     free: bool) -> None:
+        """Registry lock held: drop the entry out of the hierarchy."""
+        eng = self.engine
+        if free and st.get("pages") \
+                and st["epoch"] == eng.pool_epoch():
+            eng.rolling_free(st["pages"])
+        self.service._rolling.pop(key, None)
+        self.service._mem.drop(key)
+        pc = getattr(eng, "_pagecheck", None)
+        if pc is not None:
+            pc.on_host_drop(key)
+        self.note_cold(key, n_pages)
+
+    def _warm_to_cold(self, key: Any) -> None:
+        """A warm store entry was capacity-evicted (lock held by the
+        demote path, or the service's finalize path): its registry
+        entry — if still host-resident — dies with it."""
+        svc = self.service
+        st = svc._rolling.get(key) if svc._rolling is not None else None
+        n = 0
+        if st is not None and st.get("host") and not st.get("pages"):
+            ps = max(1, self.engine.rolling_page_size())
+            n = -(-st["len"] // ps)
+            svc._rolling.pop(key, None)
+            svc._mem.drop(key)
+        pc = getattr(self.engine, "_pagecheck", None)
+        if pc is not None:
+            pc.on_host_drop(key)
+        self.warm_evictions += 1
+        self.service.db.metrics.counters["tier_warm_evictions"].inc()
+        self.note_cold(key, n)
+
+    # ------------------------------------------------------ promotion (plan)
+
+    def begin_promote(self, key: Any, st: Dict[str, Any],
+                      epoch: int) -> Optional[Tuple[List[int], Any]]:
+        """Service thread, registry lock HELD (``_rolling_plan``): a
+        warm-resident conversation arrived — reserve device pages and
+        return ``(page_ids, payload)`` for the engine's H2D insert, or
+        ``None`` if the warm copy is gone / the pool can't cover it
+        (the caller restarts the conversation cold)."""
+        eng = self.engine
+        entry = self.store.pop(key)
+        if entry is None:
+            return None
+        alloc = eng.paged.allocator
+        n = entry.n_pages
+        ids = alloc.reserve(n)
+        if len(ids) < n:
+            try:
+                # make room the same way admission does: spill/evict
+                # other idle conversations (we hold the registry lock)
+                self.service._rolling_evict(n - len(ids))
+                ids += alloc.reserve(n - len(ids))
+            except BaseException:
+                # the partial reservation must not leak on a raise —
+                # nothing owns these ids yet
+                alloc.add_free(ids)
+                raise
+        if len(ids) < n:
+            alloc.add_free(ids)
+            pc = getattr(eng, "_pagecheck", None)
+            if pc is not None:
+                pc.on_host_drop(key)
+            self.note_cold(key, n)
+            return None
+        pc = getattr(eng, "_pagecheck", None)
+        if pc is not None:
+            pc.on_promote(ids, key)
+        self.promotions += 1
+        self.service.db.metrics.counters["tier_promotions"].inc()
+        self.service._mem.resident(key, n)
+        TRACER.instant("tier.promote", cat="tier", args={"pages": n})
+        eng.flight.record_event(
+            {"kind": "tier.promote", "ts": time.time(), "pages": n,
+             "shard": eng.flight_shard})
+        return ids, (entry.k, entry.v)
+
+    def drop_warm(self, key: Any) -> None:
+        """The warm copy is obsolete (conversation restarted fresh or
+        finalized non-clean) — discard without cold accounting."""
+        self.store.drop(key)
+        pc = getattr(self.engine, "_pagecheck", None)
+        if pc is not None:
+            pc.on_host_drop(key)
+
+    # ---------------------------------------------------------- cold ledger
+
+    def note_cold(self, key: Any, n_pages: int = 0) -> None:
+        with self._cold_lock:
+            self._cold.pop(key, None)
+            self._cold[key] = (time.time(), int(n_pages))
+            while len(self._cold) > self._cold_cap:
+                self._cold.popitem(last=False)
+
+    def take_cold(self, key: Any) -> bool:
+        """A fresh prefill is about to serve ``key`` — was it evicted
+        out of the hierarchy (a COLD RESUME, re-prefilled from the
+        broker log) rather than brand new?"""
+        with self._cold_lock:
+            hit = self._cold.pop(key, None)
+        if hit is None:
+            return False
+        self.cold_resumes += 1
+        self.service.db.metrics.counters["tier_cold_resumes"].inc()
+        TRACER.instant("tier.cold_resume", cat="tier")
+        self.engine.flight.record_event(
+            {"kind": "tier.cold_resume", "ts": time.time(),
+             "shard": self.engine.flight_shard})
+        return True
+
+    # ------------------------------------------------------------------ intro
+
+    def pages_by_tier(self) -> Dict[str, int]:
+        """Flag-independent gauge triple. hot = device pages out of the
+        free list (pool custody: slots + prefix cache + registry); warm
+        = spilled pages in the host store; cold = last-known footprint
+        of conversations evicted out of the hierarchy."""
+        eng = self.engine
+        hot = 0
+        if eng.paged is not None:
+            hot = max(0, eng.paged.num_pages - 1
+                      - eng.paged.allocator.free_count())
+        with self._cold_lock:
+            cold = sum(n for _, n in self._cold.values())
+        return {"hot": hot, "warm": self.store.page_count(), "cold": cold}
+
+    def status(self) -> Dict[str, Any]:
+        eng = self.engine
+        with self._cold_lock:
+            cold_conversations = len(self._cold)
+        return {
+            "enabled": True,
+            "pages": self.pages_by_tier(),
+            "warm_store": self.store.stats(),
+            "cold_conversations": cold_conversations,
+            "counters": {
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "cold_resumes": self.cold_resumes,
+                "warm_evictions": self.warm_evictions,
+            },
+            "warm_hit_rate": (
+                self.promotions / max(1, self.promotions
+                                      + self.cold_resumes)),
+            "config": {
+                "min_idle_s": self.min_idle_s,
+                "demote_watermark": getattr(eng, "_bp_demote", None),
+                "warm_capacity_bytes": self.store.capacity_bytes,
+            },
+            "pending_orders": len(self._orders),  # swarmlint: disable=SWL303 -- racy gauge read; a torn count costs one stale sample
+        }

@@ -617,6 +617,7 @@ def _mem_summary(path: str, dump: Dict[str, Any]) -> Dict[str, Any]:
         "total_pages": occ.get("total_pages"),
         "headroom_pages": occ.get("headroom_pages"),
         "conversations": conv.get("by_state"),
+        "tier_validation": dump.get("tier_validation"),
         "verdict": dump.get("verdict"),
     }
 
@@ -692,12 +693,23 @@ def memory_report(paths: Sequence[str]) -> Dict[str, Any]:
                           "device_capacity_pages")},
             "warm_tier": data.get("warm_tier"),
             "cold_resume": data.get("cold_resume"),
+            # predicted-vs-measured warm tier (ISSUE 19): the what-if
+            # model's promised hit-rate gain against the promotion hit
+            # rate the live tier actually delivered, with a drift flag
+            # when the model has gone stale
+            "tier_validation": data.get("tier_validation"),
             "verdict": data.get("verdict"),
         })
     return {
         "kind": "swarmdb.obs.memory",
         "version": 1,
         "dumps": dumps,
+        # dumps whose live tier disagreed with the what-if model by
+        # more than SWARMDB_MEM_TIER_DRIFT — re-run sizing before
+        # trusting the verdict line
+        "tier_drift_flagged": [
+            d["path"] for d in dumps
+            if (d.get("tier_validation") or {}).get("drifted")],
     }
 
 

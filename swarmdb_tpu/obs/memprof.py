@@ -556,6 +556,16 @@ class MemProfiler:
         # KV bytes per page (engine wiring sets this from its cache
         # buffers) — prices the warm tier's re-admission device_put
         self.page_bytes = 0
+        # measured warm tier (ISSUE 19): TierManager binds its status()
+        # so tier_validation() can close the loop — the what-if model
+        # above vs the tier that actually shipped
+        self._tier_status: Optional[Callable[[], Dict[str, Any]]] = None
+
+    def bind_tier(self, status_fn: Callable[[], Dict[str, Any]]) -> None:
+        """Register the live TierManager's ``status`` callable. Always
+        bound (not flag-gated): the validation compares two cheap
+        snapshots at read time, records nothing on the hot path."""
+        self._tier_status = status_fn
 
     # ------------------------------------------------------------ wiring
 
@@ -762,6 +772,56 @@ class MemProfiler:
                 f"(hit rate {base * 100:.1f}% at 1x; no modeled warm "
                 f"tier adds >=1%)")
 
+    def tier_validation(self) -> Optional[Dict[str, Any]]:
+        """Predicted vs measured warm tier (ISSUE 19 loop closure).
+
+        The what-if model priced a ghost warm tier from sampled reuse
+        distances; now a real one is running. Among arrivals that MISSED
+        the device pool, the model predicts the share the warm tier
+        recovers as ``extra_hit_rate / (1 - device_hit_rate)``; the
+        tier manager measures the same share directly as
+        ``promotions / (promotions + cold_resumes)``. Drift beyond
+        ``SWARMDB_MEM_TIER_DRIFT`` (default 0.2 absolute) flags the
+        model as stale — wrong sampling rate, non-stationary workload,
+        or a warm store sized below what the curve assumed.
+        """
+        if self._tier_status is None:
+            return None
+        try:
+            st = self._tier_status()
+        except Exception:
+            return None
+        counters = st.get("counters", {})
+        promotions = int(counters.get("promotions", 0))
+        cold = int(counters.get("cold_resumes", 0))
+        warm_pages = int(st.get("pages", {}).get("warm", 0))
+        resumes = promotions + cold
+        out: Dict[str, Any] = {
+            "warm_pages": warm_pages,
+            "promotions": promotions,
+            "cold_resumes": cold,
+            "measured_warm_share": (round(promotions / resumes, 4)
+                                    if resumes else None),
+            "predicted_warm_share": None,
+            "drift": None,
+            "drifted": False,
+        }
+        if self.sampler.sampled and warm_pages > 0:
+            c_dev = self.device_capacity()
+            base = self.sampler.hit_rate_at(c_dev)
+            extra = max(0.0, self.sampler.hit_rate_at(c_dev + warm_pages)
+                        - base)
+            miss = max(1e-9, 1.0 - base)
+            out["predicted_warm_share"] = round(min(1.0, extra / miss), 4)
+        if (out["measured_warm_share"] is not None
+                and out["predicted_warm_share"] is not None
+                and resumes >= _env_int("SWARMDB_MEM_TIER_MIN_RESUMES", 20)):
+            drift = out["measured_warm_share"] - out["predicted_warm_share"]
+            out["drift"] = round(drift, 4)
+            out["drifted"] = abs(drift) > _env_float(
+                "SWARMDB_MEM_TIER_DRIFT", 0.2)
+        return out
+
     # ------------------------------------------------------------- surfaces
 
     def counters_snapshot(self) -> Dict[str, Any]:
@@ -802,6 +862,7 @@ class MemProfiler:
                           curve=self.sampler.curve(c_dev)),
             "warm_tier": self.warm_tier_model(),
             "cold_resume": self.cold_resume_model(),
+            "tier_validation": self.tier_validation(),
             "verdict": self.verdict(),
         }
 
@@ -826,6 +887,7 @@ class MemProfiler:
             "curve": {str(r["capacity_x"]): r["hit_rate"]
                       for r in self.sampler.curve(c_dev)},
             "sampled_accesses": self.sampler.sampled,
+            "tier_validation": self.tier_validation(),
             "verdict": self.verdict(),
         }
 

@@ -107,6 +107,9 @@ class _Pool:
         # slot -> [(page, epoch)] recorded when the row was built
         self.row_stamps: Dict[int, List[Tuple[int, int]]] = {}
         self.owner_rids: Dict[int, str] = {}
+        # conversation keys whose pages were demoted to the warm tier
+        # (cleared by on_promote / on_host_drop)
+        self.host_keys: set = set()
         self.lane: Optional[str] = None
         self.churn_allocated = 0
         self.churn_freed = 0
@@ -284,13 +287,16 @@ class PageCheckRegistry:
         with self._mu:
             pool = self._pools[pool_id]
             bad: List[Tuple[int, str]] = []
+            demoted: List[int] = []
             for p in pages:
                 pg = pool.pages.get(p)
                 if pg is None:
                     bad.append((p, "not a page of this pool"))
+                elif pg.state == "host_resident":
+                    demoted.append(p)
                 elif pg.state not in ("owned", "cached"):
                     bad.append((p, f"state={pg.state}"))
-            v = None
+            v = v2 = None
             if bad:
                 detail = ", ".join(f"{p} ({why})" for p, why in bad)
                 v = self._violation(
@@ -300,7 +306,17 @@ class PageCheckRegistry:
                     f"foreign page(s): {detail} — the row would alias "
                     f"pages this conversation does not own",
                     [p for p, _ in bad])
+            if demoted:
+                v2 = self._violation(
+                    pool, "use-after-demote",
+                    f"slot {slot} (rid="
+                    f"{pool.owner_rids.get(slot)}) references demoted "
+                    f"page(s) {demoted} — their contents left for the "
+                    f"warm tier; the device copy is about to be freed "
+                    f"and reallocated (promote first, or re-prefill "
+                    f"cold)", demoted)
         self._emit(v)
+        self._emit(v2)
 
     def stamp_row(self, pool_id: int, slot: int,
                   pages: Sequence[int]) -> None:
@@ -357,6 +373,91 @@ class PageCheckRegistry:
                 if pg is not None and pg.state == "owned":
                     pg.state = "cached"
                     pg.owner_slot = None
+
+    # -- cross-tier custody (ISSUE 19: SWL801-805 learn the spill) ------
+
+    def on_demote(self, pool_id: int, pages: Sequence[int],
+                  key: Any = None) -> None:
+        """Conversation ``key``'s pages are leaving for the warm tier:
+        their contents were gathered to host RAM and the device ids are
+        about to return to the free list. Shadow state ``owned``/
+        ``cached`` -> ``host_resident`` — a demoted page is NOT freed
+        yet, and referencing it is a distinct ``use-after-demote``
+        crime. Demoting a page you do not hold (free/reserved/trash) or
+        demoting the same key twice without an intervening promote/drop
+        are violations."""
+        with self._mu:
+            pool = self._pools[pool_id]
+            dbl = key is not None and key in pool.host_keys
+            bad, twice = [], []
+            for p in pages:
+                pg = pool.pages.get(p)
+                if pg is None or pg.state in ("free", "reserved",
+                                              "trash"):
+                    bad.append(p)
+                    continue
+                if pg.state == "host_resident":
+                    twice.append(p)
+                    continue
+                pg.state = "host_resident"
+                pg.owner_slot = None
+            if key is not None:
+                pool.host_keys.add(key)
+            v1 = v2 = None
+            if bad:
+                v1 = self._violation(
+                    pool, "demote-of-free",
+                    f"demotion gathered page(s) {bad} the conversation "
+                    f"does not hold — the spilled payload would carry "
+                    f"another owner's (or freed) pages to the warm "
+                    f"tier", bad)
+            if twice or dbl:
+                v2 = self._violation(
+                    pool, "double-demote",
+                    f"key {key!r} demoted twice (pages {twice or pages}"
+                    f") — two warm-tier payloads would claim the same "
+                    f"conversation and the second gather reads pages "
+                    f"already spilled", list(twice or pages))
+        self._emit(v1)
+        self._emit(v2)
+
+    def on_promote(self, pool_id: int, pages: Sequence[int],
+                   key: Any = None) -> None:
+        """Warm payload re-admitted: ``pages`` are the freshly RESERVED
+        device ids the payload was device_put into; they become rolling
+        custody (``cached``). Promoting into pages the allocator did
+        not reserve is a violation — the insert would overwrite live
+        state."""
+        with self._mu:
+            pool = self._pools[pool_id]
+            self._epoch += 1
+            bad = []
+            for p in pages:
+                pg = pool.pages.get(p)
+                if pg is None:
+                    continue
+                if pg.state != "reserved":
+                    bad.append(p)
+                pg.state = "cached"
+                pg.epoch = self._epoch
+                pg.owner_slot = None
+            if key is not None:
+                pool.host_keys.discard(key)
+            v = None
+            if bad:
+                v = self._violation(
+                    pool, "promote-unreserved",
+                    f"promotion inserted into page(s) {bad} that were "
+                    f"not reserved — the H2D bulk insert would "
+                    f"overwrite pages another conversation owns", bad)
+        self._emit(v)
+
+    def on_host_drop(self, pool_id: int, key: Any) -> None:
+        """A warm entry left the host store WITHOUT promotion (capacity
+        eviction or finalize) — the conversation went cold. Clears the
+        double-demote guard for the key."""
+        with self._mu:
+            self._pools[pool_id].host_keys.discard(key)
 
     def on_pin(self, pool_id: int, pages: Sequence[int]) -> None:
         with self._mu:

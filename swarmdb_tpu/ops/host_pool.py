@@ -1,0 +1,161 @@
+"""Warm-tier host-RAM page store (ISSUE 19 — swarmtier).
+
+The middle rung of the three-tier conversation-state hierarchy: pages
+demoted out of the device pool land here as raw numpy payloads (storage
+width — int8 + scales on quantized pools, so a spilled page costs half
+the bf16 bytes swarmmem's ``warm_tier_model`` already prices), keyed by
+conversation. Promotion pops the payload and bulk-``device_put``s it
+back into freshly reserved device pages; eviction out of THIS store is
+the warm→cold transition (the conversation falls back to idempotent
+re-prefill from the broker log — PR 8 proved that replay bit-identical).
+
+Capacity is byte-priced: ``SWARMDB_TIER_WARM_MB`` (default 256) divided
+by the live pool's ``pool_page_bytes`` (k+v across layers, scales
+included). The store is plain LRU over conversations — temperature-aware
+VICTIM selection happens on the device side (backend/tiering.py picks
+who gets demoted); once spilled, recency is the only signal left.
+
+Thread-safe: the engine thread gathers payloads in, the service thread
+(``_rolling_plan``) pops them out at arrival time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils.sync import make_lock
+
+
+def warm_capacity_bytes() -> int:
+    """Resolve SWARMDB_TIER_WARM_MB (default 256 MiB; 0 disables the
+    warm tier entirely — demotions fall straight through to cold)."""
+    try:
+        mb = float(os.environ.get("SWARMDB_TIER_WARM_MB", "256"))
+    except ValueError:
+        mb = 256.0
+    return max(0, int(mb * (1 << 20)))
+
+
+class WarmEntry(NamedTuple):
+    """One spilled conversation: raw k/v payloads for ``n_pages`` pages.
+
+    ``k``/``v`` are :func:`ops.paged_kv.pool_gather_pages` outputs —
+    ``(int8 data, f32 scale)`` tuples on quantized pools, a single array
+    on plain pools. ``length`` is the token count the pages cover (the
+    registry's ``st["len"]``); promotion must reserve exactly
+    ``n_pages`` device pages to rehydrate it.
+    """
+
+    k: Any
+    v: Any
+    n_pages: int
+    length: int
+    nbytes: int
+
+
+def _payload_bytes(payload: Any) -> int:
+    if isinstance(payload, tuple):
+        return sum(int(np.asarray(p).nbytes) for p in payload)
+    return int(np.asarray(payload).nbytes)
+
+
+class HostPageStore:
+    """LRU byte-capped map: conversation key -> :class:`WarmEntry`."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 label: str = "warm") -> None:
+        self.capacity_bytes = (warm_capacity_bytes()
+                               if capacity_bytes is None
+                               else int(capacity_bytes))
+        self.label = label
+        self._lock = make_lock(f"host_pool.{label}")
+        self._entries: "OrderedDict[Any, WarmEntry]" = OrderedDict()
+        self._bytes = 0
+        self._puts = 0
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    # ------------------------------------------------------------- write
+    def put(self, key: Any, k_payload: Any, v_payload: Any,
+            n_pages: int, length: int) -> List[Any]:
+        """Store a demoted conversation; returns the keys EVICTED to make
+        room (the caller counts them as warm→cold transitions). A key
+        already present is replaced (latest demote wins). If the entry
+        alone exceeds capacity it is not stored and ``[key]`` is
+        returned — the demote degenerates to a cold eviction.
+        """
+        nbytes = _payload_bytes(k_payload) + _payload_bytes(v_payload)
+        entry = WarmEntry(k_payload, v_payload, int(n_pages),
+                          int(length), nbytes)
+        evicted: List[Any] = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            if nbytes > self.capacity_bytes:
+                return [key]
+            while self._bytes + nbytes > self.capacity_bytes and self._entries:
+                victim, ventry = self._entries.popitem(last=False)
+                self._bytes -= ventry.nbytes
+                self._evictions += 1
+                evicted.append(victim)
+            self._entries[key] = entry
+            self._bytes += nbytes
+            self._puts += 1
+        return evicted
+
+    # -------------------------------------------------------------- read
+    def pop(self, key: Any) -> Optional[WarmEntry]:
+        """Remove and return the entry (promotion consumes it)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                self._misses += 1
+                return None
+            self._bytes -= entry.nbytes
+            self._hits += 1
+            return entry
+
+    def has(self, key: Any) -> bool:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)  # arrival interest = touch
+                return True
+            return False
+
+    def drop(self, key: Any) -> bool:
+        """Discard without counting a hit/miss (cold finalize paths)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return False
+            self._bytes -= entry.nbytes
+            return True
+
+    # ------------------------------------------------------------- intro
+    def page_count(self) -> int:
+        with self._lock:
+            return sum(e.n_pages for e in self._entries.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "pages": sum(e.n_pages for e in self._entries.values()),
+                "bytes": self._bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "puts": self._puts,
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+            }

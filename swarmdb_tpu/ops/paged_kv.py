@@ -483,6 +483,56 @@ def pool_insert_pages(pool: Any, flat_ids: jnp.ndarray,
     return pool.at[:, flat_ids].set(dense_pages.astype(pool.dtype))
 
 
+def pool_gather_pages(pool: Any, ids: Sequence[int]) -> Any:
+    """RAW payload of ``ids`` pages across all layers, as host numpy.
+
+    The warm-tier spill format (ISSUE 19): pages leave the device at
+    STORAGE width — int8 payload + f32 scales on quantized pools (the
+    page spills at half the bf16 byte cost), pool dtype verbatim on
+    plain pools. Reinserting the same payload via :func:`pool_insert_raw`
+    is bit-identical: no dequant/requant round trip happens in either
+    direction.
+
+    Returns ``(data [L, n, ps, Hkv, D], scale [L, n, Hkv])`` numpy
+    tuple for :class:`QuantPool`, else a single ``[L, n, ps, Hkv, D]``
+    numpy array. Caller must run this on the engine thread — the gather
+    reads pool buffers that engine jits donate.
+    """
+    n = len(ids)
+    # pad the index to the next power of two with the trash page (0):
+    # an advanced-index gather compiles per index LENGTH, and demotion
+    # victims come in arbitrary page counts — unpadded, every new count
+    # is a fresh XLA compile on the admission/eviction path (measured
+    # as multi-ms stalls riding warm-hit TTFT). Pow2 padding bounds the
+    # variants at ~log2(pool) per dtype; the pad rows are sliced off
+    # host-side below.
+    padded = max(1, 1 << (n - 1).bit_length()) if n else 1
+    idx = np.zeros(padded, np.int32)
+    idx[:n] = list(ids)
+    if isinstance(pool, QuantPool):
+        return (np.asarray(jax.device_get(pool.data[:, idx]))[:, :n],
+                np.asarray(jax.device_get(pool.scale[:, idx]))[:, :n])
+    return np.asarray(jax.device_get(pool[:, idx]))[:, :n]
+
+
+def pool_insert_raw(pool: Any, flat_ids: jnp.ndarray, payload: Any) -> Any:
+    """Reinsert a :func:`pool_gather_pages` payload at ``flat_ids``.
+
+    The warm-tier promotion primitive: payload is already at storage
+    width, so the insert is a plain ``.at[].set`` — the EXACT bytes that
+    left the pool come back (quantized pools: int8 + scales set
+    separately, no requantization). jit-safe; the engine wraps this in a
+    donated dispatch so promotion rides the same buffer-reuse path as
+    prefill inserts.
+    """
+    if isinstance(pool, QuantPool):
+        q, s = payload
+        return QuantPool(
+            pool.data.at[:, flat_ids].set(jnp.asarray(q, jnp.int8)),
+            pool.scale.at[:, flat_ids].set(jnp.asarray(s, jnp.float32)))
+    return pool.at[:, flat_ids].set(jnp.asarray(payload, pool.dtype))
+
+
 def paged_write_chunk(
     k_pages: jnp.ndarray,    # [L, P, ps, Hkv, D]
     v_pages: jnp.ndarray,

@@ -175,6 +175,12 @@ class ShardLaneGroup:
         # adopted (deadline/retry budgets, migration tracking) and
         # routing excludes quarantined lanes.
         self.supervisor = None
+        # tier-aware routing hook (ISSUE 19): GenRequest -> lane index
+        # whose warm store holds the request's conversation, or None.
+        # A warm-resident lane beats the least-loaded cold lane — the
+        # promotion stays a host->device copy instead of a full
+        # re-prefill on a lane that never saw the conversation.
+        self.tier_locator: Optional[Callable[[GenRequest], Optional[int]]] = None
         self._rr = 0
         self._rr_lock = make_lock("parallel.lanes.ShardLaneGroup._rr_lock")
         for idx, eng in enumerate(lanes):
@@ -264,6 +270,18 @@ class ShardLaneGroup:
             # on the fallback lane) until the home lane is re-admitted
             j = ok[request.shard_hint % len(ok)]
             return j, self.lanes[j]
+        if self.tier_locator is not None:
+            # tier-aware: land on the lane already holding the
+            # conversation's warm pages (hint takes precedence above —
+            # page custody beats payload locality)
+            try:
+                t = self.tier_locator(request)
+            except Exception:
+                t = None
+            if t is not None:
+                t = t % len(self.lanes)
+                if t in ok:
+                    return t, self.lanes[t]
         # least-loaded admissible lane; racy reads are fine (load balance
         # is a heuristic, correctness never depends on it). Round-robin
         # tiebreak so an idle group still spreads arrivals.

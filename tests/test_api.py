@@ -880,3 +880,80 @@ def test_admin_llm_backend_route(tmp_path):
         assert db.agents_for_backend("tpu-0") == ["bot"]
 
     api_drive(drive, tmp_path)
+
+
+def test_admin_tiers_and_tier_metrics(tmp_path):
+    """GET /admin/tiers + the swarmdb_tier_* /metrics lines (ISSUE 19
+    satellite): with a TierManager attached both render its status();
+    without one the gauges stay FLAG-INDEPENDENT — hot derives from the
+    page allocator, warm/cold render 0, counters render 0 — so
+    dashboards keep a stable series across deployments."""
+    import types
+
+    from swarmdb_tpu.ops.paged_kv import PageAllocator
+
+    status = {
+        "enabled": True,
+        "pages": {"hot": 12, "warm": 7, "cold": 140},
+        "warm_store": {"entries": 3, "bytes": 4096,
+                       "capacity_bytes": 8192, "hits": 2, "misses": 1},
+        "cold_conversations": 5,
+        "counters": {"demotions": 9, "promotions": 4,
+                     "cold_resumes": 2, "warm_evictions": 1},
+        "warm_hit_rate": 4 / 6,
+        "config": {"min_idle_s": 0.5, "demote_watermark": 0.85,
+                   "warm_capacity_bytes": 8192},
+        "pending_orders": 0,
+    }
+    with_tier = types.SimpleNamespace(
+        _tier=types.SimpleNamespace(status=lambda: dict(status)),
+        engine=types.SimpleNamespace(paged=None, _prefix=None))
+
+    async def drive_on(client, db):
+        headers = await get_token(client, "admin", "pw")
+        # admin-only
+        user = await get_token(client, "user", "pw")
+        r = await client.get("/admin/tiers", headers=user)
+        assert r.status == 403
+        r = await client.get("/admin/tiers", headers=headers)
+        assert r.status == 200
+        body = await r.json()
+        assert body["enabled"] is True
+        assert body["pages"] == {"hot": 12, "warm": 7, "cold": 140}
+        assert body["counters"]["demotions"] == 9
+        assert body["warm_hit_rate"] == pytest.approx(4 / 6)
+        assert body["config"]["demote_watermark"] == 0.85
+        r = await client.get("/metrics")
+        m = await r.text()
+        assert 'swarmdb_tier_pages{tier="hot"} 12' in m
+        assert 'swarmdb_tier_pages{tier="warm"} 7' in m
+        assert 'swarmdb_tier_pages{tier="cold"} 140' in m
+        assert "swarmdb_tier_demotions_total 9" in m
+        assert "swarmdb_tier_promotions_total 4" in m
+        assert "swarmdb_tier_cold_resumes_total 2" in m
+
+    api_drive(drive_on, tmp_path, serving=with_tier)
+
+    # no tier manager: flag-independent fallback off the allocator
+    alloc = PageAllocator(9, 4, 16, 2)
+    assert alloc.allocate(0, 2) is not None  # hot = 9 - 1 - 6 = 2
+    without_tier = types.SimpleNamespace(
+        _tier=None,
+        engine=types.SimpleNamespace(
+            paged=types.SimpleNamespace(allocator=alloc), _prefix=None))
+
+    async def drive_off(client, db):
+        headers = await get_token(client, "admin", "pw")
+        r = await client.get("/admin/tiers", headers=headers)
+        assert r.status == 200
+        body = await r.json()
+        assert body == {"enabled": False,
+                        "pages": {"hot": 2, "warm": 0, "cold": 0}}
+        r = await client.get("/metrics")
+        m = await r.text()
+        assert 'swarmdb_tier_pages{tier="hot"} 2' in m
+        assert 'swarmdb_tier_pages{tier="warm"} 0' in m
+        assert 'swarmdb_tier_pages{tier="cold"} 0' in m
+        assert "swarmdb_tier_demotions_total 0" in m
+
+    api_drive(drive_off, tmp_path, serving=without_tier)
