@@ -2247,6 +2247,350 @@ def bench_chaos_cluster_serve(seconds: float) -> dict:
     return result
 
 
+# --------------------------------------------------------------------------
+# Mode: swarm10k (ISSUE 20 acceptance)
+
+
+def bench_swarm10k(seconds: float) -> dict:
+    """swarmfleet acceptance (ISSUE 20): 100x swarm100's agent count as
+    bursty OPEN-LOOP arrivals with mixed priorities, replayed over the
+    SAME precomputed schedule twice — colocated control first, then the
+    disaggregated fleet (``SWARMDB_FLEET=prefill:N,decode:M``) — on
+    virtual CPU devices (same stance as dpserve/chaos_serve: the path is
+    what a v5e-8 would jit, the numbers are CPU wall-clock). The record
+    carries the A/B (throughput + p95 TTFT), greedy bit-identity across
+    the prefill→decode handoff, acked loss (MUST be 0), and windowed
+    per-pool duty cycles proving both pools stay busy."""
+    import numpy as np
+
+    n = _env("SWARMDB_BENCH_FLEET_LANES", 4, int)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from swarmdb_tpu.backend.engine import GenRequest
+    from swarmdb_tpu.backend.sampling import SamplingParams
+    from swarmdb_tpu.models.configs import get_config
+    from swarmdb_tpu.parallel.mesh import make_mesh
+    from swarmdb_tpu.parallel.serving import build_serving_engine
+    from swarmdb_tpu.utils.xla_cache import enable_compile_cache
+
+    enable_compile_cache(os.environ.get(
+        "SWARMDB_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache")))
+
+    agents = _env("SWARMDB_BENCH_AGENTS", 10000)       # 100x swarm100
+    new_tokens = _env("SWARMDB_BENCH_NEW_TOKENS", 24, int)
+    # long decode chunks are the serving-realistic setting (amortize the
+    # per-chunk host sync); they are ALSO the colocated mode's TTFT
+    # poison — an admission arriving mid-chunk waits the chunk out, which
+    # is precisely the interference the prefill pool removes
+    decode_chunk = _env("SWARMDB_BENCH_DECODE_CHUNK", 24, int)
+    rate = _env("SWARMDB_BENCH_FLEET_RATE", 20.0)      # arrivals/sec
+    peak_x = _env("SWARMDB_BENCH_FLEET_PEAK_X", 4.0)   # peak-phase mult
+    ttft_slo_ms = _env("SWARMDB_BENCH_TTFT_SLO_MS", 100.0)
+    max_inflight = _env("SWARMDB_BENCH_FLEET_INFLIGHT", 200, int)
+    window = max(10.0, min(seconds, 40.0))
+    # the fleet's working regime is admission-heavy: agent turns carry
+    # tens of tokens of conversation context, replies are short
+    n_pre = max(1, n // 2)
+    fleet_spec = os.environ.get(
+        "SWARMDB_BENCH_FLEET_SPEC", f"prefill:{n_pre},decode:{n - n_pre}")
+
+    # one precomputed arrival schedule replayed by BOTH runs, open-loop
+    # (arrivals never wait on completions), in TWO phases:
+    #   steady — bursty traffic at the operating rate. This is where the
+    #     latency A/B lives: goodput under the TTFT SLO and p95 TTFT
+    #     (DistServe-style SLO attainment — the metric disaggregation
+    #     exists to move; raw msgs/s of a sub-saturated open loop equals
+    #     the offered rate by construction, for ANY serving topology).
+    #   peak — sustained overload (peak_x times the rate, no bursts).
+    #     This is where the pool-balance proof lives: both pools must
+    #     show >= 0.5 duty (a starving pool means the split is wrong)
+    #     and nothing may shed or hang even past saturation.
+    # Priorities are mixed and decorrelated from the agent id.
+    # burst_x > 1 modulates the steady phase with square-wave burst
+    # seconds ON TOP of Poisson clumping; the default keeps pure Poisson
+    # (already bursty in the memoryless sense) — synchronized thundering
+    # herds belong to the peak phase, where they hit both topologies
+    rng = np.random.default_rng(_env("SWARMDB_BENCH_SEED", 1234, int))
+    burst_x = _env("SWARMDB_BENCH_FLEET_BURST", 1.0)
+    w_steady = round(window * 0.65, 2)
+    prios = (0, 1, 1, 2, 3)
+    sched = []
+    t = 0.0
+    i = 0
+    while t < window:
+        if t < w_steady:
+            burst = burst_x if (t % 5.0) < 1.0 else 1.0
+            t += float(rng.exponential(1.0 / (rate * burst)))
+        else:
+            t += float(rng.exponential(1.0 / (rate * peak_x)))
+        a = int(rng.integers(0, agents))
+        sched.append((t, a, prios[i % len(prios)],
+                      "steady" if t < w_steady else "peak"))
+        i += 1
+
+    probe_prompts = [[1, 5, 9, 13], [2, 4, 6, 8, 10], [3, 7, 11]]
+
+    def run(fleet: bool) -> dict:
+        from swarmdb_tpu.obs import TRACER
+        from swarmdb_tpu.obs.memprof import memprof as _mp
+        from swarmdb_tpu.obs.profiler import profiler as _kp
+
+        TRACER.reset()
+        _kp().reset()
+        _mp().reset()
+        if fleet:
+            os.environ["SWARMDB_FLEET"] = fleet_spec
+        else:
+            os.environ.pop("SWARMDB_FLEET", None)
+        try:
+            group, _info = build_serving_engine(
+                get_config("tiny-debug"),
+                make_mesh(n, data=n, model=1, expert=1),
+                max_batch=_env("SWARMDB_BENCH_MAX_BATCH", 6 * n, int),
+                max_seq=128, paged=True, page_size=8,
+                decode_chunk=decode_chunk)
+        finally:
+            os.environ.pop("SWARMDB_FLEET", None)
+        if _env("SWARMDB_BENCH_PREWARM", 1, int) == 1:
+            group.warmup()
+        group.start()
+        sup = group.attach_supervisor(deadline_s=240.0, retries=3)
+        out: dict = {}
+        try:
+            # greedy bit-identity probes BEFORE the load (deterministic
+            # queue state): the fleet run's streams cross the handoff
+            probes = []
+            for p in probe_prompts:
+                toks, reason = group.generate_sync(
+                    p, SamplingParams(max_new_tokens=8), timeout=180.0)
+                probes.append((list(toks), reason))
+            out["probes"] = probes
+
+            lock = threading.Lock()
+            stats = {"acked_loss": 0, "reasons": {}, "tokens": 0}
+            recs: list = []  # (phase, ttft_s, n_tokens)
+            outstanding = []
+            done_n = [0]
+
+            def submit(a: int, prio: int, phase: str) -> None:
+                done = threading.Event()
+                t_submit = time.monotonic()
+                first = [0.0]
+                streamed: list = []
+
+                def on_tok(rid, tok):
+                    if not first[0]:
+                        first[0] = time.monotonic() - t_submit
+                    streamed.append(tok)
+
+                def on_done(rid, toks, reason):
+                    with lock:
+                        stats["reasons"][reason] = (
+                            stats["reasons"].get(reason, 0) + 1)
+                        if reason not in ("length", "eos"):
+                            stats["acked_loss"] += 1  # non-success
+                        elif streamed != list(toks):
+                            stats["acked_loss"] += 1  # dup/lost chunk
+                        else:
+                            stats["tokens"] += len(toks)
+                            recs.append((phase, first[0], len(toks)))
+                        done_n[0] += 1
+                    done.set()
+
+                # long-context agent turn: 64-96 tokens of "conversation
+                # so far" (varies by agent, exercises several ragged
+                # buckets), short reply — the admission-heavy mix the
+                # prefill pool exists to absorb
+                plen = 64 + (a % 5) * 8
+                prompt = [1 + ((a + k) % 61) for k in range(plen)]
+                group.submit(GenRequest(
+                    prompt=prompt,
+                    sampling=SamplingParams(max_new_tokens=new_tokens),
+                    priority=prio, on_token=on_tok, on_done=on_done))
+                outstanding.append(done)
+
+            from swarmdb_tpu.obs.profiler import profiler
+            prof = profiler()
+            snap_peak0 = None
+            t0 = time.monotonic()
+            for (at, a, prio, phase) in sched:
+                lag = t0 + at - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+                if phase == "peak" and snap_peak0 is None:
+                    snap_peak0 = prof.counters_snapshot()
+                # safety valve, not closed-loop pacing: an unbounded
+                # open loop on a slow host would pile the queue past the
+                # shed watermark and the run would measure shedding, not
+                # serving — cap in-flight well above steady state
+                while (len(outstanding) - done_n[0]) >= max_inflight:
+                    time.sleep(0.005)
+                submit(a, prio, phase)
+            # pool duty is measured over the PEAK phase's offered-load
+            # window only: at steady sub-saturated load an efficient pool
+            # SHOULD idle, and the drain tail would dilute every pool
+            snap_peak1 = prof.counters_snapshot()
+            # open-loop drain: arrivals stopped, every stream must finish
+            drain_deadline = time.monotonic() + 180.0
+            for d in outstanding:
+                if not d.wait(max(0.1, drain_deadline - time.monotonic())):
+                    with lock:
+                        stats["acked_loss"] += 1  # hung stream = loss
+            span_s = time.monotonic() - t0
+
+            # peak-window per-lane duty (busy-ns delta), rolled up by
+            # fleet pool; lane labels are resolved from each engine's own
+            # profile handle because the registry keeps prior sub-runs'
+            # lanes registered
+            def lane_label(j):
+                return getattr(getattr(group.lanes[j], "_prof", None),
+                               "label", f"lane{j}")
+
+            duty_by_lane = {}
+            if snap_peak0 is not None:
+                span_ns = max(
+                    1, snap_peak1["mono_ns"] - snap_peak0["mono_ns"])
+                for j in range(len(group.lanes)):
+                    lbl = lane_label(j)
+                    d = (snap_peak1["lane_busy_ns"].get(lbl, 0)
+                         - snap_peak0["lane_busy_ns"].get(lbl, 0))
+                    duty_by_lane[f"lane{j}"] = round(
+                        min(1.0, d / span_ns), 4)
+            out["peak_duty_by_lane"] = duty_by_lane
+            if fleet and group.fleet is not None:
+                pool_duty = {}
+                for role, idxs in group.fleet.pools.items():
+                    duties = [duty_by_lane.get(f"lane{j}", 0.0)
+                              for j in idxs]
+                    pool_duty[role] = round(
+                        sum(duties) / max(1, len(duties)), 4)
+                out["pool_duty"] = pool_duty
+                out["pools_report"] = prof.pools_report()
+                out["fleet"] = group.fleet.stats()
+            with lock:
+                out["acked_loss"] = stats["acked_loss"]
+                out["reasons"] = dict(stats["reasons"])
+                out["tokens"] = stats["tokens"]
+                done_recs = list(recs)
+
+            def pct(vals, q):
+                if not vals:
+                    return None
+                return round(vals[min(len(vals) - 1,
+                                      int(q / 100 * (len(vals) - 1)))], 4)
+
+            steady = sorted(r[1] for r in done_recs if r[0] == "steady")
+            peak = sorted(r[1] for r in done_recs if r[0] == "peak")
+            slo_s = ttft_slo_ms / 1e3
+            out["completed"] = len(done_recs)
+            out["steady_completed"] = len(steady)
+            out["peak_completed"] = len(peak)
+            # SLO-attainment goodput: steady-phase completions whose
+            # first token met the TTFT SLO, per second of steady window
+            out["goodput_msgs_per_sec"] = round(
+                sum(1 for v in steady if v <= slo_s) / w_steady, 2)
+            out["slo_attainment"] = round(
+                sum(1 for v in steady if v <= slo_s)
+                / max(1, len(steady)), 4)
+            out["p50_ttft_s"] = pct(steady, 50)
+            out["p95_ttft_s"] = pct(steady, 95)
+            out["peak_p95_ttft_s"] = pct(peak, 95)
+            out["span_s"] = round(span_s, 2)
+            out["completed_per_sec"] = round(
+                len(done_recs) / max(1e-6, span_s), 2)
+            out["tokens_per_sec"] = round(
+                stats["tokens"] / max(1e-6, span_s), 1)
+        finally:
+            sup.stop()
+            group.stop()
+        return out
+
+    colo = run(False)
+    fl = run(True)
+    bit_identical = colo["probes"] == fl["probes"]
+    # the headline is DistServe-style SLO-attainment goodput: steady-
+    # phase completions whose FIRST token met the TTFT SLO, per second.
+    # (Raw msgs/s of a sub-saturated open loop equals the offered rate
+    # for any topology — it cannot distinguish serving quality.)
+    value = fl["goodput_msgs_per_sec"]
+    v0 = colo["goodput_msgs_per_sec"]
+    pool_duty = fl.get("pool_duty", {})
+    min_pool_duty = min(pool_duty.values()) if pool_duty else None
+    fleet_stats = fl.get("fleet", {})
+    result = {
+        "metric": "swarm10k_slo_goodput_msgs_per_sec",
+        "value": value,
+        "unit": "msgs/sec",
+        "mode": "swarm10k",
+        "model": "tiny-debug",
+        "lanes": n,
+        "fleet_spec": fleet_spec,
+        "agents": agents,
+        "arrivals": len(sched),
+        "arrival_rate": rate,
+        "peak_rate": rate * peak_x,
+        "ttft_slo_ms": ttft_slo_ms,
+        "new_tokens_per_reply": new_tokens,
+        "completed": fl["completed"],
+        "acked_loss": fl["acked_loss"] + colo["acked_loss"],
+        "fleet_acked_loss": fl["acked_loss"],
+        "colocated_acked_loss": colo["acked_loss"],
+        "tokens_per_sec": fl["tokens_per_sec"],
+        "msgs_per_sec": fl["completed_per_sec"],
+        "colocated_raw_msgs_per_sec": colo["completed_per_sec"],
+        "slo_attainment": fl["slo_attainment"],
+        "colocated_slo_attainment": colo["slo_attainment"],
+        "p50_send_to_first_token_s": fl["p50_ttft_s"],
+        "p95_ttft_s": fl["p95_ttft_s"],
+        "peak_p95_ttft_s": fl["peak_p95_ttft_s"],
+        "colocated_msgs_per_sec": v0,
+        "colocated_p95_ttft_s": colo["p95_ttft_s"],
+        "colocated_peak_p95_ttft_s": colo["peak_p95_ttft_s"],
+        "fleet_speedup_x": round(value / v0, 3) if v0 else None,
+        "greedy_bit_identical": bit_identical,
+        "min_pool_duty_cycle": min_pool_duty,
+        "pool_duty": pool_duty,
+        "peak_duty_by_lane": fl.get("peak_duty_by_lane"),
+        "colocated_peak_duty_by_lane": colo.get("peak_duty_by_lane"),
+        "pools": fl.get("pools_report"),
+        # the fleet block (ISSUE 20 bench-record plumbing): pool sizes,
+        # handoffs, fallbacks, handoff latency percentiles, transit store
+        "fleet": {
+            "pool_sizes": fleet_stats.get("pool_sizes"),
+            "weights": fleet_stats.get("weights"),
+            "handoffs": fleet_stats.get("handoffs"),
+            "handoff_fallbacks": fleet_stats.get("handoff_fallbacks"),
+            "handoff_ms_p50": fleet_stats.get("handoff_ms_p50"),
+            "handoff_ms_p95": fleet_stats.get("handoff_ms_p95"),
+            "colocated_fallback": fleet_stats.get("colocated_fallback"),
+            "transit_store": fleet_stats.get("transit_store"),
+        },
+        "finish_reasons": fl["reasons"],
+        "host_cpus": os.cpu_count(),
+        "note": ("virtual-CPU-device open-loop A/B of the disaggregated "
+                 "prefill/decode fleet vs the colocated control at equal "
+                 "lanes + identical arrival schedule; not TPU perf"),
+    }
+    problems = []
+    if result["acked_loss"]:
+        problems.append(f"ACKED LOSS: {result['acked_loss']} streams "
+                        "lost/duplicated a chunk, failed, or hung")
+    if not bit_identical:
+        problems.append("greedy probes diverged across the "
+                        "prefill→decode handoff")
+    if problems:
+        result["error"] = "; ".join(problems)
+    return result
+
+
 _MODES = {
     "echo": bench_echo,
     "serve": bench_serve,
@@ -2259,6 +2603,7 @@ _MODES = {
     "ha": bench_ha,
     "chaos_serve": bench_chaos_serve,
     "chaos_cluster_serve": bench_chaos_cluster_serve,
+    "swarm10k": bench_swarm10k,
 }
 
 # dpserve/swarm1M are NOT here: both are CPU measurements by design
@@ -2271,9 +2616,9 @@ _NEEDS_BACKEND = {"serve", "group", "tooluse", "swarm100", "longctx"}
 # (CPU-only, seconds of wall time, no TPU backend); longctx runs LAST:
 # it is the slowest warmup, so a cold-container budget squeeze sheds the
 # long-context line rather than the headline serve/tooluse records
-_ALL_MODES = ("echo", "ha", "chaos_serve", "chaos_cluster_serve", "serve",
-              "group", "tooluse", "swarm100", "swarm1M", "dpserve",
-              "longctx")
+_ALL_MODES = ("echo", "ha", "chaos_serve", "chaos_cluster_serve",
+              "swarm10k", "serve", "group", "tooluse", "swarm100",
+              "swarm1M", "dpserve", "longctx")
 
 
 def _force_cpu() -> None:
@@ -2358,6 +2703,10 @@ _SUMMARY_KEYS = (
     # number next to blast_radius, and the non-victim TTFT bound verdict
     ("conv", "rebalance_convergence_s"),
     ("ttftok", "ttft_ok"),
+    # disaggregated fleet (ISSUE 20): the A/B headline, the handoff
+    # price, and proof both pools pulled their weight
+    ("flx", "fleet_speedup_x"),
+    ("pduty", "min_pool_duty_cycle"),
 )
 
 
@@ -2392,6 +2741,16 @@ def _mode_summary(r: dict) -> dict:
         conv = mem.get("conversations") or {}
         if conv:
             out["hotc"] = conv.get("hot", 0)
+    # swarmfleet compact scalars (ISSUE 20): handoff volume + latency and
+    # the fallback count, so driver records can trend the disaggregation
+    # tax next to the A/B headline
+    fle = r.get("fleet")
+    if fle and fle.get("handoffs") is not None:
+        out["ho"] = fle.get("handoffs")
+        if fle.get("handoff_ms_p50") is not None:
+            out["hoff"] = fle["handoff_ms_p50"]
+        if fle.get("handoff_fallbacks"):
+            out["hofb"] = fle["handoff_fallbacks"]
     if r.get("tpu_error"):
         out["pl"] = "cpu-fallback"
     return out

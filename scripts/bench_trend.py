@@ -253,6 +253,24 @@ def compare_modes(base: Dict[str, Any], test: Dict[str, Any],
                 if tm / bm < (1.0 - threshold):
                     entry["regressed"] = True
                     entry[f"{tag}_regressed"] = True
+        # swarmfleet numbers guarded first-class (ISSUE 20): the fleet-
+        # vs-colocated goodput ratio and the worst pool's peak duty
+        # cycle. swarm10k's headline (SLO goodput) is gated by the
+        # generic throughput ratio above; these two catch the fleet
+        # silently losing its edge over the colocated control (flx
+        # drifting under 1.0) or one pool starving at peak (pduty
+        # collapse) while the headline still clears.
+        for short, tag in (("flx", "fleet_speedup"),
+                           ("pduty", "min_pool_duty")):
+            bm, tm = b.get(short), t.get(short)
+            if isinstance(bm, (int, float)) and \
+                    isinstance(tm, (int, float)) and bm > 0:
+                entry[f"base_{short}"] = bm
+                entry[f"test_{short}"] = tm
+                entry[f"{short}_ratio"] = round(tm / bm, 3)
+                if tm / bm < (1.0 - threshold):
+                    entry["regressed"] = True
+                    entry[f"{tag}_regressed"] = True
         # cold-resume TTFT is a LATENCY: direction inverts — regression
         # is the ratio growing past 1+threshold (a slower log-replay
         # resume), not shrinking below 1-threshold

@@ -295,6 +295,12 @@ class Engine:
         # pinned by test). ShardLaneGroup relabels lanes "lane<i>".
         self._prof = kernel_profiler().lane()
         self._prof_resident_key = PROF_RESIDENT_KEYS[0]
+        # swarmfleet role (ISSUE 20): None = colocated (default, full
+        # warmup), "prefill" = admission/ragged-prefill waves only,
+        # "decode" = resident decode + rolling-resume only. The role
+        # restricts WARMUP (compile count + VMEM), not capability — an
+        # off-role request still runs, it just cold-compiles.
+        self._role: Optional[str] = None
         # ShardLaneGroup sets this to the lane index: lanes share ONE
         # flight recorder, and step records carry which lane wrote them
         self.flight_shard: Optional[int] = None
@@ -1793,20 +1799,21 @@ class Engine:
                     "compile cache is off (set SWARMDB_COMPILE_CACHE), so "
                     "parallel AOT results could not be reused", parallel)
         positions = np.zeros((self.max_batch,), np.int32)
-        for variant, decode in enumerate(self._decode_variants):
-            if self._mh is not None:
-                self._mh.publish_decode(variant, positions,
-                                        self._base_keys_np, self._temp,
-                                        self._topk, self._topp)
-            (all_toks, _lps, self._last_tokens, self._last_lps,
-             self.cache) = decode(
-                self.params, self._last_tokens, self._last_lps, positions,
-                self.cache, self._base_keys_np, self._temp, self._topk,
-                self._topp,
-            )
-            jax.block_until_ready(all_toks)
+        if self._role_warms_decode():
+            for variant, decode in enumerate(self._decode_variants):
+                if self._mh is not None:
+                    self._mh.publish_decode(variant, positions,
+                                            self._base_keys_np, self._temp,
+                                            self._topk, self._topp)
+                (all_toks, _lps, self._last_tokens, self._last_lps,
+                 self.cache) = decode(
+                    self.params, self._last_tokens, self._last_lps,
+                    positions, self.cache, self._base_keys_np, self._temp,
+                    self._topk, self._topp,
+                )
+                jax.block_until_ready(all_toks)
 
-        if self._use_resident():
+        if self._use_resident() and self._role_warms_decode():
             # resident-session variants: with live all-False the
             # while_loop body never executes (no emission fires) but the
             # program still compiles; state passes through the donation
@@ -1826,7 +1833,7 @@ class Engine:
         zero_f = np.zeros(Bp, np.float32)
         ones_f = np.ones(Bp, np.float32)
         keys = self._base_keys_np[np.zeros(Bp, np.int64)]
-        if self._ragged_active():
+        if self._ragged_active() and self._role_warms_prefill():
             # packed ragged waves: ONE variant per packed width — every
             # input is padding (dead rows, trash-routed positions)
             R = self.max_batch
@@ -1849,6 +1856,8 @@ class Engine:
                     np.ones(R, np.float32),
                 )
         for bucket in self.prefill_buckets:
+            if not self._role_warms_prefill():
+                break  # fleet decode lanes admit via resume delta-prefill
             tokens = np.full((Bp, bucket), self.pad_id, np.int32)
             if self.paged:
                 if self._ragged_active():
@@ -1908,7 +1917,8 @@ class Engine:
                     tokens = np.full((Bp, bucket), self.pad_id, np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        if not self._ragged_active():
+                        if (not self._ragged_active()
+                                and self._role_warms_prefill()):
                             # ragged engines serve cache hits through the
                             # ragged waves (a hit is just a prefix_len);
                             # only the rolling-resume variants below stay
@@ -1940,6 +1950,8 @@ class Engine:
                                 np.zeros((Bp, maxp), np.int32), drop,
                                 keys, zero_f, zero_i, ones_f,
                             )
+                        continue
+                    if not self._role_warms_prefill():
                         continue
                     lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                      self.max_seq // self._prefix_ps)
@@ -2009,15 +2021,33 @@ class Engine:
                 return w
         return self._ragged_widths[0]
 
+    def _role_warms_decode(self) -> bool:
+        """Whether this lane's warmup covers the decode-side variants
+        (decode chunk, resident sessions). ONE gate shared by warmup()
+        and warmup_call_plan() — same agree-or-drift contract as
+        _packed_active. Fleet PREFILL lanes skip them."""
+        return self._role != "prefill"
+
+    def _role_warms_prefill(self) -> bool:
+        """Whether this lane's warmup covers the admission-side prefill
+        variants (ragged/packed/bucketed + prefix). Fleet DECODE lanes
+        skip them — their only admission path is the rolling-resume
+        delta-prefill, which _warm_resume covers."""
+        return self._role != "decode"
+
     def _warm_resume(self) -> bool:
         """Whether warmup covers the rolling-KV resume variants (paged +
-        prefix engines, SWARMDB_ROLLING_KV deployments only). ONE gate
-        shared by warmup() and warmup_call_plan() — they must agree or
-        the precompile drift test fails."""
+        prefix engines, SWARMDB_ROLLING_KV deployments only — plus fleet
+        DECODE lanes, whose admission path IS the resume delta-prefill).
+        ONE gate shared by warmup() and warmup_call_plan() — they must
+        agree or the precompile drift test fails."""
+        if self._role == "prefill":
+            return False
         return (self.paged is not None
                 and getattr(self, "_prefill_paged_resume_fused", None)
                 is not None
-                and os.environ.get("SWARMDB_ROLLING_KV") == "1")
+                and (os.environ.get("SWARMDB_ROLLING_KV") == "1"
+                     or self._role == "decode"))
 
     def warmup_call_plan(self) -> List[Tuple[Any, Tuple[Any, ...]]]:
         """(jitted fn, ShapeDtypeStruct args) for every variant warmup()
@@ -2052,10 +2082,12 @@ class Engine:
         key_dt = self._base_keys_np.dtype
         f32_B, i32_B = sds((B,), np.float32), sds((B,), np.int32)
         plan: List[Tuple[Any, Tuple[Any, ...]]] = []
-        for decode in self._decode_variants:
-            plan.append((decode, (params_s, lt_s, llp_s, i32_B, cache_s,
-                                  keys_B, f32_B, i32_B, f32_B)))
-        if self._use_resident():
+        if self._role_warms_decode():
+            for decode in self._decode_variants:
+                plan.append((decode, (params_s, lt_s, llp_s, i32_B,
+                                      cache_s, keys_B, f32_B, i32_B,
+                                      f32_B)))
+        if self._use_resident() and self._role_warms_decode():
             # resident sessions carry host callbacks, which jax refuses
             # to serialize into the persistent cache — the AOT compile
             # still validates the specs, and warmup's jit execution adds
@@ -2068,7 +2100,7 @@ class Engine:
 
         keys_Bp = sds((Bp,) + self._base_keys_np.shape[1:], key_dt)
         i32_Bp, f32_Bp = sds((Bp,), np.int32), sds((Bp,), np.float32)
-        if self._ragged_active():
+        if self._ragged_active() and self._role_warms_prefill():
             maxp = self.paged.allocator.maxp
             keys_R = sds((B,) + self._base_keys_np.shape[1:], key_dt)
             for wd in self._ragged_widths:
@@ -2079,6 +2111,8 @@ class Engine:
                     cache_s["v"], lt_s, llp_s, keys_R, f32_B, i32_B,
                     f32_B)))
         for bucket in self.prefill_buckets:
+            if not self._role_warms_prefill():
+                break  # fleet decode lanes admit via resume delta-prefill
             tok = sds((Bp, bucket), np.int32)
             if self.paged:
                 if self._ragged_active():
@@ -2116,7 +2150,8 @@ class Engine:
                     table = sds((Bp, ppb), np.int32)
                     if self.paged:
                         chunks = -(-bucket // self._prefix_ps)
-                        if not self._ragged_active():
+                        if (not self._ragged_active()
+                                and self._role_warms_prefill()):
                             for rb in self._row_buckets:
                                 keys_rb = sds(
                                     (rb,) + self._base_keys_np.shape[1:],
@@ -2140,7 +2175,7 @@ class Engine:
                                 sds((Bp, maxp), np.int32), i32_Bp,
                                 cache_s["k"], cache_s["v"], lt_s, llp_s,
                                 keys_Bp, f32_Bp, i32_Bp, f32_Bp)))
-                    else:
+                    elif self._role_warms_prefill():
                         lane_pages = min(ppb + -(-bucket // self._prefix_ps),
                                          self.max_seq // self._prefix_ps)
                         reg = sds((Bp, lane_pages), np.int32)
@@ -2358,6 +2393,11 @@ class Engine:
             self._in_step = True
             try:
                 self._admit()
+                if self._role == "prefill":
+                    # fleet prefill lanes retire admission-only requests
+                    # straight off the prefill sample — decode never runs
+                    # for them, so the lane's whole duty is prefill waves
+                    self._drain_prefill_only()
                 if self._use_resident():
                     # device-resident session: the while_loop runs chunks
                     # until all lanes finish or the host votes to stop
@@ -4075,6 +4115,44 @@ class Engine:
         return all_toks, all_lps, snapshot, time.monotonic_ns(), variant
 
     # swarmlint: hot
+    def _drain_prefill_only(self) -> None:
+        """Fleet PREFILL lanes (ISSUE 20): retire admission-only
+        (max_new_tokens <= 1) requests straight off the prefill sample.
+        ``_last_tokens[i]`` IS the fed token the colocated decode path
+        reads as ``block[0, i]``, so emitting it here keeps the
+        prefill→decode handoff bit-identical to colocated serving. One
+        host sync per admission round, accounted like _process_block's.
+        Off-role slots (max_new > 1, e.g. colocated fallback under a
+        quarantined decode pool) are left for the regular decode loop."""
+        rows = [i for i, s in enumerate(self.slots)
+                if s.active and s.pending_first and s.request is not None
+                and s.request.sampling.max_new_tokens <= 1]
+        if not rows:
+            return
+        t_sync0 = time.monotonic_ns()
+        # swarmlint: sanctioned-drain
+        toks, lps = jax.device_get((self._last_tokens, self._last_lps))
+        t_sync1 = time.monotonic_ns()
+        self.tracer.span_end(t_sync0, "engine.host_sync", cat="engine")
+        self.metrics.counters["engine_host_syncs"].inc()
+        self._host_sync_n += 1
+        self.metrics.counters["phase_us_host_sync"].inc(
+            (t_sync1 - t_sync0) // 1000)
+        now = time.time()
+        for i in rows:
+            s = self.slots[i]
+            if not s.active:
+                continue
+            if s.cancelled:
+                self._retire(i, "cancelled")
+                continue
+            s.pending_first = False
+            self._emit_token(i, int(toks[i]), now, logprob=float(lps[i]))
+            if s.active:
+                # emit retires max_new<=1 on "length"/"eos"; this only
+                # fires for a degenerate max_new=0 request
+                self._retire(i, "length")
+
     def _process_block(self, all_toks, all_lps, snapshot,
                        t_dispatch_ns: int = 0, variant: int = -1) -> None:
         """Fetch one dispatched chunk's [K+1, B] token block (+ matching

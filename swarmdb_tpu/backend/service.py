@@ -1663,4 +1663,9 @@ class ServingService:
             "engine": self.engine.stats(),
             "tier": (self._tier.status() if self._tier is not None
                      else {"enabled": False}),
+            # swarmfleet (ISSUE 20): pool map + handoff counters, flag-
+            # independent like "tier" — {"enabled": false} when colocated
+            "fleet": (dict(enabled=True, **fleet.stats())
+                      if (fleet := getattr(self.engine, "fleet", None))
+                      is not None else {"enabled": False}),
         }

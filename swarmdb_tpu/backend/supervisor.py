@@ -238,12 +238,14 @@ class LaneSupervisor:
         across migrations/retries (replays reuse it), so cancel and
         stream identity keep working from the caller's side."""
         tr = self._adopt(request)
-        idx, eng = self._route(request)
-        tr.lane = idx
+        # track BEFORE dispatching: a fleet handoff can move the request
+        # across pools (note_lane) while the submit call is still in
+        # flight, and those updates need the tracker registered
         with self._lock:
             self._tracked[request.request_id] = tr
         try:
-            return eng.submit(request)
+            self._dispatch(request)
+            return request.request_id
         except Exception:
             with self._lock:
                 self._tracked.pop(request.request_id, None)
@@ -293,6 +295,35 @@ class LaneSupervisor:
         if self.group is not None:
             return self.group._route(request)
         return 0, self.lanes[0]
+
+    def _dispatch(self, request: GenRequest) -> int:
+        """Route + submit one request (or replay). With a fleet attached
+        (swarmfleet role pools) the FleetManager owns placement — staged
+        prefill→decode handoffs included; it reports lane positions back
+        through note_lane. Otherwise: classic health-aware route."""
+        fleet = getattr(self.group, "fleet", None) \
+            if self.group is not None else None
+        if fleet is not None:
+            idx = fleet.dispatch(request)
+            if idx is not None:
+                return idx
+        idx, eng = self._route(request)
+        self.note_lane(request.request_id, idx)
+        eng.submit(request)
+        return idx
+
+    def note_lane(self, request_id: str, idx: int) -> None:
+        """Record where a tracked request currently lives. The fleet
+        calls this at every stage transition (prefill lane, then decode
+        lane) so quarantine scans migrate cross-pool requests from the
+        lane they actually occupy."""
+        with self._lock:
+            tr = self._tracked.get(request_id)
+        if tr is None:
+            return
+        with tr.lock:
+            if not tr.done:
+                tr.lane = idx
 
     # ------------------------------------------------------------ wrapping
 
@@ -361,13 +392,11 @@ class LaneSupervisor:
                 return
             tr.retry_timer = None
             replay = self._build_replay(tr, attempt)
+        with tr.lock:
+            if tr.done or attempt != tr.attempt:
+                return
         try:
-            idx, eng = self._route(replay)
-            with tr.lock:
-                if tr.done or attempt != tr.attempt:
-                    return
-                tr.lane = idx
-            eng.submit(replay)
+            self._dispatch(replay)
         except Exception:
             logger.exception("retry resubmit failed for %s",
                              tr.request.request_id)
@@ -526,10 +555,7 @@ class LaneSupervisor:
                     continue
                 replay = self._build_replay(tr, bump)
             try:
-                new_idx, eng = self._route(replay)
-                with tr.lock:
-                    tr.lane = new_idx
-                eng.submit(replay)
+                new_idx = self._dispatch(replay)
                 moved += 1
                 self.metrics.counters["requests_migrated"].inc()
                 self.flight.record_event(

@@ -765,6 +765,39 @@ def roofline_report(paths: Sequence[str],
                 "vmem_budget_bytes": b,
                 "vmem_utilization": (round(est / b, 4) if b else None),
             })
+        # per-pool section (swarmfleet): pool idleness is a first-class
+        # number. Prefer the dump's own pools rollup; reconstruct it from
+        # pool-labelled lane rows for dumps written mid-transition.
+        pools = [dict(p) for p in (data.get("pools") or [])]
+        if not pools:
+            by_pool: Dict[str, List[Dict[str, Any]]] = {}
+            for lrow in (data.get("lanes") or []):
+                p = lrow.get("pool")
+                if p:
+                    by_pool.setdefault(p, []).append(lrow)
+            for p, rows in sorted(by_pool.items()):
+                duties = [r.get("duty_cycle") or 0.0 for r in rows]
+                pools.append({
+                    "pool": p,
+                    "lanes": [r.get("lane") for r in rows],
+                    "duty_cycle_min": round(min(duties), 6),
+                    "duty_cycle_mean": round(sum(duties) / len(duties), 6),
+                })
+        fam = {"prefill": ("prefill",), "decode": ("decode", "resident")}
+        for prow in pools:
+            # each pool's variant family grouped out of the same device-
+            # time table: role-typed pools partition the variant names,
+            # so the share split is exact in fleet mode
+            fams = fam.get(str(prow.get("pool")))
+            if not fams:
+                continue
+            pv = [v for v in variants
+                  if str(v.get("variant") or "").startswith(fams)]
+            dev = sum(v.get("device_s") or 0.0 for v in pv)
+            prow["device_s"] = round(dev, 6)
+            if total_dev > 0:
+                prow["device_share"] = round(dev / total_dev, 4)
+            prow["top_variants"] = [v.get("variant") for v in pv[:3]]
         dumps.append({
             "path": path,
             "node": data.get("node"),
@@ -775,6 +808,7 @@ def roofline_report(paths: Sequence[str],
             "device_s_total": round(total_dev, 6),
             "top_variants": top,
             "lanes": data.get("lanes"),
+            "pools": pools,
             "tiny_flush_waves": data.get("tiny_flush_waves", 0),
             "tiny_flush_rows": tiny,
             "vmem_budget_bytes": budget,

@@ -709,6 +709,19 @@ class MemProfiler:
         bw = _env_float("SWARMDB_MEM_H2D_GBPS", 10.0) * 1e9
         per_page_ms = (self.page_bytes / bw * 1e3
                        if self.page_bytes and bw else None)
+        # warm byte price: a spilled page costs page_bytes of host RAM —
+        # divided by the LIVE store's measured compress ratio when
+        # SWARMDB_TIER_ZSTD is actually shipping compressed payloads
+        ratio = None
+        if self._tier_status is not None:
+            try:
+                ws = self._tier_status().get("warm_store") or {}
+                ratio = ws.get("compress_ratio")
+            except Exception:
+                ratio = None
+        page_cost = self.page_bytes
+        if page_cost and ratio and ratio > 0:
+            page_cost = page_cost / ratio
         out = []
         for mult in (0.5, 1.0, 2.0, 4.0):
             n = max(1, int(c_dev * mult))
@@ -721,6 +734,10 @@ class MemProfiler:
             }
             if per_page_ms is not None:
                 row["readmit_ms_per_page"] = round(per_page_ms, 4)
+            if page_cost:
+                row["warm_host_bytes"] = int(n * page_cost)
+                if ratio:
+                    row["compress_ratio"] = ratio
             out.append(row)
         return out
 

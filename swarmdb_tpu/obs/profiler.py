@@ -194,12 +194,13 @@ class LaneProfile:
     the lane's engine thread and its emission-callback thread; the
     races are benign (the flight-recorder stance: rings are evidence)."""
 
-    __slots__ = ("label", "enabled", "busy_ns", "serving_since_ns",
+    __slots__ = ("label", "pool", "enabled", "busy_ns", "serving_since_ns",
                  "_reg", "_ring", "_ring_idx", "_ring_cap")
 
     def __init__(self, reg: "KernelProfiler", label: str,
                  ring_cap: int) -> None:
         self.label = label
+        self.pool: Optional[str] = None
         self.enabled = True
         self.busy_ns = 0
         self.serving_since_ns = time.monotonic_ns()
@@ -213,6 +214,11 @@ class LaneProfile:
 
     def set_label(self, label: str) -> None:
         self.label = label
+
+    def set_pool(self, pool: Optional[str]) -> None:
+        """Name the lane's fleet role (swarmfleet pool map) so duty
+        cycles and the roofline report group by pool."""
+        self.pool = pool
 
     # ---------------------------------------------------------- record path
 
@@ -451,11 +457,54 @@ class KernelProfiler:
             lanes = list(self._lanes)
         return [{
             "lane": lane.label,
+            "pool": lane.pool,
             "busy_s": round(lane.busy_ns / 1e9, 6),
             "elapsed_s": round(
                 max(0, now_ns - lane.serving_since_ns) / 1e9, 3),
             "duty_cycle": round(lane.duty_cycle(now_ns), 6),
         } for lane in lanes]
+
+    # variant-name families per fleet role: with role-typed pools these
+    # partition the registry (prefill lanes only ever dispatch prefill-
+    # family variants and vice versa), so per-pool MFU is exact there
+    _POOL_FAMILIES = {
+        "prefill": ("prefill",),
+        "decode": ("decode", "resident"),
+    }
+
+    def pools_report(self) -> List[Dict[str, Any]]:
+        """Per-pool rollup (swarmfleet): duty cycles aggregated over the
+        pool's lanes + the pool's variant-family MFU. Empty list when no
+        lane carries a pool label (colocated mode)."""
+        now_ns = time.monotonic_ns()
+        peaks = self.peaks()
+        with self._lock:
+            lanes = [l for l in self._lanes if l.pool is not None]
+            vs = list(self._vars.values())
+        if not lanes:
+            return []
+        out: List[Dict[str, Any]] = []
+        for pool in sorted({l.pool for l in lanes}):
+            members = [l for l in lanes if l.pool == pool]
+            duties = [l.duty_cycle(now_ns) for l in members]
+            row: Dict[str, Any] = {
+                "pool": pool,
+                "lanes": [l.label for l in members],
+                "busy_s": round(sum(l.busy_ns for l in members) / 1e9, 6),
+                "duty_cycle_min": round(min(duties), 6),
+                "duty_cycle_mean": round(sum(duties) / len(duties), 6),
+            }
+            fams = self._POOL_FAMILIES.get(pool)
+            if fams and peaks.get("peak_flops"):
+                fam_vs = [v for v in vs
+                          if v.name.startswith(fams) and v.flops]
+                flops = sum(v.flops * v.invocations for v in fam_vs)
+                dev_s = sum(v.device_ns for v in fam_vs) / 1e9
+                if flops and dev_s > 0:
+                    row["mfu"] = round(
+                        flops / dev_s / peaks["peak_flops"], 6)
+            out.append(row)
+        return out
 
     def dispatch_profile(self) -> List[Dict[str, Any]]:
         """The wave-shape histogram, tiny ragged flush waves named. Each
@@ -541,6 +590,7 @@ class KernelProfiler:
             "mfu": round(agg, 6) if agg is not None else None,
             "variants": self.variants_report(),
             "lanes": self.lanes_report(),
+            "pools": self.pools_report(),
             "dispatch_profile": self.dispatch_profile(),
             "tiny_flush_waves": self.tiny_flush_waves(),
         }
@@ -550,7 +600,7 @@ class KernelProfiler:
         device-time variants + lane duty cycles, small enough to ride a
         JSON line."""
         rows = self.variants_report()[:top]
-        return {
+        out = {
             "platform": self.platform,
             "mfu": (round(self.mfu(), 6)
                     if self.mfu() is not None else None),
@@ -558,6 +608,10 @@ class KernelProfiler:
             "lanes": self.lanes_report(),
             "tiny_flush_waves": self.tiny_flush_waves(),
         }
+        pools = self.pools_report()
+        if pools:
+            out["pools"] = pools
+        return out
 
     # -------------------------------------------------------- prometheus
 
@@ -571,7 +625,11 @@ class KernelProfiler:
         lines.append(f"swarmdb_mfu {round(agg, 6) if agg else 0.0}")
         lines.append("# TYPE swarmdb_lane_duty_cycle gauge")
         for row in self.lanes_report():
-            lines.append(f'swarmdb_lane_duty_cycle{{lane="{row["lane"]}"}} '
+            lbl = f'lane="{row["lane"]}"'
+            if row.get("pool"):
+                # fleet mode: pool idleness is a first-class label
+                lbl += f',pool="{row["pool"]}"'
+            lines.append(f"swarmdb_lane_duty_cycle{{{lbl}}} "
                          f"{row['duty_cycle']}")
         lines.append("# TYPE swarmdb_kernel_device_seconds_total counter")
         lines.append("# TYPE swarmdb_kernel_invocations_total counter")

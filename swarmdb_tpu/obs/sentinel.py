@@ -83,7 +83,7 @@ class SLOConfig:
                  "ttft_p95_s", "queue_p95_s", "cost_growth_x",
                  "retry_rate", "mfu_drop_x", "duty_drop_x",
                  "prefix_hit_drop_x", "mem_headroom_min",
-                 "max_alerts", "enabled")
+                 "handoff_p95_ms", "max_alerts", "enabled")
 
     def __init__(self,
                  window_s: Optional[float] = None,
@@ -97,6 +97,7 @@ class SLOConfig:
                  duty_drop_x: Optional[float] = None,
                  prefix_hit_drop_x: Optional[float] = None,
                  mem_headroom_min: Optional[float] = None,
+                 handoff_p95_ms: Optional[float] = None,
                  max_alerts: Optional[int] = None,
                  enabled: Optional[bool] = None) -> None:
         self.window_s = window_s if window_s is not None else \
@@ -140,6 +141,13 @@ class SLOConfig:
         self.mem_headroom_min = mem_headroom_min \
             if mem_headroom_min is not None else \
             _env_float("SWARMDB_SLO_MEM_HEADROOM_MIN", 0.05)
+        # swarmfleet SLO (ISSUE 20): p95 prefill→decode handoff latency
+        # in a window that actually handed off. The handoff is a host
+        # gather + store round-trip — if it degrades toward prefill cost
+        # the disaggregation is returning its win. <= 0 disables.
+        self.handoff_p95_ms = handoff_p95_ms \
+            if handoff_p95_ms is not None else \
+            _env_float("SWARMDB_SLO_HANDOFF_P95_MS", 250.0)
         self.max_alerts = max_alerts if max_alerts is not None else \
             _env_int("SWARMDB_SLO_ALERTS", 64)
         self.enabled = enabled if enabled is not None else \
@@ -188,6 +196,8 @@ class SLOSentinel:
         # swarmmem cumulative snapshot (window prefix hit rate is a
         # token-count delta, same stance)
         self._prev_mem: Optional[Dict[str, Any]] = None
+        # swarmfleet cumulative handoff count (window handoffs = delta)
+        self._prev_handoffs: Optional[int] = None
 
     # ------------------------------------------------------------- wiring
 
@@ -212,6 +222,7 @@ class SLOSentinel:
             self._prev_counters = None  # re-anchor, don't bill the gap
             self._prev_prof = None
             self._prev_mem = None
+            self._prev_handoffs = None
 
     # -------------------------------------------------------- record path
 
@@ -336,6 +347,7 @@ class SLOSentinel:
         }
         self._profile_window(window)
         self._mem_window(window)
+        self._fleet_window(window)
         self.ingest(window)
 
     def _profile_window(self, window: Dict[str, Any]) -> None:
@@ -392,6 +404,23 @@ class SLOSentinel:
         dmiss = cur["miss_tokens"] - prev["miss_tokens"]
         if dhit + dmiss > 0:
             window["prefix_hit_rate"] = round(dhit / (dhit + dmiss), 4)
+
+    def _fleet_window(self, window: Dict[str, Any]) -> None:
+        """Fold swarmfleet handoff latency into the closing window: only
+        windows that actually handed off carry ``handoff_p95_ms`` (the
+        handoff_p95_ms SLO watches it). No-op without a fleet."""
+        if self.metrics is None:
+            return
+        c = self.metrics.counters.get("fleet_handoffs")
+        cur = int(c.value) if c is not None else 0
+        prev, self._prev_handoffs = self._prev_handoffs, cur
+        if prev is None or cur <= prev:
+            return
+        window["handoffs"] = cur - prev
+        h = self.metrics.latencies.get("fleet_handoff_s")
+        p95 = h.percentile(95) if h is not None else None
+        if p95 is not None:
+            window["handoff_p95_ms"] = round(p95 * 1e3, 3)
 
     # ---------------------------------------------------------- detection
 
@@ -527,6 +556,16 @@ class SLOSentinel:
             breaches.append({"slo": "mem_headroom_min",
                              "limit": cfg.mem_headroom_min,
                              "value": headroom})
+        # swarmfleet SLO (ISSUE 20): the prefill→decode handoff is a
+        # host gather + transit-store round-trip — p95 creeping toward
+        # prefill cost means the disaggregation is returning its win
+        # (runbook step 17 names the checks).
+        ho = window.get("handoff_p95_ms")
+        if (ho is not None and cfg.handoff_p95_ms > 0
+                and ho > cfg.handoff_p95_ms):
+            breaches.append({"slo": "handoff_p95_ms",
+                             "limit": cfg.handoff_p95_ms,
+                             "value": ho})
         return breaches
 
     def _fire_alert(self, window: Dict[str, Any],

@@ -41,6 +41,73 @@ def warm_capacity_bytes() -> int:
     return max(0, int(mb * (1 << 20)))
 
 
+# ------------------------------------------------------------- compression
+#
+# SWARMDB_TIER_ZSTD=1 compresses demoted payloads at rest (and on the
+# fleet's prefill→decode handoff wire, which rides the same store). The
+# container may not ship python-zstandard; zlib is the stdlib fallback —
+# same seam, worse ratio. Codec is resolved per store at construction so
+# tests can flip the env var per instance.
+
+def _resolve_codec() -> Optional[Tuple[str, Any, Any]]:
+    if os.environ.get("SWARMDB_TIER_ZSTD", "0") != "1":
+        return None
+    try:
+        import zstandard  # type: ignore
+
+        comp = zstandard.ZstdCompressor(level=3)
+        deco = zstandard.ZstdDecompressor()
+        return ("zstd", comp.compress, deco.decompress)
+    except Exception:
+        import zlib
+
+        return ("zlib",
+                lambda b: zlib.compress(b, 3),
+                zlib.decompress)
+
+
+class _Packed(NamedTuple):
+    """One compressed array: blob + enough metadata to rebuild it."""
+
+    blob: bytes
+    dtype: str
+    shape: Tuple[int, ...]
+    raw_nbytes: int
+
+
+def _pack_array(arr: Any, compress: Any) -> _Packed:
+    a = np.ascontiguousarray(arr)
+    return _Packed(compress(a.tobytes()), str(a.dtype),
+                   tuple(a.shape), int(a.nbytes))
+
+
+def _pack(payload: Any, compress: Any) -> Any:
+    if isinstance(payload, tuple):
+        return tuple(_pack_array(p, compress) for p in payload)
+    return _pack_array(payload, compress)
+
+
+def _unpack_array(p: _Packed, decompress: Any) -> np.ndarray:
+    return np.frombuffer(decompress(p.blob),
+                         dtype=np.dtype(p.dtype)).reshape(p.shape)
+
+
+def _unpack(payload: Any, decompress: Any) -> Any:
+    if isinstance(payload, _Packed):
+        return _unpack_array(payload, decompress)
+    if isinstance(payload, tuple):
+        return tuple(_unpack_array(p, decompress) if isinstance(p, _Packed)
+                     else p for p in payload)
+    return payload
+
+
+def _is_packed(payload: Any) -> bool:
+    if isinstance(payload, _Packed):
+        return True
+    return (isinstance(payload, tuple)
+            and any(isinstance(p, _Packed) for p in payload))
+
+
 class WarmEntry(NamedTuple):
     """One spilled conversation: raw k/v payloads for ``n_pages`` pages.
 
@@ -59,8 +126,10 @@ class WarmEntry(NamedTuple):
 
 
 def _payload_bytes(payload: Any) -> int:
+    if isinstance(payload, _Packed):
+        return len(payload.blob)
     if isinstance(payload, tuple):
-        return sum(int(np.asarray(p).nbytes) for p in payload)
+        return sum(_payload_bytes(p) for p in payload)
     return int(np.asarray(payload).nbytes)
 
 
@@ -80,6 +149,9 @@ class HostPageStore:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        self._codec = _resolve_codec()
+        self._raw_in = 0     # uncompressed bytes offered to the codec
+        self._comp_in = 0    # compressed bytes actually stored
 
     # ------------------------------------------------------------- write
     def put(self, key: Any, k_payload: Any, v_payload: Any,
@@ -90,7 +162,16 @@ class HostPageStore:
         alone exceeds capacity it is not stored and ``[key]`` is
         returned — the demote degenerates to a cold eviction.
         """
-        nbytes = _payload_bytes(k_payload) + _payload_bytes(v_payload)
+        raw = _payload_bytes(k_payload) + _payload_bytes(v_payload)
+        nbytes = raw
+        if self._codec is not None:
+            _, compress, _ = self._codec
+            k_payload = _pack(k_payload, compress)
+            v_payload = _pack(v_payload, compress)
+            nbytes = _payload_bytes(k_payload) + _payload_bytes(v_payload)
+            with self._lock:
+                self._raw_in += raw
+                self._comp_in += nbytes
         entry = WarmEntry(k_payload, v_payload, int(n_pages),
                           int(length), nbytes)
         evicted: List[Any] = []
@@ -112,7 +193,9 @@ class HostPageStore:
 
     # -------------------------------------------------------------- read
     def pop(self, key: Any) -> Optional[WarmEntry]:
-        """Remove and return the entry (promotion consumes it)."""
+        """Remove and return the entry (promotion consumes it). Always
+        returns real numpy payloads — compressed entries are inflated
+        here, outside the lock."""
         with self._lock:
             entry = self._entries.pop(key, None)
             if entry is None:
@@ -120,7 +203,19 @@ class HostPageStore:
                 return None
             self._bytes -= entry.nbytes
             self._hits += 1
-            return entry
+        if _is_packed(entry.k) or _is_packed(entry.v):
+            codec = self._codec or _resolve_codec()
+            if codec is None:  # env flipped off mid-life; stdlib fallback
+                import zlib
+
+                decompress: Any = zlib.decompress
+            else:
+                decompress = codec[2]
+            k = _unpack(entry.k, decompress)
+            v = _unpack(entry.v, decompress)
+            entry = WarmEntry(k, v, entry.n_pages, entry.length,
+                              _payload_bytes(k) + _payload_bytes(v))
+        return entry
 
     def has(self, key: Any) -> bool:
         with self._lock:
@@ -149,7 +244,7 @@ class HostPageStore:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "entries": len(self._entries),
                 "pages": sum(e.n_pages for e in self._entries.values()),
                 "bytes": self._bytes,
@@ -158,4 +253,11 @@ class HostPageStore:
                 "hits": self._hits,
                 "misses": self._misses,
                 "evictions": self._evictions,
+                "codec": self._codec[0] if self._codec else None,
             }
+            if self._comp_in > 0:
+                out["raw_bytes_in"] = self._raw_in
+                out["compressed_bytes_in"] = self._comp_in
+                out["compress_ratio"] = round(
+                    self._raw_in / self._comp_in, 3)
+            return out

@@ -181,6 +181,12 @@ class ShardLaneGroup:
         # promotion stays a host->device copy instead of a full
         # re-prefill on a lane that never saw the conversation.
         self.tier_locator: Optional[Callable[[GenRequest], Optional[int]]] = None
+        # swarmfleet (ISSUE 20): SWARMDB_FLEET_TIERS per-lane speed/
+        # reliability weights. DeServe-style: a slow tier is weighted
+        # DOWN in the load score, not excluded — and CRITICAL traffic
+        # pins to the fastest admissible lanes. None = homogeneous.
+        self.lane_weights: Optional[List[float]] = None
+        self.fleet = None
         self._rr = 0
         self._rr_lock = make_lock("parallel.lanes.ShardLaneGroup._rr_lock")
         for idx, eng in enumerate(lanes):
@@ -196,6 +202,15 @@ class ShardLaneGroup:
             # the /admin/mem occupancy rows line up with duty cycles
             if eng.paged is not None:
                 eng.paged.allocator.mem.set_label(f"lane{idx}")
+        # swarmfleet (ISSUE 20): SWARMDB_FLEET=prefill:N,decode:M
+        # partitions the lanes into role-typed pools. Built HERE — before
+        # warmup() — so role-restricted warmup plans shrink each lane's
+        # compile count (prefill lanes skip resident-decode variants and
+        # vice versa). Default off: colocated, bit-for-bit untouched.
+        from .fleet import build_fleet, parse_tier_weights
+
+        self.lane_weights = parse_tier_weights(len(lanes))
+        self.fleet = build_fleet(self)
 
     def _make_probe(self, idx: int) -> Callable[[], bool]:
         def probe() -> bool:
@@ -259,8 +274,16 @@ class ShardLaneGroup:
         ok = [j for j in range(len(self.lanes)) if sup.lane_admissible(j)]
         return ok or list(range(len(self.lanes)))
 
-    def _route(self, request: GenRequest) -> "Tuple[int, Engine]":
+    def _route(self, request: GenRequest,
+               within: Optional[List[int]] = None) -> "Tuple[int, Engine]":
         ok = self._admissible()
+        if within:
+            # pool-restricted routing (swarmfleet): keep only the
+            # requested pool's lanes; if the whole pool is quarantined
+            # fall back to the full admissible set — the FleetManager
+            # handles pool-level degradation before calling in here
+            sel = [j for j in within if j in ok]
+            ok = sel or ok
         if request.shard_hint is not None:
             j = request.shard_hint % len(self.lanes)
             if j in ok:
@@ -282,6 +305,15 @@ class ShardLaneGroup:
                 t = t % len(self.lanes)
                 if t in ok:
                     return t, self.lanes[t]
+        # DeServe-style tier pinning: CRITICAL (priority-0 in deadline
+        # terms, numeric 3 here) traffic only ever lands on the fastest
+        # admissible tier; batch/background is absorbed by slow lanes
+        # via the weighted load score below.
+        w = self.lane_weights
+        if w is not None and request.priority >= 3:
+            top = max(w[j] for j in ok)
+            fast = [j for j in ok if w[j] >= top]
+            ok = fast or ok
         # least-loaded admissible lane; racy reads are fine (load balance
         # is a heuristic, correctness never depends on it). Round-robin
         # tiebreak so an idle group still spreads arrivals.
@@ -292,6 +324,10 @@ class ShardLaneGroup:
         for j in ok:
             e = self.lanes[j]
             load = len(e._queue) + sum(1 for s in e.slots if s.active)
+            if w is not None:
+                # effective load: a half-speed lane at load 2 is as
+                # behind as a full-speed lane at load 4
+                load = load / w[j]
             loads.append((load, (j + rot) % len(self.lanes), j, e))
         _, _, j, e = min(loads, key=lambda t: (t[0], t[1]))
         return j, e
@@ -302,13 +338,21 @@ class ShardLaneGroup:
     def submit(self, request: GenRequest) -> str:
         if self.supervisor is not None:
             # adoption (deadline/retry budgets, migration tracking) +
-            # health-aware routing; the supervisor calls _route directly
+            # health-aware routing; the supervisor dispatches through
+            # the fleet (when present) or _route directly
             return self.supervisor.submit(request)
+        if self.fleet is not None:
+            if self.fleet.dispatch(request) is not None:
+                return request.request_id
         return self._lane_for(request).submit(request)
 
     def cancel(self, request_id: str) -> bool:
         if self.supervisor is not None and self.supervisor.cancel(
                 request_id):
+            return True
+        if self.fleet is not None and self.fleet.cancel(request_id):
+            # transit-gap cancel: stage 1 retired on the prefill pool,
+            # stage 2 not yet submitted — no engine knows the rid
             return True
         for e in self.lanes:
             if e.cancel(request_id):
@@ -385,6 +429,10 @@ class ShardLaneGroup:
         }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
+        if self.fleet is not None:
+            out["fleet"] = self.fleet.stats()
+        if self.lane_weights is not None:
+            out["lane_weights"] = list(self.lane_weights)
         if self.supervisor is not None:
             out["lane_states"] = [
                 l["state"] for l in self.supervisor.status()["lanes"]]

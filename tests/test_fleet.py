@@ -1,0 +1,388 @@
+"""swarmfleet (ISSUE 20): disaggregated prefill/decode lane pools.
+
+The acceptance contracts proven here:
+
+- the env spec parsers reject anything that does not exactly partition
+  the lane count (a silently resized pool would invalidate capacity
+  planning) and fall back to colocated;
+- a staged prefill->decode handoff is greedy BIT-IDENTICAL to the same
+  request on a colocated group (the prefill sample IS the fed token),
+  including the streamed-vs-returned chunk contract;
+- routing honors DeServe tiering: CRITICAL traffic pins to the fastest
+  admissible lane, ``within`` restricts to a pool, and a fully
+  quarantined pool degrades to a correctness-preserving colocated
+  submit on the surviving pool;
+- page custody across the handoff (device -> transit host store ->
+  device) is pagecheck-clean: zero sanitizer violations;
+- a prefill lane KILLED mid-admission-wave loses nothing: the
+  supervisor replays the staged requests on siblings and every stream
+  still finishes bit-identical to the colocated reference.
+
+All on CPU virtual devices; the only sleeping is bounded convergence
+polling. The kill test mutates lane state and therefore runs LAST.
+"""
+
+import os
+import threading
+
+import pytest
+
+# an injected LaneKilled IS an unhandled thread exception — the failure
+# mode under test, not noise
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+from swarmdb_tpu.backend.chaos import ServingChaos, wait_until
+from swarmdb_tpu.backend.engine import GenRequest
+from swarmdb_tpu.backend.sampling import SamplingParams
+from swarmdb_tpu.models.configs import get_config
+from swarmdb_tpu.parallel.fleet import parse_fleet_spec, parse_tier_weights
+from swarmdb_tpu.parallel.mesh import make_mesh
+from swarmdb_tpu.parallel.serving import build_serving_engine
+
+
+# ------------------------------------------------------------ spec parsers
+
+
+def test_parse_fleet_spec_partitions_lanes():
+    assert parse_fleet_spec(4, "prefill:2,decode:2") == {
+        "prefill": [0, 1], "decode": [2, 3]}
+    assert parse_fleet_spec(4, "prefill:1,decode:3") == {
+        "prefill": [0], "decode": [1, 2, 3]}
+    # order in the spec string does not matter; prefill lanes come first
+    assert parse_fleet_spec(3, "decode:2,prefill:1") == {
+        "prefill": [0], "decode": [1, 2]}
+
+
+def test_parse_fleet_spec_rejects_bad_specs():
+    # empty -> fleet off
+    assert parse_fleet_spec(4, "") is None
+    assert parse_fleet_spec(4, "   ") is None
+    # does not sum to the lane count: REJECTED, not resized
+    assert parse_fleet_spec(4, "prefill:1,decode:1") is None
+    assert parse_fleet_spec(4, "prefill:3,decode:3") is None
+    # an empty pool cannot serve its role
+    assert parse_fleet_spec(4, "prefill:0,decode:4") is None
+    assert parse_fleet_spec(4, "prefill:4,decode:0") is None
+    # garbage
+    assert parse_fleet_spec(4, "prefill:two,decode:2") is None
+    assert parse_fleet_spec(4, "fast:2,slow:2") is None
+    assert parse_fleet_spec(4, "prefill=2,decode=2") is None
+
+
+def test_parse_tier_weights():
+    assert parse_tier_weights(4, "1,1,0.5,2") == [1.0, 1.0, 0.5, 2.0]
+    assert parse_tier_weights(4, "") is None
+    # wrong arity, non-positive, or garbage -> homogeneous (None)
+    assert parse_tier_weights(4, "1,1,1") is None
+    assert parse_tier_weights(4, "1,1,0,1") is None
+    assert parse_tier_weights(4, "1,1,-2,1") is None
+    assert parse_tier_weights(4, "a,b,c,d") is None
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def _build_group(n, env):
+    """Build a tiny-debug group with the fleet env pinned around
+    construction only (the spec is read in ShardLaneGroup.__init__)."""
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        g, info = build_serving_engine(
+            get_config("tiny-debug"),
+            make_mesh(n, data=n, model=1, expert=1),
+            max_batch=4, max_seq=128, paged=True, page_size=8,
+            decode_chunk=4)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert info.data_size == n
+    return g
+
+
+@pytest.fixture(scope="module")
+def fleet_stack():
+    """4-lane supervised fleet group (prefill:2,decode:2) with a fast
+    tier on decode lane 3, shared by the module (one compile payment);
+    every test must leave all four lanes healthy."""
+    g = _build_group(4, {"SWARMDB_FLEET": "prefill:2,decode:2",
+                         "SWARMDB_FLEET_TIERS": "1,1,1,2"})
+    assert g.fleet is not None
+    g.start()
+    sup = g.attach_supervisor(
+        suspect_s=0.25, quarantine_s=0.5, poll_s=0.05,
+        probe_clean_n=2, probe_timeout_s=60.0, deadline_s=120.0,
+        retries=2)
+    chaos = ServingChaos(g)
+    yield g, sup, chaos
+    chaos.stop()
+    sup.stop()
+    g.stop()
+
+
+@pytest.fixture(scope="module")
+def colo():
+    """Colocated 4-lane reference group, same geometry: the greedy
+    oracle the fleet's handoff streams must match bit-for-bit."""
+    g = _build_group(4, {"SWARMDB_FLEET": "", "SWARMDB_FLEET_TIERS": ""})
+    assert g.fleet is None and g.lane_weights is None
+    g.start()
+    yield g
+    g.stop()
+
+
+def _healthy(sup) -> bool:
+    return all(l["state"] == "alive" for l in sup.status()["lanes"])
+
+
+def _gen(group, prompt, max_new, priority=1, on_token=None, timeout=120.0):
+    """Submit one request through the group and wait for it; returns
+    (tokens, reason, streamed)."""
+    done = threading.Event()
+    out = {}
+    streamed = []
+
+    def _tok(rid, tok):
+        streamed.append(tok)
+        if on_token is not None:
+            on_token(rid, tok, streamed)
+
+    def _done(rid, toks, reason):
+        out["toks"] = toks
+        out["reason"] = reason
+        done.set()
+
+    req = GenRequest(prompt=list(prompt),
+                     sampling=SamplingParams(max_new_tokens=max_new),
+                     priority=priority, on_token=_tok, on_done=_done)
+    group.submit(req)
+    assert done.wait(timeout), "request never completed"
+    return out["toks"], out["reason"], streamed
+
+
+# ------------------------------------------------------------ pool wiring
+
+
+def test_fleet_pools_wired(fleet_stack):
+    g, sup, _ = fleet_stack
+    wait_until(lambda: _healthy(sup), 30.0, what="all lanes alive")
+    assert g.fleet.pools == {"prefill": [0, 1], "decode": [2, 3]}
+    for j in (0, 1):
+        assert g.lanes[j]._role == "prefill"
+        assert g.fleet.lane_role(j) == "prefill"
+    for j in (2, 3):
+        assert g.lanes[j]._role == "decode"
+        assert g.fleet.lane_role(j) == "decode"
+    st = g.stats()
+    assert st["fleet"]["pool_sizes"] == {"prefill": 2, "decode": 2}
+    assert st["fleet"]["weights"] == [1.0, 1.0, 1.0, 2.0]
+    assert st["lane_weights"] == [1.0, 1.0, 1.0, 2.0]
+    # per-pool duty attribution: the profiler knows each lane's role
+    pools = {getattr(g.lanes[j]._prof, "pool", None) for j in range(4)}
+    assert pools == {"prefill", "decode"}
+    from swarmdb_tpu.obs.profiler import profiler
+
+    rep = profiler().pools_report()
+    assert {r["pool"] for r in rep} >= {"prefill", "decode"}
+
+
+# --------------------------------------------------- handoff bit-identity
+
+
+def test_handoff_bit_identity_vs_colocated(fleet_stack, colo):
+    g, sup, _ = fleet_stack
+    wait_until(lambda: _healthy(sup), 30.0, what="all lanes alive")
+    c = g.metrics.counters
+    handoffs0 = c["fleet_handoffs"].value
+    fallbacks0 = c["fleet_handoff_fallbacks"].value
+    prompts = [[1, 5, 9, 13],
+               [2, 4, 6, 8, 10, 12, 14],
+               list(range(3, 40)),           # multi-page prefill
+               [7, 7, 7]]
+    for p in prompts:
+        ref, rreason, rstream = _gen(colo, p, 16)
+        assert rreason == "length" and len(ref) == 16
+        assert rstream == ref
+        toks, reason, streamed = _gen(g, p, 16)
+        # the staged handoff (prefill sample fed to the decode resume)
+        # must be indistinguishable from the colocated stream
+        assert reason == "length"
+        assert toks == ref, (p, toks, ref)
+        assert streamed == toks
+    st = g.fleet.stats()
+    assert st["handoffs"] - handoffs0 >= len(prompts)
+    assert c["fleet_handoff_fallbacks"].value == fallbacks0
+    # the transit store carried real payloads and drained them all
+    ts = st["transit_store"]
+    assert ts["puts"] >= len(prompts)
+    assert ts["entries"] == 0 and ts["bytes"] == 0
+    assert st["handoff_ms_p50"] is not None
+    assert st["handoff_ms_p95"] >= st["handoff_ms_p50"]
+
+
+def test_admission_only_work_stays_on_prefill_pool(fleet_stack, colo):
+    g, sup, _ = fleet_stack
+    wait_until(lambda: _healthy(sup), 30.0, what="all lanes alive")
+    c = g.metrics.counters
+    direct0 = c["fleet_direct_prefill"].value
+    handoffs0 = c["fleet_handoffs"].value
+    prompt = [3, 1, 4, 1, 5]
+    ref, _, _ = _gen(colo, prompt, 1)
+    toks, reason, _ = _gen(g, prompt, 1)
+    # max_new_tokens=1 is pure admission work: the prefill drain retires
+    # it in place — no handoff, same single greedy token
+    assert reason == "length" and toks == ref and len(toks) == 1
+    assert c["fleet_direct_prefill"].value == direct0 + 1
+    assert c["fleet_handoffs"].value == handoffs0
+
+
+# ----------------------------------------------------------------- routing
+
+
+def test_routing_critical_pins_to_fast_tier(fleet_stack):
+    g, sup, _ = fleet_stack
+    wait_until(lambda: _healthy(sup), 30.0, what="all lanes alive")
+
+    def req(priority):
+        return GenRequest(prompt=[1, 2, 3],
+                          sampling=SamplingParams(max_new_tokens=4),
+                          priority=priority)
+
+    decode = g.fleet.pools["decode"]
+    # CRITICAL (priority 3) pins to the fastest admissible decode lane
+    for _ in range(6):
+        idx, _eng = g._route(req(3), within=decode)
+        assert idx == 3, "CRITICAL must pin to the weight-2.0 lane"
+    # batch traffic spreads across the whole pool (weighted load score,
+    # round-robin tiebreak) — both decode lanes absorb it when idle
+    seen = {g._route(req(1), within=decode)[0] for _ in range(12)}
+    assert seen == set(decode)
+    # within the homogeneous prefill pool, pinning has nothing to pick:
+    # CRITICAL spreads like everything else
+    pre = g.fleet.pools["prefill"]
+    seen = {g._route(req(3), within=pre)[0] for _ in range(12)}
+    assert seen == set(pre)
+    # `within` is a hard restriction, not a hint
+    for j in range(4):
+        assert g._route(req(1), within=[j])[0] == j
+
+
+def test_quarantined_pool_degrades_to_colocated(fleet_stack, monkeypatch):
+    g, sup, _ = fleet_stack
+    wait_until(lambda: _healthy(sup), 30.0, what="all lanes alive")
+    c = g.metrics.counters
+    orig = sup.lane_admissible
+    prompt = [9, 8, 7, 6]
+
+    # the whole prefill pool reads quarantined: the decode pool serves
+    # colocated-style (no handoff) until siblings are re-admitted
+    monkeypatch.setattr(sup, "lane_admissible",
+                        lambda j: j >= 2 and orig(j))
+    fb0 = c["fleet_colocated_fallback"].value
+    ho0 = c["fleet_handoffs"].value
+    toks, reason, streamed = _gen(g, prompt, 8)
+    assert reason == "length" and len(toks) == 8 and streamed == toks
+    assert c["fleet_colocated_fallback"].value > fb0
+    assert c["fleet_handoffs"].value == ho0
+
+    # BOTH pools quarantined: the fleet steps aside entirely and the
+    # group's classic route (full-set fallback) still serves
+    monkeypatch.setattr(sup, "lane_admissible", lambda j: False)
+    toks, reason, _ = _gen(g, prompt, 8)
+    assert reason == "length" and len(toks) == 8
+
+    monkeypatch.setattr(sup, "lane_admissible", orig)
+    wait_until(lambda: _healthy(sup), 30.0, what="lanes re-admitted")
+
+
+# --------------------------------------------------- pagecheck custody
+
+
+def test_handoff_custody_is_pagecheck_clean(monkeypatch, tmp_path):
+    """Every handoff's page custody chain (prefill device pages ->
+    on_demote -> transit host_resident -> on_promote onto the decode
+    lane -> final free) must check out under the sanitizer. Zero
+    violations."""
+    monkeypatch.setenv("SWARMDB_PAGECHECK", "1")
+    monkeypatch.setenv("SWARMDB_FLIGHT_DIR", str(tmp_path))
+    from swarmdb_tpu.obs import pagecheck
+
+    pagecheck.registry().reset()
+    g = _build_group(2, {"SWARMDB_FLEET": "prefill:1,decode:1",
+                         "SWARMDB_FLEET_TIERS": ""})
+    assert g.fleet is not None
+    g.start()
+    try:
+        for i in range(3):
+            toks, reason, _ = _gen(g, [1 + i, 5, 9, 13, 17], 12)
+            assert reason == "length" and len(toks) == 12
+        assert g.fleet.stats()["handoffs"] >= 3
+        assert g.fleet.stats()["handoff_fallbacks"] == 0
+        assert pagecheck.registry().violations() == [], \
+            pagecheck.registry().violations()
+    finally:
+        g.stop()
+        pagecheck.registry().reset()
+
+
+# ------------------------------------------------- chaos: prefill-lane kill
+#
+# LAST in file order: kills a lane and relies on supervisor re-admission.
+
+
+def test_handoff_raced_with_prefill_lane_kill(fleet_stack, colo):
+    """A prefill lane dies while an admission wave is staged on it. The
+    supervisor quarantines the lane and replays its in-flight staged
+    admissions on the sibling prefill lane; every stream still finishes
+    bit-identical to the colocated greedy reference — zero loss, zero
+    duplicates."""
+    g, sup, chaos = fleet_stack
+    wait_until(lambda: _healthy(sup), 30.0, what="all lanes alive")
+    prompt = list(range(2, 30))
+    ref, rreason, _ = _gen(colo, prompt, 20)
+    assert rreason == "length" and len(ref) == 20
+
+    n = 6
+    events = [threading.Event() for _ in range(n)]
+    outs = [{} for _ in range(n)]
+    streams = [[] for _ in range(n)]
+    killed = []
+    kill_lock = threading.Lock()
+
+    def mk(i):
+        def _tok(rid, tok):
+            streams[i].append(tok)
+            # first decoded token anywhere: part of the wave is still
+            # staged on the prefill pool — kill lane 0 under it
+            with kill_lock:
+                if not killed:
+                    killed.append(True)
+                    chaos.kill_lane(0)
+
+        def _done(rid, toks, reason):
+            outs[i]["toks"] = toks
+            outs[i]["reason"] = reason
+            events[i].set()
+
+        return _tok, _done
+
+    for i in range(n):
+        tok, done_cb = mk(i)
+        g.submit(GenRequest(prompt=list(prompt),
+                            sampling=SamplingParams(max_new_tokens=20),
+                            on_token=tok, on_done=done_cb))
+    for i, ev in enumerate(events):
+        assert ev.wait(180.0), f"request {i} never completed"
+    assert killed, "wave finished before the kill armed"
+    for i in range(n):
+        assert outs[i]["reason"] == "length", (i, outs[i])
+        assert outs[i]["toks"] == ref, i
+        assert streams[i] == outs[i]["toks"], i
+    # the killed lane is restarted, probed clean, and re-admitted
+    wait_until(lambda: _healthy(sup), 90.0, what="killed lane re-admitted")

@@ -1,0 +1,115 @@
+"""Warm-tier payload compression (ISSUE 20 satellite): the
+``SWARMDB_TIER_ZSTD`` codec seam on :class:`ops.host_pool.HostPageStore`.
+
+Contracts: the codec is resolved per store at construction (env flips
+affect new stores only); zstd is preferred with zlib as the stdlib
+fallback; round-trips are bit-exact for both plain and quantized
+``(int8 data, f32 scale)`` payloads; capacity accounting and eviction
+run on COMPRESSED bytes; ``stats()`` reports the achieved ratio.
+"""
+
+import numpy as np
+import pytest
+
+from swarmdb_tpu.ops.host_pool import HostPageStore
+
+
+def _plain_payload(pages=4, fill=3):
+    # low-entropy payloads: compressible enough to prove ratio > 1
+    k = np.full((pages, 8, 2, 4), fill, dtype=np.float32)
+    v = np.full((pages, 8, 2, 4), fill + 1, dtype=np.float32)
+    return k, v
+
+
+def _quantized_payload(pages=4):
+    data = np.ones((pages, 8, 2, 4), dtype=np.int8)
+    scale = np.full((pages, 8, 2, 1), 0.5, dtype=np.float32)
+    return (data, scale), (data * 2, scale * 3)
+
+
+def test_codec_off_by_default(monkeypatch):
+    monkeypatch.delenv("SWARMDB_TIER_ZSTD", raising=False)
+    store = HostPageStore(capacity_bytes=1 << 20, label="t")
+    k, v = _plain_payload()
+    assert store.put("a", k, v, 4, 30) == []
+    st = store.stats()
+    assert st["codec"] is None
+    assert "compress_ratio" not in st
+    # uncompressed: stored bytes are the raw payload bytes
+    assert st["bytes"] == k.nbytes + v.nbytes
+    e = store.pop("a")
+    np.testing.assert_array_equal(e.k, k)
+    np.testing.assert_array_equal(e.v, v)
+
+
+def test_zstd_env_roundtrip_bit_exact(monkeypatch):
+    monkeypatch.setenv("SWARMDB_TIER_ZSTD", "1")
+    store = HostPageStore(capacity_bytes=1 << 20, label="t")
+    k, v = _plain_payload()
+    store.put("a", k, v, 4, 30)
+    st = store.stats()
+    # zstd when the wheel is present, zlib stdlib fallback otherwise —
+    # either way the seam is live
+    assert st["codec"] in ("zstd", "zlib")
+    assert st["bytes"] < k.nbytes + v.nbytes
+    assert st["compress_ratio"] > 1.0
+    assert st["raw_bytes_in"] == k.nbytes + v.nbytes
+    assert st["compressed_bytes_in"] == st["bytes"]
+    e = store.pop("a")
+    # pop inflates back to real numpy, bit-exact, nbytes re-rawed
+    np.testing.assert_array_equal(e.k, k)
+    np.testing.assert_array_equal(e.v, v)
+    assert e.k.dtype == np.float32 and e.k.shape == k.shape
+    assert e.nbytes == k.nbytes + v.nbytes
+    assert e.n_pages == 4 and e.length == 30
+
+
+def test_quantized_tuple_payload_roundtrip(monkeypatch):
+    monkeypatch.setenv("SWARMDB_TIER_ZSTD", "1")
+    store = HostPageStore(capacity_bytes=1 << 20, label="t")
+    (kd, ks), (vd, vs) = _quantized_payload()
+    store.put("q", (kd, ks), (vd, vs), 4, 30)
+    e = store.pop("q")
+    assert isinstance(e.k, tuple) and isinstance(e.v, tuple)
+    np.testing.assert_array_equal(e.k[0], kd)
+    np.testing.assert_array_equal(e.k[1], ks)
+    np.testing.assert_array_equal(e.v[0], vd)
+    np.testing.assert_array_equal(e.v[1], vs)
+    assert e.k[0].dtype == np.int8 and e.k[1].dtype == np.float32
+
+
+def test_eviction_accounts_compressed_bytes(monkeypatch):
+    monkeypatch.setenv("SWARMDB_TIER_ZSTD", "1")
+    probe = HostPageStore(capacity_bytes=1 << 20, label="probe")
+    k, v = _plain_payload()
+    probe.put("x", k, v, 4, 30)
+    nbytes = probe.stats()["bytes"]
+    # room for exactly two compressed entries: the third put evicts the
+    # LRU entry, not (raw-sized accounting would evict everything)
+    store = HostPageStore(capacity_bytes=2 * nbytes + 1, label="t")
+    assert store.put("a", k, v, 4, 30) == []
+    assert store.put("b", k, v, 4, 30) == []
+    assert store.put("c", k, v, 4, 30) == ["a"]
+    st = store.stats()
+    assert st["entries"] == 2 and st["evictions"] == 1
+    assert store.pop("a") is None
+    assert store.pop("b") is not None and store.pop("c") is not None
+    assert store.stats()["bytes"] == 0
+
+
+def test_env_flip_off_midlife_still_inflates(monkeypatch):
+    """A store built with the codec on must keep inflating entries even
+    if the env var is flipped off mid-life (ops toggling the flag must
+    not corrupt in-flight payloads)."""
+    monkeypatch.setenv("SWARMDB_TIER_ZSTD", "1")
+    store = HostPageStore(capacity_bytes=1 << 20, label="t")
+    if store.stats()["codec"] == "zstd":
+        pytest.skip("zstd blobs need the zstd codec to inflate; the "
+                    "mid-life fallback seam is zlib-specific")
+    k, v = _plain_payload()
+    store.put("a", k, v, 4, 30)
+    monkeypatch.delenv("SWARMDB_TIER_ZSTD")
+    store._codec = None  # simulate a store that lost its resolution
+    e = store.pop("a")
+    np.testing.assert_array_equal(e.k, k)
+    np.testing.assert_array_equal(e.v, v)
